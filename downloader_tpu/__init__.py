@@ -1,0 +1,31 @@
+"""downloader_tpu — a from-scratch rebuild of tritonmedia/downloader-go.
+
+A queue-driven media-acquisition framework: consumes protobuf ``Download``
+jobs from an AMQP broker, fetches media over HTTP or BitTorrent through a
+pluggable per-protocol downloader registry, scans the result directory for
+video files, uploads them to an S3-compatible object store, and publishes a
+``Convert`` message for the next pipeline stage — with at-least-once
+delivery, supervised broker reconnection, progress reporting, and graceful
+shutdown.
+
+Reference: /root/reference (tritonmedia/downloader-go). The reference is a
+pure network/disk I/O Go microservice with no tensor compute (SURVEY.md §0);
+this rebuild targets the same capability set in Python + stdlib, with the
+AMQP and S3 clients implemented from the wire protocols up rather than
+wrapped from third-party SDKs.
+
+Package map (reference analogue in parens):
+
+- ``wire``     — protobuf job contract            (dep tritonmedia.go)
+- ``scan``     — media file discovery             (internal/process)
+- ``fetch``    — download dispatch + backends     (internal/downloader{,/http,/torrent})
+- ``store``    — S3 client + uploader             (internal/uploader)
+- ``queue``    — AMQP transport, at-least-once    (internal/rabbitmq)
+- ``daemon``   — composition root / job loop      (cmd/downloader)
+- ``ops``      — JAX integrity digests (rebuild-only addition; the
+                 reference has no compute — see SURVEY.md §0)
+- ``parallel`` — sharded multi-device digest path (rebuild-only addition)
+- ``utils``    — structured logging, env helpers  (logrus usage)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from .media import scan_dir, MEDIA_EXTENSIONS  # noqa: F401

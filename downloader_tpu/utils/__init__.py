@@ -1,0 +1,1 @@
+from .logging import get_logger, configure_from_env  # noqa: F401

@@ -1,0 +1,155 @@
+"""Structured logging with logrus-compatible semantics.
+
+The reference uses sirupsen/logrus throughout, configured from two env vars
+in its entrypoint (cmd/downloader/downloader.go:45-52):
+
+- ``LOG_LEVEL=debug``  -> enable caller reporting (file:line on each record)
+- ``LOG_FORMAT=json``  -> JSON formatter instead of key=value text
+
+This module reproduces that surface: a leveled, field-structured logger with
+``with_fields`` chaining (logrus ``WithFields``), a text formatter that
+renders ``time=... level=... msg="..." key=value`` lines and a JSON
+formatter, both thread-safe.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Mapping, TextIO
+
+_LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40, "fatal": 50}
+_LEVEL_NAMES = {v: k for k, v in _LEVELS.items()}
+
+_lock = threading.Lock()
+
+
+class _Config:
+    level: int = _LEVELS["info"]
+    json_format: bool = False
+    report_caller: bool = False
+    stream: TextIO = sys.stderr
+
+
+_config = _Config()
+
+
+def configure(
+    level: str = "info",
+    json_format: bool = False,
+    report_caller: bool = False,
+    stream: TextIO | None = None,
+) -> None:
+    """Set global logging behavior. Mirrors logrus' global configuration."""
+    with _lock:
+        _config.level = _LEVELS.get(level.lower(), _LEVELS["info"])
+        _config.json_format = json_format
+        _config.report_caller = report_caller
+        if stream is not None:
+            _config.stream = stream
+
+
+def configure_from_env(environ: Mapping[str, str] | None = None) -> None:
+    """Configure from LOG_LEVEL / LOG_FORMAT, as the reference entrypoint
+    does (cmd/downloader/downloader.go:45-52): debug level turns on caller
+    reporting; LOG_FORMAT=json selects the JSON formatter."""
+    env = os.environ if environ is None else environ
+    level = env.get("LOG_LEVEL", "info").lower()
+    configure(
+        level=level,
+        json_format=env.get("LOG_FORMAT", "").lower() == "json",
+        report_caller=level == "debug",
+    )
+
+
+def _quote(value: str) -> str:
+    if value == "" or any(ch in value for ch in ' "=\n\t'):
+        return json.dumps(value)
+    return value
+
+
+class Logger:
+    """A named logger carrying a set of structured fields."""
+
+    __slots__ = ("name", "fields")
+
+    def __init__(self, name: str = "", fields: dict[str, Any] | None = None):
+        self.name = name
+        self.fields = fields or {}
+
+    def with_fields(self, **fields: Any) -> "Logger":
+        merged = dict(self.fields)
+        merged.update(fields)
+        return Logger(self.name, merged)
+
+    def with_field(self, key: str, value: Any) -> "Logger":
+        return self.with_fields(**{key: value})
+
+    # -- emit ------------------------------------------------------------
+
+    def _emit(self, level: int, msg: str, exc: BaseException | None = None) -> None:
+        if level < _config.level:
+            return
+        record: dict[str, Any] = {
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            "level": _LEVEL_NAMES.get(level, str(level)),
+            "msg": msg,
+        }
+        if self.name:
+            record["logger"] = self.name
+        if _config.report_caller:
+            # first frame outside this module is the real call site
+            this_file = os.path.normcase(__file__)
+            for frame in reversed(traceback.extract_stack()):
+                if os.path.normcase(frame.filename) != this_file:
+                    record["caller"] = (
+                        f"{os.path.basename(frame.filename)}:{frame.lineno}"
+                    )
+                    break
+        for key in sorted(self.fields):
+            record[key] = self.fields[key]
+        if exc is not None:
+            record["error"] = f"{type(exc).__name__}: {exc}"
+
+        if _config.json_format:
+            line = json.dumps(record, default=str)
+        else:
+            buf = io.StringIO()
+            buf.write(f'time={record.pop("time")} level={record.pop("level")} ')
+            buf.write(f'msg={_quote(record.pop("msg"))}')
+            for key, value in record.items():
+                buf.write(f" {key}={_quote(str(value))}")
+            line = buf.getvalue()
+
+        with _lock:
+            _config.stream.write(line + "\n")
+            _config.stream.flush()
+
+    def debug(self, msg: str) -> None:
+        self._emit(_LEVELS["debug"], msg)
+
+    def info(self, msg: str) -> None:
+        self._emit(_LEVELS["info"], msg)
+
+    def warning(self, msg: str) -> None:
+        self._emit(_LEVELS["warning"], msg)
+
+    warn = warning
+
+    def error(self, msg: str, exc: BaseException | None = None) -> None:
+        self._emit(_LEVELS["error"], msg, exc)
+
+    def fatal(self, msg: str, exc: BaseException | None = None) -> None:
+        """Log at fatal level and raise SystemExit(1), like logrus.Fatal
+        (used by the reference entrypoint, e.g. cmd/downloader/downloader.go:64)."""
+        self._emit(_LEVELS["fatal"], msg, exc)
+        raise SystemExit(1)
+
+
+def get_logger(name: str = "") -> Logger:
+    return Logger(name)

@@ -1,0 +1,76 @@
+"""Structured logging tests: levels, fields, text/json formats, env config."""
+
+import io
+import json
+
+import pytest
+
+from downloader_tpu.utils import logging as ulog
+
+
+@pytest.fixture
+def stream():
+    buf = io.StringIO()
+    ulog.configure(level="info", json_format=False, stream=buf)
+    yield buf
+    ulog.configure(level="info", json_format=False)
+
+
+def test_text_format_fields(stream):
+    ulog.get_logger().with_fields(url="http://x", progress=42.5).info("status")
+    line = stream.getvalue()
+    assert 'msg=status' in line
+    assert "url=http://x" in line
+    assert "progress=42.5" in line
+    assert "level=info" in line
+
+
+def test_quoting(stream):
+    ulog.get_logger().info("two words")
+    assert 'msg="two words"' in stream.getvalue()
+
+
+def test_level_filtering(stream):
+    ulog.get_logger().debug("hidden")
+    assert stream.getvalue() == ""
+    ulog.configure(level="debug", stream=stream)
+    ulog.get_logger().debug("shown")
+    assert "shown" in stream.getvalue()
+
+
+def test_json_format(stream):
+    ulog.configure(json_format=True, stream=stream)
+    ulog.get_logger("queue").with_field("topic", "v1.download").warning("oops")
+    record = json.loads(stream.getvalue())
+    assert record["msg"] == "oops"
+    assert record["level"] == "warning"
+    assert record["logger"] == "queue"
+    assert record["topic"] == "v1.download"
+    assert "time" in record
+
+
+def test_configure_from_env(stream):
+    ulog.configure_from_env({"LOG_LEVEL": "debug", "LOG_FORMAT": "json"})
+    ulog._config.stream = stream
+    ulog.get_logger().debug("d")
+    record = json.loads(stream.getvalue())
+    # debug level enables caller reporting, like logrus SetReportCaller
+    assert "caller" in record
+
+
+def test_fatal_raises_system_exit(stream):
+    with pytest.raises(SystemExit):
+        ulog.get_logger().fatal("boom")
+    assert "boom" in stream.getvalue()
+
+
+def test_error_records_exception(stream):
+    ulog.get_logger().error("failed", exc=ValueError("bad"))
+    assert "ValueError: bad" in stream.getvalue()
+
+
+def test_caller_is_call_site(stream):
+    ulog.configure(level="debug", report_caller=True, stream=stream)
+    ulog.get_logger().debug("where am i")
+    line = stream.getvalue()
+    assert "caller=test_logging.py" in line

@@ -1,0 +1,93 @@
+"""Media scanner tests.
+
+Covers the reference's table-driven fixtures (process_test.go:22-50) —
+movie at root, movie in a single top-level dir, season subdirs — plus the
+skip semantics its ``fake dir/commentary.mkv`` fixture exercises, and
+additional edge cases the reference never tested.
+"""
+
+import pytest
+
+from downloader_tpu.scan import scan_dir
+
+
+def build(tmp_path, layout):
+    for rel in layout:
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(b"x")
+    return tmp_path
+
+
+def rel_results(root, results):
+    return [str(p)[len(str(root)) + 1 :] for p in results]
+
+
+def test_movie_at_root(tmp_path):
+    root = build(tmp_path, ["movie.mkv", "movie.srt"])
+    assert rel_results(root, scan_dir(root)) == ["movie.mkv"]
+
+
+def test_movie_in_single_top_level_dir(tmp_path):
+    root = build(tmp_path, ["movie/movie.mkv", "movie/info.nfo"])
+    assert rel_results(root, scan_dir(root)) == ["movie/movie.mkv"]
+
+
+def test_season_subdirs(tmp_path):
+    root = build(
+        tmp_path,
+        [
+            "season 1/e1.mkv",
+            "season 2/e1.mkv",
+            "fake dir/commentary.mkv",  # not season-like; must be skipped
+        ],
+    )
+    assert rel_results(root, scan_dir(root)) == [
+        "season 1/e1.mkv",
+        "season 2/e1.mkv",
+    ]
+
+
+def test_s01_regex_dir_allowed(tmp_path):
+    root = build(tmp_path, ["s01/e1.mp4", "extras/bonus.mkv"])
+    assert rel_results(root, scan_dir(root)) == ["s01/e1.mp4"]
+
+
+def test_multiple_top_level_dirs_not_auto_allowed(tmp_path):
+    # Two non-season top-level dirs: neither is descended into
+    # (reference only whitelists a single top-level dir, process.go:49-52).
+    root = build(tmp_path, ["a/x.mkv", "b/y.mkv"])
+    assert scan_dir(root) == []
+
+
+def test_single_top_level_dir_nested_seasons(tmp_path):
+    root = build(tmp_path, ["Show/season 1/e1.webm", "Show/deleted scenes/d.mkv"])
+    assert rel_results(root, scan_dir(root)) == ["Show/season 1/e1.webm"]
+
+
+@pytest.mark.parametrize("ext", [".mp4", ".mkv", ".mov", ".webm"])
+def test_all_media_extensions(tmp_path, ext):
+    root = build(tmp_path, [f"m{ext}"])
+    assert rel_results(root, scan_dir(root)) == [f"m{ext}"]
+
+
+@pytest.mark.parametrize("name", ["m.avi", "m.txt", "m.mkv.part", "mkv"])
+def test_non_media_ignored(tmp_path, name):
+    root = build(tmp_path, [name, "real.mkv"])
+    assert rel_results(root, scan_dir(root)) == ["real.mkv"]
+
+
+def test_results_sorted_deterministically(tmp_path):
+    root = build(tmp_path, ["season 1/b.mkv", "season 1/a.mkv"])
+    assert rel_results(root, scan_dir(root)) == ["season 1/a.mkv", "season 1/b.mkv"]
+
+
+def test_missing_dir_raises(tmp_path):
+    with pytest.raises(OSError):
+        scan_dir(tmp_path / "nope")
+
+
+def test_symlink_loop_does_not_hang_or_crash(tmp_path):
+    root = build(tmp_path, ["season 1/e1.mkv"])
+    (root / "season 2").symlink_to(root)  # loop: season-like symlink to root
+    assert rel_results(root, scan_dir(root)) == ["season 1/e1.mkv"]

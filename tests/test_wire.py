@@ -1,0 +1,111 @@
+"""Wire contract tests: round-trips, proto3 wire-format byte vectors,
+unknown-field tolerance, malformed input rejection."""
+
+import pytest
+
+from downloader_tpu.wire import Convert, Download, Media, WireError
+from downloader_tpu.wire import protowire as wire
+
+
+class TestVarint:
+    @pytest.mark.parametrize(
+        "value,encoded",
+        [
+            (0, b"\x00"),
+            (1, b"\x01"),
+            (127, b"\x7f"),
+            (128, b"\x80\x01"),
+            (300, b"\xac\x02"),
+            (2**32, b"\x80\x80\x80\x80\x10"),
+            (2**64 - 1, b"\xff\xff\xff\xff\xff\xff\xff\xff\xff\x01"),
+        ],
+    )
+    def test_known_vectors(self, value, encoded):
+        assert wire.encode_varint(value) == encoded
+        assert wire.decode_varint(encoded, 0) == (value, len(encoded))
+
+    def test_negative_encodes_as_twos_complement(self):
+        encoded = wire.encode_varint(-1)
+        assert encoded == b"\xff\xff\xff\xff\xff\xff\xff\xff\xff\x01"
+
+    def test_truncated(self):
+        with pytest.raises(WireError):
+            wire.decode_varint(b"\x80", 0)
+
+    def test_overlong(self):
+        with pytest.raises(WireError):
+            wire.decode_varint(b"\xff" * 10 + b"\x01", 0)
+
+
+class TestMessages:
+    def test_media_known_bytes(self):
+        # field 1 (id): tag 0x0a; field 2 (source_uri): tag 0x12
+        m = Media(id="m1", source_uri="http://x/a.mkv")
+        assert m.marshal() == b"\x0a\x02m1\x12\x0ehttp://x/a.mkv"
+        assert Media.unmarshal(m.marshal()) == m
+
+    def test_empty_fields_omitted(self):
+        assert Media().marshal() == b""
+        assert Media.unmarshal(b"") == Media()
+
+    def test_download_roundtrip(self):
+        d = Download(media=Media(id="abc", source_uri="magnet:?xt=urn:btih:ff"))
+        decoded = Download.unmarshal(d.marshal())
+        assert decoded.media.id == "abc"
+        assert decoded.media.source_uri == "magnet:?xt=urn:btih:ff"
+
+    def test_convert_roundtrip(self):
+        c = Convert(created_at="2026-07-29T00:00:00Z", media=Media(id="m"))
+        decoded = Convert.unmarshal(c.marshal())
+        assert decoded.created_at == c.created_at
+        assert decoded.media.id == "m"
+
+    def test_unicode(self):
+        m = Media(id="média-𝕩", source_uri="http://host/ファイル.mkv")
+        assert Media.unmarshal(m.marshal()) == m
+
+    def test_unknown_fields_skipped(self):
+        # field 99 varint, field 98 fixed64, field 97 fixed32, then field 1
+        extra = (
+            wire.encode_tag(99, wire.WIRETYPE_VARINT)
+            + wire.encode_varint(7)
+            + wire.encode_tag(98, wire.WIRETYPE_FIXED64)
+            + (1234).to_bytes(8, "little")
+            + wire.encode_tag(97, wire.WIRETYPE_FIXED32)
+            + (5).to_bytes(4, "little")
+            + wire.encode_string(1, "kept")
+        )
+        assert Media.unmarshal(extra).id == "kept"
+
+    def test_malformed_rejected(self):
+        with pytest.raises(WireError):
+            Media.unmarshal(b"\x0a\xff")  # truncated length-delimited
+        with pytest.raises(WireError):
+            Media.unmarshal(b"\x0b\x00")  # wire type 3 (group) unsupported
+        with pytest.raises(WireError):
+            Media.unmarshal(b"\x00")  # field number 0
+
+    def test_wrong_wire_type_for_string_rejected(self):
+        bad = wire.encode_tag(1, wire.WIRETYPE_VARINT) + wire.encode_varint(3)
+        with pytest.raises(WireError):
+            Media.unmarshal(bad)
+
+    def test_invalid_utf8_raises_wire_error(self):
+        # proto3 strings must be valid UTF-8; callers catch WireError only
+        with pytest.raises(WireError):
+            Media.unmarshal(b"\x0a\x02\xff\xfe")
+
+    def test_media_presence_roundtrips(self):
+        # absent submessage stays absent; empty-but-present stays present
+        assert Download().marshal() == b""
+        assert Download.unmarshal(b"").media is None
+        assert Download() == Download.unmarshal(b"")
+        present = Download(media=Media())
+        assert present.marshal() == b"\x0a\x00"
+        assert Download.unmarshal(present.marshal()).media == Media()
+
+    def test_varint_range_enforced(self):
+        with pytest.raises(WireError):
+            wire.encode_varint(1 << 64)
+        with pytest.raises(WireError):
+            wire.encode_varint(-(1 << 63) - 1)
