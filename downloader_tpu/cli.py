@@ -1,0 +1,139 @@
+"""Command-line interface.
+
+``python -m downloader_tpu download-once`` runs one job end-to-end with no
+broker — download → scan → upload — the minimum slice of the reference's
+pipeline (cmd/downloader/downloader.go:116-147 without the AMQP wrapper).
+``python -m downloader_tpu serve`` runs the full queue-driven daemon.
+
+The reference's single CLI flag is ``-cpuprofile`` writing a pprof CPU
+profile (cmd/downloader/downloader.go:26,32-43); ``--cpuprofile`` here
+writes a cProfile dump readable with ``python -m pstats``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import cProfile
+import os
+import sys
+
+from .fetch import DispatchClient, HTTPBackend
+from .scan import scan_dir
+from .store import Uploader
+from .utils import configure_from_env, get_logger
+from .utils.cancel import CancelToken
+
+log = get_logger("cli")
+
+DEFAULT_BUCKET = "triton-staging"  # reference cmd/downloader/downloader.go:95
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="downloader_tpu")
+    parser.add_argument(
+        "--cpuprofile", default="", help="write a cProfile dump to this file"
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    once = sub.add_parser(
+        "download-once", help="run one job (download, scan, upload) with no broker"
+    )
+    once.add_argument("--id", required=True, help="media id for the job")
+    once.add_argument("--url", required=True, help="source URI to download")
+    once.add_argument(
+        "--base-dir",
+        default=os.path.join(os.getcwd(), "downloading"),
+        help="directory jobs download into (default: ./downloading)",
+    )
+    once.add_argument("--bucket", default=DEFAULT_BUCKET)
+    once.add_argument(
+        "--skip-upload",
+        action="store_true",
+        help="stop after scan (no S3_ENDPOINT needed)",
+    )
+
+    serve = sub.add_parser("serve", help="run the queue-driven daemon")
+    serve.add_argument(
+        "--base-dir", default=os.path.join(os.getcwd(), "downloading")
+    )
+    serve.add_argument("--bucket", default=DEFAULT_BUCKET)
+    serve.add_argument(
+        "--concurrency",
+        type=int,
+        default=int(os.environ.get("JOB_CONCURRENCY", "1")),
+        help="parallel job workers (reference fixes this at 1, cmd:100-103)",
+    )
+    return parser
+
+
+def _download_once(args: argparse.Namespace) -> int:
+    token = CancelToken()
+    base_dir = os.path.abspath(args.base_dir)
+    dispatcher = DispatchClient(token, base_dir, _default_backends())
+
+    job_dir = dispatcher.download(args.id, args.url)
+    files = scan_dir(job_dir)
+    log.with_fields(count=len(files)).info("found media files")
+    for path in files:
+        print(path)
+
+    if args.skip_upload:
+        return 0
+
+    uploader = Uploader.from_env(args.bucket)
+    result = uploader.upload_files(token, args.id, files)
+    log.with_fields(
+        uploaded=len(result.uploaded), failed=len(result.failed)
+    ).info("upload complete")
+    return 0 if not result.failed else 1
+
+
+def _default_backends():
+    from .fetch.torrent import TorrentBackend
+
+    # torrent first, then http, matching the reference's registration order
+    # (cmd/downloader/downloader.go:87-90)
+    return [TorrentBackend(), HTTPBackend()]
+
+
+def main(argv: list[str] | None = None) -> int:
+    configure_from_env()
+    args = _build_parser().parse_args(argv)
+
+    profiler = None
+    if args.cpuprofile:
+        profiler = cProfile.Profile()
+        profiler.enable()
+        log.info("started cpu profiler")
+
+    try:
+        if args.command == "download-once":
+            return _download_once(args)
+        if args.command == "serve":
+            try:
+                from .daemon.app import serve
+            except ImportError as exc:
+                log.error(
+                    "the queue-driven daemon is not available in this build",
+                    exc=exc,
+                )
+                return 2
+
+            return serve(
+                base_dir=os.path.abspath(args.base_dir),
+                bucket=args.bucket,
+                concurrency=args.concurrency,
+            )
+        raise AssertionError(f"unhandled command {args.command}")
+    except Exception as exc:  # surface a clean error, not a traceback
+        log.error("job failed", exc=exc)
+        return 1
+    finally:
+        if profiler is not None:
+            profiler.disable()
+            profiler.dump_stats(args.cpuprofile)
+            log.info(f"wrote cpu profile to {args.cpuprofile}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,235 @@
+"""In-process BitTorrent seeder + HTTP tracker, for hermetic tests and
+benchmarks.
+
+Serves exactly one torrent from memory: the tracker half answers announces
+with this seeder as the only peer (compact form), and the peer half speaks
+enough of the wire protocol to seed — handshake, bitfield, unchoke on
+interest, request→piece, and ut_metadata (BEP 9) so magnet flows can be
+tested without .torrent files. The reference has no hermetic torrent
+fixture at all (SURVEY.md §4); this is the rebuild's.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.server
+import socket
+import socketserver
+import struct
+import threading
+import urllib.parse
+
+from . import bencode
+from .peer import (
+    BLOCK_SIZE,
+    HANDSHAKE_PSTR,
+    MSG_BITFIELD,
+    MSG_EXTENDED,
+    MSG_INTERESTED,
+    MSG_PIECE,
+    MSG_REQUEST,
+    MSG_UNCHOKE,
+)
+
+
+def make_torrent(
+    name: str,
+    data: bytes | dict[str, bytes],
+    piece_length: int = 32 * 1024,
+    trackers: tuple[str, ...] = (),
+) -> tuple[dict, bytes, bytes]:
+    """Build (info_dict, metainfo_bytes, content_blob) for a single- or
+    multi-file torrent held in memory."""
+    if isinstance(data, dict):
+        blob = b"".join(data.values())
+        files = [
+            {b"path": [part.encode() for part in path.split("/")], b"length": len(content)}
+            for path, content in data.items()
+        ]
+        info: dict = {
+            b"name": name.encode(),
+            b"piece length": piece_length,
+            b"files": files,
+        }
+    else:
+        blob = data
+        info = {
+            b"name": name.encode(),
+            b"piece length": piece_length,
+            b"length": len(blob),
+        }
+    pieces = b"".join(
+        hashlib.sha1(blob[i : i + piece_length]).digest()
+        for i in range(0, max(len(blob), 1), piece_length)
+    )
+    info[b"pieces"] = pieces
+    meta: dict = {b"info": info}
+    if trackers:
+        meta[b"announce"] = trackers[0].encode()
+        meta[b"announce-list"] = [[t.encode()] for t in trackers]
+    return info, bencode.encode(meta), blob
+
+
+class Seeder:
+    """One-torrent seeder; ``endpoint`` properties expose the tracker URL
+    and a magnet URI for the served torrent."""
+
+    def __init__(self, name: str, data: bytes | dict[str, bytes], piece_length: int = 32 * 1024):
+        self.info, self.metainfo, self.blob = make_torrent(name, data, piece_length)
+        self.info_bytes = bencode.encode(self.info)
+        self.info_hash = hashlib.sha1(self.info_bytes).digest()
+        self.piece_length = piece_length
+
+        seeder = self
+
+        # -- peer half ---------------------------------------------------
+
+        class PeerHandler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock: socket.socket = self.request
+                sock.settimeout(20)
+                try:
+                    seeder._serve_peer(sock)
+                except (OSError, struct.error, ValueError):
+                    pass
+
+        self._peer_server = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", 0), PeerHandler
+        )
+        self._peer_server.daemon_threads = True
+
+        # -- tracker half ------------------------------------------------
+
+        class TrackerHandler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                query = dict(
+                    urllib.parse.parse_qsl(
+                        urllib.parse.urlparse(self.path).query,
+                        encoding="latin-1",
+                    )
+                )
+                seeder.announces.append(query)
+                host, port = seeder.peer_address
+                compact = socket.inet_aton(host) + struct.pack(">H", port)
+                body = bencode.encode({b"interval": 60, b"peers": compact})
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._tracker_server = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), TrackerHandler
+        )
+        self.announces: list[dict] = []
+        self._threads = [
+            threading.Thread(target=self._peer_server.serve_forever, daemon=True),
+            threading.Thread(target=self._tracker_server.serve_forever, daemon=True),
+        ]
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "Seeder":
+        for thread in self._threads:
+            thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._peer_server.shutdown()
+        self._peer_server.server_close()
+        self._tracker_server.shutdown()
+        self._tracker_server.server_close()
+
+    def __enter__(self) -> "Seeder":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @property
+    def peer_address(self) -> tuple[str, int]:
+        return self._peer_server.server_address[:2]
+
+    @property
+    def tracker_url(self) -> str:
+        host, port = self._tracker_server.server_address[:2]
+        return f"http://{host}:{port}/announce"
+
+    @property
+    def magnet_uri(self) -> str:
+        return (
+            f"magnet:?xt=urn:btih:{self.info_hash.hex()}"
+            f"&dn={urllib.parse.quote(self.info.get(b'name', b'').decode())}"
+            f"&tr={urllib.parse.quote(self.tracker_url, safe='')}"
+        )
+
+    # -- peer protocol ---------------------------------------------------
+
+    def _recv_exact(self, sock: socket.socket, count: int) -> bytes:
+        data = bytearray()
+        while len(data) < count:
+            chunk = sock.recv(count - len(data))
+            if not chunk:
+                raise OSError("client gone")
+            data += chunk
+        return bytes(data)
+
+    def _serve_peer(self, sock: socket.socket) -> None:
+        hs = self._recv_exact(sock, 68)
+        if hs[1:20] != HANDSHAKE_PSTR or hs[28:48] != self.info_hash:
+            return
+        reserved = bytearray(8)
+        reserved[5] |= 0x10
+        sock.sendall(
+            bytes([len(HANDSHAKE_PSTR)])
+            + HANDSHAKE_PSTR
+            + bytes(reserved)
+            + self.info_hash
+            + b"-SEED00-" + b"0" * 12
+        )
+        num_pieces = len(self.info[b"pieces"]) // 20
+        bitfield = bytearray((num_pieces + 7) // 8)
+        for i in range(num_pieces):
+            bitfield[i // 8] |= 0x80 >> (i % 8)
+        self._send(sock, MSG_BITFIELD, bytes(bitfield))
+        # extended handshake advertising ut_metadata
+        ext_hs = bencode.encode(
+            {b"m": {b"ut_metadata": 3}, b"metadata_size": len(self.info_bytes)}
+        )
+        self._send(sock, MSG_EXTENDED, bytes([0]) + ext_hs)
+
+        while True:
+            length = struct.unpack(">I", self._recv_exact(sock, 4))[0]
+            if length == 0:
+                continue
+            body = self._recv_exact(sock, length)
+            msg_id, payload = body[0], body[1:]
+            if msg_id == MSG_INTERESTED:
+                self._send(sock, MSG_UNCHOKE)
+            elif msg_id == MSG_REQUEST:
+                index, begin, want = struct.unpack(">III", payload)
+                start = index * self.piece_length + begin
+                chunk = self.blob[start : start + want]
+                self._send(
+                    sock, MSG_PIECE, struct.pack(">II", index, begin) + chunk
+                )
+            elif msg_id == MSG_EXTENDED and payload and payload[0] == 3:
+                request = bencode.decode(payload[1:])
+                if isinstance(request, dict) and request.get(b"msg_type") == 0:
+                    piece = request.get(b"piece", 0)
+                    start = piece * BLOCK_SIZE
+                    chunk = self.info_bytes[start : start + BLOCK_SIZE]
+                    header = bencode.encode(
+                        {
+                            b"msg_type": 1,
+                            b"piece": piece,
+                            b"total_size": len(self.info_bytes),
+                        }
+                    )
+                    # remote's local id for ut_metadata is 1 (peer.py UT_METADATA)
+                    self._send(sock, MSG_EXTENDED, bytes([1]) + header + chunk)
+
+    def _send(self, sock: socket.socket, msg_id: int, payload: bytes = b"") -> None:
+        sock.sendall(struct.pack(">IB", 1 + len(payload), msg_id) + payload)

@@ -1,0 +1,102 @@
+"""BitTorrent download backend.
+
+Rebuild of the reference's ``internal/downloader/torrent`` package
+(torrent.go:18-119), which delegates to anacrolix/torrent. Registration
+matches the reference exactly: protocol ``magnet`` plus file extension
+``.torrent`` (torrent.go:26-37) — and unlike the reference, which registers
+``.torrent`` but then rejects any non-magnet scheme at runtime
+(torrent.go:62-64), this backend accepts both job flavors: a magnet URI, or
+an http(s) URL to a .torrent file which is fetched and parsed.
+
+Per-job isolation mirrors the reference's fresh-client-per-job design
+("prevent state leakage", torrent.go:43-44): every download builds its own
+session state; nothing persists between jobs.
+
+The metadata timeout matches the reference's 10 minutes (torrent.go:67-76)
+and, unlike the reference — whose WaitAll ignores ctx cancellation
+(torrent.go:104-106, its own TODO) — cancellation here aborts the transfer
+promptly at every stage.
+"""
+
+from __future__ import annotations
+
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from ..utils import get_logger
+from ..utils.cancel import CancelToken
+from .dispatch import BackendRegistration, ProgressFn
+from .http import TransferError
+from .magnet import MagnetError, TorrentJob, parse_magnet, parse_metainfo
+
+log = get_logger("fetch.torrent")
+
+METADATA_TIMEOUT = 600.0  # reference torrent.go:67: 10 minutes
+
+
+class TorrentBackend:
+    def __init__(
+        self,
+        progress_interval: float = 1.0,
+        metadata_timeout: float = METADATA_TIMEOUT,
+    ):
+        self._progress_interval = progress_interval
+        self._metadata_timeout = metadata_timeout
+
+    def register(self) -> BackendRegistration:
+        return BackendRegistration(
+            name="torrent",
+            protocols=("magnet",),
+            file_extensions=(".torrent",),
+        )
+
+    # -- job parsing -----------------------------------------------------
+
+    def _job_from_url(self, token: CancelToken, url: str) -> TorrentJob:
+        scheme = urllib.parse.urlparse(url).scheme
+        if scheme == "magnet":
+            return parse_magnet(url)
+        if scheme in ("http", "https"):
+            # the .torrent-file path the reference stubs out (torrent.go:62-64)
+            log.with_fields(url=url).info("fetching .torrent metainfo file")
+            try:
+                response = urllib.request.urlopen(url, timeout=30)
+            except (urllib.error.URLError, OSError) as exc:
+                raise TransferError(f"failed to fetch .torrent file: {exc}") from exc
+            remove_hook = token.add_callback(response.close)
+            try:
+                with response:
+                    data = response.read()
+            except (urllib.error.URLError, OSError) as exc:
+                token.raise_if_cancelled()
+                raise TransferError(f"failed to fetch .torrent file: {exc}") from exc
+            finally:
+                remove_hook()
+            return parse_metainfo(data)
+        raise TransferError(f"unsupported scheme '{scheme}'")
+
+    # -- download --------------------------------------------------------
+
+    def download(
+        self, token: CancelToken, base_dir: str, progress: ProgressFn, url: str
+    ) -> None:
+        try:
+            job = self._job_from_url(token, url)
+        except MagnetError as exc:
+            raise TransferError(str(exc)) from exc
+
+        log.with_fields(
+            info_hash=job.info_hash.hex(), name=job.display_name
+        ).info("prepared torrent job")
+
+        from .peer import SwarmDownloader  # deferred: heaviest module
+
+        downloader = SwarmDownloader(
+            job,
+            base_dir,
+            metadata_timeout=self._metadata_timeout,
+            progress_interval=self._progress_interval,
+        )
+        downloader.run(token, lambda percent: progress(url, percent))
+        progress(url, 100.0)

@@ -1,0 +1,197 @@
+"""Minimal S3-compatible client: bucket ensure + streamed object PUT.
+
+The reference wraps minio-go v6 (uploader.go:41-56); this client speaks the
+S3 REST API directly over http.client with SigV4 auth (sigv4.py) or
+anonymous requests. Path-style addressing is used so it works against
+MinIO, an in-process stub, or AWS alike (the reference uses
+BucketLookupAuto, uploader.go:50).
+
+Operations implemented are exactly the reference's usage surface:
+``bucket_exists`` + ``make_bucket`` (uploader.go:64-70) and ``put_object``
+streaming from a file (uploader.go:86-89).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import io
+import os
+import time
+import urllib.parse
+from typing import BinaryIO
+
+from ..utils.cancel import CancelToken
+from . import sigv4
+from .credentials import Credentials
+
+_STREAM_CHUNK = 1024 * 1024
+
+
+class S3Error(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"s3: {status} {message}")
+        self.status = status
+
+
+class S3Client:
+    def __init__(
+        self,
+        endpoint: str,
+        credentials: Credentials,
+        secure: bool = False,
+        region: str = "us-east-1",
+        timeout: float = 60.0,
+    ):
+        self._host = endpoint
+        self._credentials = credentials
+        self._secure = secure
+        self._region = region
+        self._timeout = timeout
+
+    @classmethod
+    def from_endpoint_url(
+        cls, url: str, credentials: Credentials, region: str = "us-east-1"
+    ) -> "S3Client":
+        """Build from an S3_ENDPOINT-style URL; https selects TLS, and the
+        host:port is extracted, as in the reference (uploader.go:26-41)."""
+        parsed = urllib.parse.urlparse(url)
+        host = parsed.hostname or ""
+        if parsed.port:
+            host = f"{host}:{parsed.port}"
+        if not host:
+            raise ValueError(f"invalid S3 endpoint URL: {url!r}")
+        return cls(host, credentials, secure=parsed.scheme == "https", region=region)
+
+    # -- request plumbing ------------------------------------------------
+
+    def _connect(self) -> http.client.HTTPConnection:
+        conn_cls = (
+            http.client.HTTPSConnection if self._secure else http.client.HTTPConnection
+        )
+        return conn_cls(self._host, timeout=self._timeout)
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: BinaryIO | None = None,
+        content_length: int = 0,
+        payload_hash: str = sigv4.EMPTY_SHA256,
+        content_type: str | None = None,
+        token: CancelToken | None = None,
+    ) -> tuple[int, bytes]:
+        headers: dict[str, str] = {"Host": self._host}
+        if content_type:
+            headers["Content-Type"] = content_type
+        if body is not None:
+            headers["Content-Length"] = str(content_length)
+
+        if not self._credentials.anonymous:
+            amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+            headers["x-amz-date"] = amz_date
+            headers["x-amz-content-sha256"] = payload_hash
+            if self._credentials.session_token:
+                headers["x-amz-security-token"] = self._credentials.session_token
+            headers["Authorization"] = sigv4.sign(
+                method,
+                path,
+                {},
+                headers,
+                payload_hash,
+                self._credentials.access_key,
+                self._credentials.secret_key,
+                self._region,
+                "s3",
+                amz_date,
+            )
+
+        # sign with the raw path (SigV4 canonicalization encodes it once);
+        # percent-encode only for the request line
+        encoded_path = urllib.parse.quote(path, safe="/-._~")
+        conn = self._connect()
+        remove_hook = (
+            token.add_callback(conn.close) if token is not None else lambda: None
+        )
+        try:
+            conn.putrequest(
+                method, encoded_path, skip_host=True, skip_accept_encoding=True
+            )
+            for name, value in headers.items():
+                conn.putheader(name, value)
+            conn.endheaders()
+            if body is not None:
+                while True:
+                    if token is not None:
+                        token.raise_if_cancelled()
+                    chunk = body.read(_STREAM_CHUNK)
+                    if not chunk:
+                        break
+                    conn.send(chunk)
+            response = conn.getresponse()
+            return response.status, response.read()
+        finally:
+            remove_hook()
+            conn.close()
+
+    @staticmethod
+    def _object_path(bucket: str, key: str) -> str:
+        return f"/{bucket}/{key}"
+
+    # -- API surface -----------------------------------------------------
+
+    def bucket_exists(self, bucket: str) -> bool:
+        status, _ = self._request("HEAD", f"/{bucket}")
+        if status in (200,):
+            return True
+        if status in (404,):
+            return False
+        raise S3Error(status, f"HEAD bucket {bucket}")
+
+    def make_bucket(self, bucket: str) -> None:
+        status, body = self._request("PUT", f"/{bucket}")
+        if status not in (200, 204):
+            raise S3Error(status, body.decode(errors="replace")[:200])
+
+    def put_object(
+        self,
+        bucket: str,
+        key: str,
+        stream: BinaryIO,
+        size: int,
+        content_type: str = "application/octet-stream",
+        token: CancelToken | None = None,
+        sign_payload: bool = False,
+    ) -> None:
+        """Streamed PUT, single pass over the data by default (signed as
+        UNSIGNED-PAYLOAD, still SigV4-authenticated). ``sign_payload=True``
+        opts into a signed content hash at the cost of reading seekable
+        streams twice — avoid for large media files."""
+        payload_hash = "UNSIGNED-PAYLOAD"
+        if self._credentials.anonymous:
+            payload_hash = sigv4.EMPTY_SHA256  # unused when unsigned
+        elif sign_payload and stream.seekable():
+            digest = hashlib.sha256()
+            start = stream.tell()
+            while True:
+                chunk = stream.read(_STREAM_CHUNK)
+                if not chunk:
+                    break
+                digest.update(chunk)
+            stream.seek(start)
+            payload_hash = digest.hexdigest()
+
+        status, body = self._request(
+            "PUT",
+            self._object_path(bucket, key),
+            body=stream,
+            content_length=size,
+            payload_hash=payload_hash,
+            content_type=content_type,
+            token=token,
+        )
+        if status not in (200, 201, 204):
+            raise S3Error(status, body.decode(errors="replace")[:200])
+
+    def put_bytes(self, bucket: str, key: str, data: bytes, **kwargs) -> None:
+        self.put_object(bucket, key, io.BytesIO(data), len(data), **kwargs)

@@ -1,0 +1,154 @@
+"""In-memory S3-compatible stub server, for hermetic tests and benchmarks.
+
+The reference has no test double for its uploader (SURVEY.md §4 notes zero
+uploader tests); this stub is the rebuild's answer — a real HTTP server
+speaking just enough S3 (HEAD/PUT bucket, PUT/GET object, path-style) to
+exercise the client end-to-end, including SigV4 verification: when
+constructed with credentials it recomputes the signature from the received
+request and rejects mismatches with 403, so canonicalization bugs in the
+client surface as test failures.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.server
+import re
+import threading
+import urllib.parse
+
+from . import sigv4
+from .credentials import Credentials
+
+_AUTH_RE = re.compile(
+    r"AWS4-HMAC-SHA256 Credential=(?P<access>[^/]+)/(?P<date>\d{8})/"
+    r"(?P<region>[^/]+)/(?P<service>[^/]+)/aws4_request, "
+    r"SignedHeaders=(?P<signed>[^,]+), Signature=(?P<signature>[0-9a-f]{64})"
+)
+
+
+class S3Stub:
+    def __init__(self, credentials: Credentials | None = None):
+        self.credentials = credentials
+        self.buckets: dict[str, dict[str, bytes]] = {}
+        self.lock = threading.Lock()
+        stub = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _reject(self, status: int, message: str = "") -> None:
+                body = message.encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _read_body(self) -> bytes:
+                length = int(self.headers.get("Content-Length", "0"))
+                return self.rfile.read(length) if length else b""
+
+            def _verify_auth(self, body: bytes) -> bool:
+                if stub.credentials is None or stub.credentials.anonymous:
+                    return True
+                match = _AUTH_RE.match(self.headers.get("Authorization", ""))
+                if not match or match["access"] != stub.credentials.access_key:
+                    return False
+                headers = {
+                    name: self.headers[name]
+                    for name in match["signed"].split(";")
+                    if name in self.headers
+                }
+                payload_hash = self.headers.get(
+                    "x-amz-content-sha256", sigv4.EMPTY_SHA256
+                )
+                if payload_hash not in ("UNSIGNED-PAYLOAD",):
+                    if hashlib.sha256(body).hexdigest() != payload_hash:
+                        return False
+                parsed = urllib.parse.urlparse(self.path)
+                query = dict(urllib.parse.parse_qsl(parsed.query))
+                expected = sigv4.sign(
+                    self.command,
+                    urllib.parse.unquote(parsed.path),
+                    query,
+                    headers,
+                    payload_hash,
+                    stub.credentials.access_key,
+                    stub.credentials.secret_key,
+                    match["region"],
+                    match["service"],
+                    self.headers.get("x-amz-date", ""),
+                )
+                return expected.endswith(match["signature"])
+
+            def _route(self) -> tuple[str, str]:
+                path = urllib.parse.unquote(urllib.parse.urlparse(self.path).path)
+                parts = path.lstrip("/").split("/", 1)
+                bucket = parts[0]
+                key = parts[1] if len(parts) > 1 else ""
+                return bucket, key
+
+            def do_HEAD(self):
+                bucket, key = self._route()
+                with stub.lock:
+                    if key:
+                        exists = key in stub.buckets.get(bucket, {})
+                    else:
+                        exists = bucket in stub.buckets
+                self._reject(200 if exists else 404)
+
+            def do_PUT(self):
+                body = self._read_body()
+                if not self._verify_auth(body):
+                    self._reject(403, "SignatureDoesNotMatch")
+                    return
+                bucket, key = self._route()
+                with stub.lock:
+                    if not key:
+                        stub.buckets.setdefault(bucket, {})
+                        self._reject(200)
+                        return
+                    if bucket not in stub.buckets:
+                        self._reject(404, "NoSuchBucket")
+                        return
+                    stub.buckets[bucket][key] = body
+                self._reject(200)
+
+            def do_GET(self):
+                bucket, key = self._route()
+                with stub.lock:
+                    data = stub.buckets.get(bucket, {}).get(key)
+                if data is None:
+                    self._reject(404, "NoSuchKey")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self) -> "S3Stub":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def __enter__(self) -> "S3Stub":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,104 @@
+"""Media uploader: push scanned files to the object store.
+
+Rebuild of the reference's ``internal/uploader`` (uploader.go:24-97):
+
+- Client built from ``S3_ENDPOINT`` (scheme selects TLS, uploader.go:32-36)
+  and the env credential chain (credentials.py).
+- ``upload_files``: ensure the bucket exists, creating it best-effort with
+  a warning on failure (uploader.go:64-70); upload each file to
+  ``<media_id>/original/<base64(basename)>`` — base64 so arbitrary media
+  names can't produce invalid object keys (uploader.go:86-89); per-file
+  failures are logged and skipped (uploader.go:74-91).
+
+Upgrade over the reference (its own TODO, uploader.go:61): the result
+reports which files uploaded and which failed, and the call raises
+UploadError if every file failed, so the daemon can leave the job
+unacked/retryable instead of acking a wholly failed upload.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+from dataclasses import dataclass, field
+
+from ..utils import get_logger
+from ..utils.cancel import CancelToken
+from .credentials import from_env
+from .s3 import S3Client, S3Error
+
+log = get_logger("store")
+
+
+class UploadError(Exception):
+    """Raised when no file of a non-empty batch could be uploaded."""
+
+
+@dataclass
+class UploadResult:
+    uploaded: list[tuple[str, str]] = field(default_factory=list)  # (path, key)
+    failed: list[tuple[str, str]] = field(default_factory=list)  # (path, error)
+
+
+def object_key(media_id: str, file_path: str) -> str:
+    encoded = base64.b64encode(os.path.basename(file_path).encode()).decode()
+    return f"{media_id}/original/{encoded}"
+
+
+class Uploader:
+    def __init__(self, bucket: str, client: S3Client):
+        self._bucket = bucket
+        self._client = client
+
+    @classmethod
+    def from_env(cls, bucket: str) -> "Uploader":
+        endpoint = os.environ.get("S3_ENDPOINT", "")
+        client = S3Client.from_endpoint_url(endpoint, from_env())
+        return cls(bucket, client)
+
+    def _ensure_bucket(self) -> None:
+        try:
+            if self._client.bucket_exists(self._bucket):
+                return
+        except S3Error as exc:
+            log.warning(f"failed to check bucket: {exc}")
+            return
+        try:
+            self._client.make_bucket(self._bucket)
+            log.info("created bucket")
+        except S3Error as exc:
+            # best-effort, like the reference (uploader.go:66-69)
+            log.warning(f"failed to create bucket: {exc}")
+
+    def upload_files(
+        self,
+        token: CancelToken,
+        media_id: str,
+        files: list[str],
+    ) -> UploadResult:
+        self._ensure_bucket()
+        result = UploadResult()
+
+        for file_path in files:
+            token.raise_if_cancelled()
+            key = object_key(media_id, file_path)
+            try:
+                size = os.stat(file_path).st_size
+                with open(file_path, "rb") as stream:
+                    log.with_fields(key=key, size=size).info(
+                        "starting upload of file"
+                    )
+                    self._client.put_object(
+                        self._bucket, key, stream, size, token=token
+                    )
+                log.info("finished upload")
+                result.uploaded.append((file_path, key))
+            except (OSError, S3Error) as exc:
+                log.error(f"failed to upload file '{file_path}'", exc=exc)
+                result.failed.append((file_path, str(exc)))
+
+        if files and not result.uploaded:
+            raise UploadError(
+                f"all {len(result.failed)} uploads failed for media '{media_id}'"
+            )
+        return result

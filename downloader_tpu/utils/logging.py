@@ -23,8 +23,16 @@ import time
 import traceback
 from typing import Any, Mapping, TextIO
 
-_LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40, "fatal": 50}
-_LEVEL_NAMES = {v: k for k, v in _LEVELS.items()}
+_LEVELS = {
+    "trace": 5,
+    "debug": 10,
+    "info": 20,
+    "warn": 30,  # logrus accepts both spellings
+    "warning": 30,
+    "error": 40,
+    "fatal": 50,
+}
+_LEVEL_NAMES = {10: "debug", 20: "info", 30: "warning", 40: "error", 50: "fatal"}
 
 _lock = threading.Lock()
 

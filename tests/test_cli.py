@@ -1,0 +1,123 @@
+"""End-to-end CLI tests: the no-broker `download-once` slice across both
+backends — local HTTP file server / hermetic torrent swarm → scan →
+in-memory S3 — exercising the whole pipeline the way an operator would."""
+
+import base64
+import http.server
+import threading
+
+import pytest
+
+from downloader_tpu.cli import main
+from downloader_tpu.store import Credentials
+from downloader_tpu.store.stub import S3Stub
+from downloader_tpu.fetch.seeder import Seeder
+
+MOVIE = b"\x00fake-matroska\x01" * 4096
+
+
+@pytest.fixture
+def file_server():
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(MOVIE)))
+            self.end_headers()
+            self.wfile.write(MOVIE)
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+@pytest.fixture
+def s3_env(monkeypatch):
+    creds = Credentials(access_key="ak", secret_key="sk")
+    with S3Stub(credentials=creds) as stub:
+        monkeypatch.setenv("S3_ENDPOINT", f"http://{stub.endpoint}")
+        monkeypatch.setenv("S3_ACCESS_KEY", "ak")
+        monkeypatch.setenv("S3_SECRET_KEY", "sk")
+        yield stub
+
+
+def test_download_once_http_end_to_end(file_server, s3_env, tmp_path, capsys):
+    code = main(
+        [
+            "download-once",
+            "--id", "media-42",
+            "--url", f"{file_server}/movie.mkv",
+            "--base-dir", str(tmp_path),
+            "--bucket", "triton-staging",
+        ]
+    )
+    assert code == 0
+    # scanner found it and printed the path
+    assert "movie.mkv" in capsys.readouterr().out
+    # upload landed under <id>/original/<b64 name>
+    key = f"media-42/original/{base64.b64encode(b'movie.mkv').decode()}"
+    assert s3_env.buckets["triton-staging"][key] == MOVIE
+
+
+def test_download_once_magnet_end_to_end(s3_env, tmp_path):
+    with Seeder("movie.mkv", MOVIE) as seeder:
+        code = main(
+            [
+                "download-once",
+                "--id", "media-7",
+                "--url", seeder.magnet_uri,
+                "--base-dir", str(tmp_path),
+            ]
+        )
+    assert code == 0
+    key = f"media-7/original/{base64.b64encode(b'movie.mkv').decode()}"
+    assert s3_env.buckets["triton-staging"][key] == MOVIE
+
+
+def test_download_once_skip_upload(file_server, tmp_path):
+    code = main(
+        [
+            "download-once",
+            "--id", "m",
+            "--url", f"{file_server}/film.mkv",
+            "--base-dir", str(tmp_path),
+            "--skip-upload",
+        ]
+    )
+    assert code == 0
+    assert (tmp_path / "m" / "film.mkv").read_bytes() == MOVIE
+
+
+def test_download_once_failure_exit_code(tmp_path):
+    code = main(
+        [
+            "download-once",
+            "--id", "m",
+            "--url", "http://127.0.0.1:9/nope.mkv",
+            "--base-dir", str(tmp_path),
+            "--skip-upload",
+        ]
+    )
+    assert code == 1
+
+
+def test_cpuprofile_written(file_server, tmp_path):
+    profile = tmp_path / "cpu.prof"
+    code = main(
+        [
+            "--cpuprofile", str(profile),
+            "download-once",
+            "--id", "m",
+            "--url", f"{file_server}/a.mkv",
+            "--base-dir", str(tmp_path / "dl"),
+            "--skip-upload",
+        ]
+    )
+    assert code == 0
+    import pstats
+
+    stats = pstats.Stats(str(profile))  # parses → valid profile dump
+    assert stats.total_calls > 0

@@ -1,0 +1,266 @@
+"""Dispatch + HTTP backend tests, driven against a real local HTTP server
+(hermetic analogue of Go's httptest): happy path, Content-Disposition
+naming, Range resume after mid-stream disconnects, error propagation (the
+bug the reference had), routing rules, and cancellation."""
+
+import http.server
+import os
+import threading
+import time
+
+import pytest
+
+from downloader_tpu.fetch import (
+    BackendRegistration,
+    DispatchClient,
+    HTTPBackend,
+    TransferError,
+    UnsupportedJobError,
+)
+from downloader_tpu.fetch.http import filename_for
+from downloader_tpu.utils.cancel import Cancelled, CancelToken
+
+PAYLOAD = bytes(range(256)) * 1024  # 256 KiB
+
+
+class Handler(http.server.BaseHTTPRequestHandler):
+    """Serves PAYLOAD at /file.mkv with Range support; /flaky drops the
+    connection halfway on the first N requests; /cd sets
+    Content-Disposition; /404 errors; /slow trickles forever."""
+
+    flaky_failures = {}
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        if self.path == "/404":
+            self.send_error(404)
+            return
+        if self.path == "/slow":
+            self.send_response(200)
+            self.send_header("Content-Length", str(10**9))
+            self.end_headers()
+            try:
+                while True:
+                    self.wfile.write(b"x" * 1024)
+                    time.sleep(0.05)
+            except (BrokenPipeError, ConnectionResetError):
+                return
+
+        body = PAYLOAD
+        start = 0
+        status = 200
+        headers = {}
+        range_header = self.headers.get("Range")
+        if range_header and range_header.startswith("bytes="):
+            start = int(range_header[6:].rstrip("-"))
+            status = 206
+            headers["Content-Range"] = f"bytes {start}-{len(body)-1}/{len(body)}"
+            body = body[start:]
+
+        if self.path == "/cd":
+            headers["Content-Disposition"] = 'attachment; filename="named.mkv"'
+
+        truncate_at = None
+        if self.path.startswith("/flaky"):
+            remaining = Handler.flaky_failures.get(self.path, 0)
+            if remaining > 0:
+                Handler.flaky_failures[self.path] = remaining - 1
+                truncate_at = len(body) // 2
+
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.end_headers()
+        if truncate_at is not None:
+            self.wfile.write(body[:truncate_at])
+            self.wfile.flush()
+            self.connection.close()  # mid-stream disconnect
+        else:
+            self.wfile.write(body)
+
+
+@pytest.fixture(scope="module")
+def server():
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+@pytest.fixture
+def backend():
+    return HTTPBackend(progress_interval=0.01, timeout=5)
+
+
+def test_download_happy_path(server, backend, tmp_path):
+    updates = []
+    backend.download(CancelToken(), str(tmp_path), lambda u, p: updates.append(p), f"{server}/file.mkv")
+    target = tmp_path / "file.mkv"
+    assert target.read_bytes() == PAYLOAD
+    assert not (tmp_path / "file.mkv.part").exists()
+    assert updates[-1] == 100.0
+
+
+def test_content_disposition_naming(server, backend, tmp_path):
+    backend.download(CancelToken(), str(tmp_path), lambda u, p: None, f"{server}/cd")
+    assert (tmp_path / "named.mkv").read_bytes() == PAYLOAD
+
+
+def test_resume_after_disconnect(server, backend, tmp_path):
+    Handler.flaky_failures["/flaky1"] = 2  # first two requests cut halfway
+    backend.download(CancelToken(), str(tmp_path), lambda u, p: None, f"{server}/flaky1")
+    assert (tmp_path / "flaky1").read_bytes() == PAYLOAD
+
+
+def test_gives_up_after_max_resume_attempts(server, tmp_path):
+    Handler.flaky_failures["/flaky2"] = 99
+    backend = HTTPBackend(progress_interval=0.01, timeout=5, max_resume_attempts=2)
+    with pytest.raises(TransferError):
+        backend.download(CancelToken(), str(tmp_path), lambda u, p: None, f"{server}/flaky2")
+
+
+def test_http_error_propagates(server, backend, tmp_path):
+    # the reference swallowed transfer errors (http.go:70); we must not
+    with pytest.raises(TransferError):
+        backend.download(CancelToken(), str(tmp_path), lambda u, p: None, f"{server}/404")
+
+
+def test_connection_refused_propagates(backend, tmp_path):
+    with pytest.raises(TransferError):
+        backend.download(
+            CancelToken(), str(tmp_path), lambda u, p: None, "http://127.0.0.1:9/x"
+        )
+
+
+def test_cancellation_aborts_midstream(server, backend, tmp_path):
+    token = CancelToken()
+    error = []
+
+    def run():
+        try:
+            backend.download(token, str(tmp_path), lambda u, p: None, f"{server}/slow")
+        except Cancelled:
+            error.append("cancelled")
+
+    thread = threading.Thread(target=run)
+    thread.start()
+    time.sleep(0.3)
+    token.cancel()
+    thread.join(timeout=5)
+    assert not thread.is_alive()
+    assert error == ["cancelled"]
+
+
+@pytest.mark.parametrize(
+    "url,cd,expected",
+    [
+        ("http://h/path/movie.mkv", None, "movie.mkv"),
+        ("http://h/path/", None, "path"),
+        ("http://h/", None, "download"),
+        ("http://h/x", 'attachment; filename="a b.mkv"', "a b.mkv"),
+        ("http://h/x", 'attachment; filename="../../etc/passwd"', "passwd"),
+        ("http://h/x", 'attachment; filename="..\\..\\evil.exe"', "evil.exe"),
+        ("http://h/%E3%83%95%E3%82%A1.mkv", None, "ファ.mkv"),
+    ],
+)
+def test_filename_for(url, cd, expected):
+    assert filename_for(url, cd) == expected
+
+
+# -- dispatch ------------------------------------------------------------
+
+
+class FakeBackend:
+    def __init__(self, name="fake", protocols=(), exts=()):
+        self.name, self.protocols, self.exts = name, protocols, exts
+        self.calls = []
+
+    def register(self):
+        return BackendRegistration(
+            name=self.name, protocols=tuple(self.protocols), file_extensions=tuple(self.exts)
+        )
+
+    def download(self, token, base_dir, progress, url):
+        self.calls.append((base_dir, url))
+
+
+def test_dispatch_by_scheme(tmp_path):
+    fake = FakeBackend(protocols=("http", "https"))
+    client = DispatchClient(CancelToken(), str(tmp_path), [fake])
+    job_dir = client.download("id1", "http://host/x.bin")
+    assert job_dir == str(tmp_path / "id1")
+    assert os.path.isdir(job_dir)
+    assert fake.calls == [(job_dir, "http://host/x.bin")]
+
+
+def test_extension_beats_scheme_for_http(tmp_path):
+    by_ext = FakeBackend(name="torrent", protocols=("magnet",), exts=(".torrent",))
+    by_scheme = FakeBackend(name="http", protocols=("http", "https"))
+    client = DispatchClient(CancelToken(), str(tmp_path), [by_ext, by_scheme])
+    client.download("id", "http://host/file.torrent")
+    assert by_ext.calls and not by_scheme.calls
+
+
+def test_extension_ignored_for_non_http(tmp_path):
+    by_ext = FakeBackend(name="e", exts=(".torrent",))
+    client = DispatchClient(CancelToken(), str(tmp_path), [by_ext])
+    # ftp URL with .torrent ext: ext map only applies to http/s
+    with pytest.raises(UnsupportedJobError):
+        client.download("id", "ftp://host/file.torrent")
+
+
+def test_unsupported_job(tmp_path):
+    client = DispatchClient(CancelToken(), str(tmp_path), [])
+    with pytest.raises(UnsupportedJobError):
+        client.download("id", "gopher://host/x")
+
+
+def test_backend_error_propagates(tmp_path):
+    class Exploding(FakeBackend):
+        def download(self, token, base_dir, progress, url):
+            raise TransferError("boom")
+
+    client = DispatchClient(
+        CancelToken(), str(tmp_path), [Exploding(protocols=("http",))]
+    )
+    with pytest.raises(TransferError):
+        client.download("id", "http://host/x")
+
+
+def test_relative_base_dir_rejected():
+    with pytest.raises(ValueError):
+        DispatchClient(CancelToken(), "relative/dir", [])
+
+
+def test_first_registered_backend_wins(tmp_path):
+    first = FakeBackend(name="first", protocols=("http",))
+    second = FakeBackend(name="second", protocols=("http",))
+    client = DispatchClient(CancelToken(), str(tmp_path), [first, second])
+    client.download("id", "http://host/x")
+    assert first.calls and not second.calls
+
+
+def test_failed_request_leaves_no_cancel_hooks(server, backend, tmp_path):
+    token = CancelToken()
+    with pytest.raises(TransferError):
+        backend.download(token, str(tmp_path), lambda u, p: None, f"{server}/404")
+    assert not token._callbacks  # no leaked response.close hooks
+
+
+def test_resume_restarts_when_part_file_vanishes(server, tmp_path):
+    Handler.flaky_failures["/flaky3"] = 1
+
+    class PartDeletingBackend(HTTPBackend):
+        def _open(self, url, offset):
+            if offset:  # simulate a tmp-cleaner racing the resume
+                for part in tmp_path.glob("*.part"):
+                    part.unlink()
+            return super()._open(url, offset)
+
+    backend = PartDeletingBackend(progress_interval=0.01, timeout=5)
+    backend.download(CancelToken(), str(tmp_path), lambda u, p: None, f"{server}/flaky3")
+    assert (tmp_path / "flaky3").read_bytes() == PAYLOAD  # not corrupt

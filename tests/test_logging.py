@@ -74,3 +74,12 @@ def test_caller_is_call_site(stream):
     ulog.get_logger().debug("where am i")
     line = stream.getvalue()
     assert "caller=test_logging.py" in line
+
+
+def test_warn_level_alias(stream):
+    ulog.configure_from_env({"LOG_LEVEL": "warn"})
+    ulog._config.stream = stream
+    ulog.get_logger().info("hidden")
+    assert stream.getvalue() == ""
+    ulog.get_logger().warning("shown")
+    assert "shown" in stream.getvalue()

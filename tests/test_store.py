@@ -1,0 +1,202 @@
+"""Store layer tests: SigV4 against AWS's published vectors, credential
+chain precedence, S3 client against the stub (signed + anonymous), and
+uploader semantics (b64 keys, bucket ensure, partial-failure policy)."""
+
+import io
+import os
+
+import pytest
+
+from downloader_tpu.store import (
+    Credentials,
+    S3Client,
+    S3Error,
+    Uploader,
+    UploadError,
+    object_key,
+)
+from downloader_tpu.store import credentials as creds_mod
+from downloader_tpu.store import sigv4
+from downloader_tpu.store.stub import S3Stub
+from downloader_tpu.utils.cancel import CancelToken
+
+
+class TestSigV4:
+    def test_aws_documentation_example(self):
+        # Worked example from AWS SigV4 docs ("Task 1-4", GET to IAM):
+        # expected values are published constants.
+        headers = {
+            "content-type": "application/x-www-form-urlencoded; charset=utf-8",
+            "host": "iam.amazonaws.com",
+            "x-amz-date": "20150830T123600Z",
+        }
+        auth = sigv4.sign(
+            "GET",
+            "/",
+            {"Action": "ListUsers", "Version": "2010-05-08"},
+            headers,
+            sigv4.EMPTY_SHA256,
+            "AKIDEXAMPLE",
+            "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY",
+            "us-east-1",
+            "iam",
+            "20150830T123600Z",
+        )
+        assert auth.endswith(
+            "Signature=5d672d79c15b13162d9279b0855cfba6789a8edb4c82c400e06b5924a6f2b5d7"
+        )
+        assert "Credential=AKIDEXAMPLE/20150830/us-east-1/iam/aws4_request" in auth
+        assert "SignedHeaders=content-type;host;x-amz-date" in auth
+
+    def test_signing_key_vector(self):
+        # Published derived-key vector from the same AWS docs example
+        key = sigv4.signing_key(
+            "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY", "20150830", "us-east-1", "iam"
+        )
+        assert key.hex() == (
+            "c4afb1cc5771d871763a393e44b703571b55cc28424d1a5e86da6ed3c154a4b9"
+        )
+
+
+class TestCredentialChain:
+    def test_generic_wins(self):
+        env = {
+            "S3_ACCESS_KEY": "g",
+            "S3_SECRET_KEY": "gs",
+            "AWS_ACCESS_KEY_ID": "a",
+            "AWS_SECRET_ACCESS_KEY": "as",
+        }
+        assert creds_mod.from_env(env).access_key == "g"
+
+    def test_aws_chain_second(self):
+        env = {"AWS_ACCESS_KEY_ID": "a", "AWS_SECRET_ACCESS_KEY": "as"}
+        creds = creds_mod.from_env(env)
+        assert creds.access_key == "a" and not creds.anonymous
+
+    def test_minio_chain_third(self):
+        env = {"MINIO_ACCESS_KEY": "m", "MINIO_SECRET_KEY": "ms"}
+        assert creds_mod.from_env(env).access_key == "m"
+
+    def test_anonymous_fallback(self):
+        assert creds_mod.from_env({}).anonymous
+
+    def test_partial_pair_skipped(self):
+        env = {"S3_ACCESS_KEY": "g", "MINIO_ACCESS_KEY": "m", "MINIO_SECRET_KEY": "s"}
+        assert creds_mod.from_env(env).access_key == "m"
+
+
+CREDS = Credentials(access_key="testkey", secret_key="testsecret")
+
+
+@pytest.fixture
+def stub():
+    with S3Stub(credentials=CREDS) as server:
+        yield server
+
+
+def client_for(stub, creds=CREDS):
+    return S3Client(stub.endpoint, creds)
+
+
+class TestS3Client:
+    def test_bucket_lifecycle(self, stub):
+        client = client_for(stub)
+        assert not client.bucket_exists("b")
+        client.make_bucket("b")
+        assert client.bucket_exists("b")
+
+    def test_put_object_signed(self, stub):
+        client = client_for(stub)
+        client.make_bucket("b")
+        client.put_bytes("b", "dir/obj.bin", b"hello world")
+        assert stub.buckets["b"]["dir/obj.bin"] == b"hello world"
+
+    def test_bad_signature_rejected(self, stub):
+        bad = client_for(stub, Credentials(access_key="testkey", secret_key="wrong"))
+        with pytest.raises(S3Error) as excinfo:
+            bad.make_bucket("b")
+        assert excinfo.value.status == 403
+
+    def test_anonymous_against_open_stub(self):
+        with S3Stub() as open_stub:
+            client = S3Client(open_stub.endpoint, Credentials())
+            client.make_bucket("pub")
+            client.put_bytes("pub", "k", b"data")
+            assert open_stub.buckets["pub"]["k"] == b"data"
+
+    def test_put_to_missing_bucket_errors(self, stub):
+        client = client_for(stub)
+        with pytest.raises(S3Error):
+            client.put_bytes("nobucket", "k", b"x")
+
+    def test_unicode_key_roundtrip(self, stub):
+        client = client_for(stub)
+        client.make_bucket("b")
+        client.put_bytes("b", "id/original/ファイル=+", b"x")
+        assert "id/original/ファイル=+" in stub.buckets["b"]
+
+    def test_endpoint_url_parsing(self):
+        client = S3Client.from_endpoint_url("https://s3.example.com:9000", Credentials())
+        assert client._host == "s3.example.com:9000" and client._secure
+        client = S3Client.from_endpoint_url("http://127.0.0.1:9000", Credentials())
+        assert not client._secure
+        with pytest.raises(ValueError):
+            S3Client.from_endpoint_url("not a url", Credentials())
+
+
+class TestUploader:
+    def make_files(self, tmp_path, names):
+        paths = []
+        for name in names:
+            p = tmp_path / name
+            p.write_bytes(b"content of " + name.encode())
+            paths.append(str(p))
+        return paths
+
+    def test_upload_files_b64_keys(self, stub, tmp_path):
+        files = self.make_files(tmp_path, ["movie.mkv", "weird name [x].mkv"])
+        uploader = Uploader("triton-staging", client_for(stub))
+        result = uploader.upload_files(CancelToken(), "media-1", files)
+        assert len(result.uploaded) == 2 and not result.failed
+        import base64
+
+        for path in files:
+            key = f"media-1/original/{base64.b64encode(os.path.basename(path).encode()).decode()}"
+            assert stub.buckets["triton-staging"][key] == open(path, "rb").read()
+
+    def test_bucket_created_if_missing(self, stub, tmp_path):
+        files = self.make_files(tmp_path, ["a.mkv"])
+        Uploader("newbucket", client_for(stub)).upload_files(
+            CancelToken(), "m", files
+        )
+        assert "newbucket" in stub.buckets
+
+    def test_partial_failure_skips_and_reports(self, stub, tmp_path):
+        files = self.make_files(tmp_path, ["ok.mkv"]) + [str(tmp_path / "missing.mkv")]
+        result = Uploader("b", client_for(stub)).upload_files(
+            CancelToken(), "m", files
+        )
+        assert len(result.uploaded) == 1 and len(result.failed) == 1
+
+    def test_total_failure_raises(self, stub, tmp_path):
+        with pytest.raises(UploadError):
+            Uploader("b", client_for(stub)).upload_files(
+                CancelToken(), "m", [str(tmp_path / "nope.mkv")]
+            )
+
+    def test_empty_batch_ok(self, stub):
+        result = Uploader("b", client_for(stub)).upload_files(CancelToken(), "m", [])
+        assert not result.uploaded and not result.failed
+
+    def test_object_key_format(self):
+        assert object_key("id1", "/x/y/movie.mkv") == "id1/original/bW92aWUubWt2"
+
+
+def test_signed_payload_opt_in(tmp_path):
+    with S3Stub(credentials=CREDS) as stub:
+        client = S3Client(stub.endpoint, CREDS)
+        client.make_bucket("b")
+        import io as _io
+
+        client.put_object("b", "k", _io.BytesIO(b"payload"), 7, sign_payload=True)
+        assert stub.buckets["b"]["k"] == b"payload"

@@ -1,0 +1,234 @@
+"""BitTorrent stack tests: bencode vectors/fuzz, magnet and metainfo
+parsing, and full hermetic swarm downloads (magnet via BEP 9 metadata
+exchange, .torrent via HTTP, single- and multi-file layouts)."""
+
+import hashlib
+import http.server
+import os
+import threading
+
+import pytest
+
+from downloader_tpu.fetch import TransferError
+from downloader_tpu.fetch.bencode import BencodeError, decode, encode
+from downloader_tpu.fetch.magnet import (
+    MagnetError,
+    parse_magnet,
+    parse_metainfo,
+)
+from downloader_tpu.fetch.peer import PieceStore, SwarmDownloader
+from downloader_tpu.fetch.seeder import Seeder, make_torrent
+from downloader_tpu.fetch.torrent import TorrentBackend
+from downloader_tpu.utils.cancel import CancelToken
+
+
+class TestBencode:
+    @pytest.mark.parametrize(
+        "value,encoded",
+        [
+            (42, b"i42e"),
+            (-7, b"i-7e"),
+            (0, b"i0e"),
+            (b"spam", b"4:spam"),
+            (b"", b"0:"),
+            ([b"a", 1], b"l1:ai1ee"),
+            ({b"b": 1, b"a": 2}, b"d1:ai2e1:bi1ee"),  # keys sorted
+            ({}, b"de"),
+        ],
+    )
+    def test_roundtrip_vectors(self, value, encoded):
+        assert encode(value) == encoded
+        assert decode(encoded) == value
+
+    def test_str_keys_encode_sorted(self):
+        assert encode({"z": 1, "a": 2}) == b"d1:ai2e1:zi1ee"
+
+    @pytest.mark.parametrize(
+        "bad",
+        [b"i03e", b"i-0e", b"ie", b"i1", b"5:abc", b"l", b"d1:a", b"x", b"",
+         b"i1ei2e", b"d1:ae", b"di1ei2ee", b"01:a"],
+    )
+    def test_malformed_rejected(self, bad):
+        with pytest.raises(BencodeError):
+            decode(bad)
+
+    def test_fuzz_no_crashes(self):
+        import os as _os
+
+        for _ in range(500):
+            raw = _os.urandom(30)
+            try:
+                decode(raw)
+            except BencodeError:
+                pass
+
+
+class TestMagnet:
+    def test_parse_hex_magnet(self):
+        info_hash = hashlib.sha1(b"x").hexdigest()
+        job = parse_magnet(
+            f"magnet:?xt=urn:btih:{info_hash}&dn=My+Show&tr=http%3A%2F%2Ft%2Fann"
+        )
+        assert job.info_hash.hex() == info_hash
+        assert job.display_name == "My Show"
+        assert job.trackers == ("http://t/ann",)
+
+    def test_parse_base32_magnet(self):
+        import base64
+
+        digest = hashlib.sha1(b"y").digest()
+        b32 = base64.b32encode(digest).decode()
+        assert parse_magnet(f"magnet:?xt=urn:btih:{b32}").info_hash == digest
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "http://not-magnet",
+            "magnet:?dn=no-xt",
+            "magnet:?xt=urn:btih:zz",
+            "magnet:?xt=urn:btih:" + "g" * 40,
+        ],
+    )
+    def test_bad_magnets(self, bad):
+        with pytest.raises(MagnetError):
+            parse_magnet(bad)
+
+    def test_parse_metainfo(self):
+        _, meta, _ = make_torrent("show", b"A" * 1000, trackers=("http://t/a",))
+        job = parse_metainfo(meta)
+        assert job.display_name == "show"
+        assert job.trackers == ("http://t/a",)
+        assert job.info is not None and len(job.info_hash) == 20
+
+    def test_metainfo_rejects_garbage(self):
+        with pytest.raises(MagnetError):
+            parse_metainfo(b"not bencoded")
+        with pytest.raises(MagnetError):
+            parse_metainfo(encode({b"no": b"info"}))
+
+
+class TestPieceStore:
+    def test_single_file_layout(self, tmp_path):
+        info, _, blob = make_torrent("movie.mkv", b"D" * 100_000, piece_length=16384)
+        store = PieceStore(info, str(tmp_path))
+        for i in range(store.num_pieces):
+            start = i * 16384
+            store.write_piece(i, blob[start : start + store.piece_size(i)])
+        assert (tmp_path / "movie.mkv").read_bytes() == blob
+
+    def test_multi_file_layout(self, tmp_path):
+        files = {"season 1/e1.mkv": b"E" * 40_000, "season 1/e2.mkv": b"F" * 24_000}
+        info, _, blob = make_torrent("show", files, piece_length=16384)
+        store = PieceStore(info, str(tmp_path))
+        for i in range(store.num_pieces):
+            start = i * 16384
+            store.write_piece(i, blob[start : start + store.piece_size(i)])
+        assert (tmp_path / "show/season 1/e1.mkv").read_bytes() == files["season 1/e1.mkv"]
+        assert (tmp_path / "show/season 1/e2.mkv").read_bytes() == files["season 1/e2.mkv"]
+
+    def test_corrupt_piece_rejected(self, tmp_path):
+        info, _, blob = make_torrent("m", b"G" * 1000)
+        store = PieceStore(info, str(tmp_path))
+        with pytest.raises(TransferError):
+            store.write_piece(0, b"wrong data" * 100)
+
+    def test_path_traversal_blocked(self, tmp_path):
+        info, _, _ = make_torrent("n", {"../../evil": b"x"})
+        store = PieceStore(info, str(tmp_path))
+        path, _ = store.files[0]
+        assert str(tmp_path) in path and ".." not in os.path.relpath(path, tmp_path)
+
+
+PAYLOAD = bytes(range(256)) * 600  # ~150 KiB, several 32 KiB pieces
+
+
+@pytest.fixture
+def seeder():
+    with Seeder("movie.mkv", PAYLOAD) as s:
+        yield s
+
+
+class TestSwarmDownload:
+    def test_magnet_download(self, seeder, tmp_path):
+        backend = TorrentBackend(progress_interval=0.01)
+        updates = []
+        backend.download(
+            CancelToken(), str(tmp_path), lambda u, p: updates.append(p), seeder.magnet_uri
+        )
+        assert (tmp_path / "movie.mkv").read_bytes() == PAYLOAD
+        assert updates[-1] == 100.0
+
+    def test_torrent_file_over_http(self, seeder, tmp_path):
+        # serve the .torrent metainfo over HTTP, then download via the
+        # extension-routed path the reference never implemented
+        _, meta, _ = make_torrent(
+            "movie.mkv", PAYLOAD, trackers=(seeder.tracker_url,)
+        )
+
+        class MetaHandler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(meta)))
+                self.end_headers()
+                self.wfile.write(meta)
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), MetaHandler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            url = f"http://127.0.0.1:{httpd.server_address[1]}/show.torrent"
+            TorrentBackend().download(CancelToken(), str(tmp_path), lambda u, p: None, url)
+            assert (tmp_path / "movie.mkv").read_bytes() == PAYLOAD
+        finally:
+            httpd.shutdown()
+
+    def test_multi_file_magnet(self, tmp_path):
+        files = {"season 1/e1.mkv": b"H" * 50_000, "notes.txt": b"I" * 100}
+        with Seeder("pack", files) as s:
+            TorrentBackend().download(
+                CancelToken(), str(tmp_path), lambda u, p: None, s.magnet_uri
+            )
+        assert (tmp_path / "pack/season 1/e1.mkv").read_bytes() == files["season 1/e1.mkv"]
+        assert (tmp_path / "pack/notes.txt").read_bytes() == files["notes.txt"]
+
+    def test_trackerless_magnet_fails_clearly(self, tmp_path):
+        magnet = f"magnet:?xt=urn:btih:{'0' * 40}"
+        with pytest.raises(TransferError) as excinfo:
+            TorrentBackend().download(
+                CancelToken(), str(tmp_path), lambda u, p: None, magnet
+            )
+        assert "DHT" in str(excinfo.value) or "tracker" in str(excinfo.value)
+
+    def test_dead_tracker_fails_clearly(self, tmp_path):
+        magnet = f"magnet:?xt=urn:btih:{'1' * 40}&tr=http://127.0.0.1:9/ann"
+        with pytest.raises(TransferError):
+            TorrentBackend().download(
+                CancelToken(), str(tmp_path), lambda u, p: None, magnet
+            )
+
+    def test_cancellation(self, seeder, tmp_path):
+        token = CancelToken()
+        token.cancel()
+        downloader = SwarmDownloader(
+            parse_magnet(seeder.magnet_uri), str(tmp_path)
+        )
+        from downloader_tpu.utils.cancel import Cancelled
+
+        with pytest.raises((Cancelled, TransferError)):
+            downloader.run(token, lambda p: None)
+
+
+class TestBencodeEdge:
+    @pytest.mark.parametrize("bad", [b"i1x2e", b"i--1e", b"3x:ab", b"1Z:a"])
+    def test_nondigit_rejected(self, bad):
+        with pytest.raises(BencodeError):
+            decode(bad)
+
+
+def test_deep_nesting_raises_bencode_error_not_recursion():
+    with pytest.raises(BencodeError):
+        decode(b"l" * 2000)
+    with pytest.raises(BencodeError):
+        decode(b"l" * 2000 + b"e" * 2000)
