@@ -1,0 +1,264 @@
+"""Daemon composition root: the consume → download → scan → upload →
+publish → ack loop.
+
+Rebuild of ``cmd/downloader/downloader.go``. The pipeline per message
+matches the reference (cmd:103-155): unmarshal ``Download``, fetch via the
+dispatcher, scan for media, upload, publish ``Convert`` (created_at +
+media, cmd:136-139), ack. Differences, all deliberate:
+
+- **N-way job concurrency** — worker threads consume the multiplexed
+  delivery stream; the reference hardwires one goroutine (its own TODO,
+  cmd:100-101).
+- **No starved consumer.** The reference ``continue``s on mid-pipeline
+  failure without ack/nack, leaving the message unacked and the
+  prefetch-1 consumer blocked until reconnect (cmd:119-149, SURVEY.md
+  §3.2). Here every outcome settles the delivery: malformed protobuf or
+  missing media → ``nack`` (dropped, as cmd:108 does), transient
+  failures → ``delivery.error()`` retry with X-Retries until
+  ``max_job_retries`` then nack, unsupported jobs → nack immediately.
+- **Graceful shutdown that finishes work**: on SIGINT/SIGTERM/SIGHUP the
+  workers stop taking new deliveries, finish and ack in-flight jobs, and
+  the queue client drains (the reference kills workers mid-job and relies
+  on redelivery).
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..fetch import DispatchClient, TransferError, UnsupportedJobError
+from ..queue import QueueClient
+from ..queue.delivery import Delivery
+from ..scan import scan_dir
+from ..store import Uploader, UploadError
+from ..utils import configure_from_env, get_logger
+from ..utils.cancel import Cancelled, CancelToken
+from ..wire import Convert, Download, WireError
+from .config import Config
+
+log = get_logger("daemon")
+
+
+@dataclass
+class DaemonStats:
+    processed: int = 0
+    failed: int = 0
+    retried: int = 0
+    dropped: int = 0
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def bump(self, **deltas: int) -> None:
+        with self.lock:
+            for name, delta in deltas.items():
+                setattr(self, name, getattr(self, name) + delta)
+
+
+class Daemon:
+    def __init__(
+        self,
+        token: CancelToken,
+        client: QueueClient,
+        dispatcher: DispatchClient,
+        uploader: Uploader,
+        config: Config,
+    ):
+        self._token = token
+        self._client = client
+        self._dispatcher = dispatcher
+        self._uploader = uploader
+        self._config = config
+        self.stats = DaemonStats()
+        self._workers: list[threading.Thread] = []
+
+    # -- job pipeline ----------------------------------------------------
+
+    def process_delivery(self, delivery: Delivery) -> None:
+        try:
+            job = Download.unmarshal(delivery.body)
+        except WireError as exc:
+            log.with_field("event", "decode-message").error(
+                "failed to unmarshal message into protobuf format", exc=exc
+            )
+            delivery.nack()  # reference cmd:108: drop malformed
+            self.stats.bump(dropped=1)
+            return
+
+        if job.media is None or not job.media.id or not job.media.source_uri:
+            log.error("download job has no usable media block; dropping")
+            delivery.nack()
+            self.stats.bump(dropped=1)
+            return
+
+        media = job.media
+        job_log = log.with_fields(id=media.id, url=media.source_uri)
+        job_log.info("got message")
+
+        if delivery.retries > 0:
+            # pace retried jobs (the reference slept 10 s on the worker
+            # before republishing, delivery.go:75; we delay on consume so
+            # the broker, not a timer, owns the in-flight message)
+            if self._token.wait(self._config.retry_delay):
+                delivery.nack(requeue=True)  # shutting down; give it back
+                return
+
+        try:
+            job_dir = self._dispatcher.download(media.id, media.source_uri)
+            files = scan_dir(job_dir)
+            job_log.with_field("count", len(files)).info("found media files")
+            self._uploader.upload_files(self._token, media.id, files)
+        except UnsupportedJobError as exc:
+            job_log.error("unsupported job; dropping", exc=exc)
+            delivery.nack()
+            self.stats.bump(dropped=1)
+            return
+        except (TransferError, UploadError, OSError) as exc:
+            if delivery.retries < self._config.max_job_retries:
+                job_log.with_field("retries", delivery.retries).error(
+                    "job failed; scheduling retry", exc=exc
+                )
+                delivery.error()
+                self.stats.bump(retried=1)
+            else:
+                job_log.error(
+                    f"job failed after {delivery.retries} retries; dropping",
+                    exc=exc,
+                )
+                delivery.nack()
+                self.stats.bump(failed=1)
+            return
+        except Cancelled:
+            # shutdown mid-job: requeue so another instance picks it up
+            delivery.nack(requeue=True)
+            return
+
+        log.info("creating v1.convert message")
+        convert = Convert(
+            created_at=time.strftime("%Y-%m-%d %H:%M:%S %z"), media=media
+        )
+        self._client.publish(self._config.publish_topic, convert.marshal())
+        job_log.info("finished processing")
+        delivery.ack()
+        self.stats.bump(processed=1)
+
+    # -- worker loop -----------------------------------------------------
+
+    def _worker(self, deliveries: "queue_mod.Queue[Delivery]") -> None:
+        while not self._token.cancelled():
+            try:
+                delivery = deliveries.get(timeout=0.2)
+            except queue_mod.Empty:
+                continue
+            try:
+                self.process_delivery(delivery)
+            except Exception as exc:  # never kill the worker thread
+                log.error("unexpected error processing job", exc=exc)
+                if not delivery.settled:
+                    # cap like the normal failure path, or a poison message
+                    # that crashes outside the caught exceptions would
+                    # retry forever
+                    if delivery.retries < self._config.max_job_retries:
+                        delivery.error()
+                        self.stats.bump(retried=1)
+                    else:
+                        delivery.nack()
+                        self.stats.bump(failed=1)
+
+    def run(self) -> None:
+        """Start consuming; returns once cancellation completes drain."""
+        deliveries = self._client.consume(self._config.consume_topic)
+        for index in range(max(1, self._config.concurrency)):
+            worker = threading.Thread(
+                target=self._worker,
+                args=(deliveries,),
+                name=f"job-worker-{index}",
+                daemon=True,
+            )
+            worker.start()
+            self._workers.append(worker)
+        log.with_field("workers", len(self._workers)).info("job loop running")
+
+        self._token.wait()  # block until cancelled
+        for worker in self._workers:
+            worker.join()
+        # deliveries still sitting in the sink were never picked up by a
+        # worker; hand them straight back so the client's drain doesn't
+        # wait out its timeout on messages nobody will process
+        while True:
+            try:
+                leftover = deliveries.get_nowait()
+            except queue_mod.Empty:
+                break
+            leftover.nack(requeue=True)
+        self._client.done()
+        log.info("finished shutdown")
+
+
+# ---------------------------------------------------------------------------
+# wiring
+
+
+def build_connection_factory(config: Config):
+    if config.broker == "memory":
+        from ..queue.memory import MemoryBroker
+
+        broker = MemoryBroker()
+        return broker.connect
+    if config.broker == "amqp":
+        from ..queue.amqp import AmqpConnection
+
+        def connect():
+            return AmqpConnection.dial(
+                config.amqp_endpoint,
+                username=config.amqp_username,
+                password=config.amqp_password,
+            )
+
+        return connect
+    raise ValueError(f"unknown BROKER '{config.broker}'")
+
+
+def serve(
+    base_dir: str | None = None,
+    bucket: str | None = None,
+    concurrency: int | None = None,
+    config: Config | None = None,
+    token: CancelToken | None = None,
+    install_signal_handlers: bool = True,
+) -> int:
+    """Run the full daemon until SIGINT/SIGTERM/SIGHUP (reference
+    cmd:158-170)."""
+    configure_from_env()
+    config = config or Config.from_env()
+    if base_dir:
+        config.base_dir = base_dir
+    if bucket:
+        config.bucket = bucket
+    if concurrency:
+        config.concurrency = concurrency
+
+    token = token or CancelToken()
+    if install_signal_handlers:
+        def handle(signum, frame):
+            log.info("shutting down")
+            token.cancel()
+
+        for signum in (signal.SIGINT, signal.SIGTERM, signal.SIGHUP):
+            signal.signal(signum, handle)
+
+    log.info("connecting to broker ...")
+    client = QueueClient(token, build_connection_factory(config))
+    client.set_prefetch(config.prefetch)
+    log.info("connected")
+
+    from ..cli import _default_backends
+
+    dispatcher = DispatchClient(token, config.base_dir, _default_backends())
+    uploader = Uploader.from_env(config.bucket)
+
+    daemon = Daemon(token, client, dispatcher, uploader, config)
+    daemon.run()
+    return 0

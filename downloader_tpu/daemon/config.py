@@ -1,0 +1,75 @@
+"""Daemon configuration from environment variables.
+
+Mirrors the reference's env contract (SURVEY.md §5 "Config"):
+
+- ``RABBITMQ_ENDPOINT`` default ``127.0.0.1:5672`` with a warning
+  (cmd/downloader/downloader.go:54-58); ``RABBITMQ_USERNAME`` /
+  ``RABBITMQ_PASSWORD`` (client.go:308),
+- ``LOG_LEVEL`` / ``LOG_FORMAT`` handled by utils.logging,
+- S3 config handled by store.credentials / store.uploader,
+- hardcoded-in-the-reference values surfaced as env with the reference
+  values as defaults: topics ``v1.download``/``v1.convert`` (cmd:68,147),
+  bucket ``triton-staging`` (cmd:95), prefetch 1 (cmd:62), download dir
+  ``./downloading`` (cmd:86).
+
+Additions over the reference: ``BROKER`` selects the transport (``amqp``
+or ``memory`` for hermetic/standalone runs) and ``JOB_CONCURRENCY`` lifts
+the hardwired single job goroutine (reference TODO cmd:100-101).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Mapping
+
+from ..utils import get_logger
+
+log = get_logger("daemon")
+
+
+@dataclass
+class Config:
+    broker: str = "amqp"
+    amqp_endpoint: str = "127.0.0.1:5672"
+    amqp_username: str = ""
+    amqp_password: str = ""
+    consume_topic: str = "v1.download"
+    publish_topic: str = "v1.convert"
+    bucket: str = "triton-staging"
+    base_dir: str = field(
+        default_factory=lambda: os.path.join(os.getcwd(), "downloading")
+    )
+    prefetch: int = 1
+    concurrency: int = 1
+    max_job_retries: int = 3
+    retry_delay: float = 10.0  # reference delivery.go:75
+    health_port: int = 0  # 0 = disabled
+
+    @classmethod
+    def from_env(cls, environ: Mapping[str, str] | None = None) -> "Config":
+        env = os.environ if environ is None else environ
+        config = cls()
+        config.broker = env.get("BROKER", config.broker).lower()
+        endpoint = env.get("RABBITMQ_ENDPOINT", "")
+        if endpoint:
+            config.amqp_endpoint = endpoint
+        elif config.broker == "amqp":
+            log.warning(
+                "RABBITMQ_ENDPOINT not defined, defaulting to local config: "
+                f"{config.amqp_endpoint}"
+            )
+        config.amqp_username = env.get("RABBITMQ_USERNAME", "")
+        config.amqp_password = env.get("RABBITMQ_PASSWORD", "")
+        config.consume_topic = env.get("CONSUME_TOPIC", config.consume_topic)
+        config.publish_topic = env.get("PUBLISH_TOPIC", config.publish_topic)
+        config.bucket = env.get("BUCKET", config.bucket)
+        config.base_dir = env.get("DOWNLOAD_DIR", config.base_dir)
+        config.prefetch = int(env.get("PREFETCH", config.prefetch))
+        config.concurrency = int(env.get("JOB_CONCURRENCY", config.concurrency))
+        config.max_job_retries = int(
+            env.get("MAX_JOB_RETRIES", config.max_job_retries)
+        )
+        config.retry_delay = float(env.get("RETRY_DELAY", config.retry_delay))
+        config.health_port = int(env.get("HEALTH_PORT", config.health_port))
+        return config

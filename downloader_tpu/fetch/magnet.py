@@ -64,11 +64,29 @@ def parse_magnet(uri: str) -> TorrentJob:
     )
 
 
+def _raw_info_span(data: bytes) -> bytes:
+    """Return the exact byte span of the top-level ``info`` value. The
+    info-hash must be computed over the bytes as they appear in the file —
+    re-encoding would silently canonicalize (e.g. re-sort missorted dict
+    keys) and produce a hash no peer or tracker recognizes."""
+    if not data.startswith(b"d"):
+        raise MagnetError(".torrent file is not a bencoded dict")
+    pos = 1
+    while pos < len(data) and data[pos : pos + 1] != b"e":
+        key, pos = bencode._decode(data, pos)
+        start = pos
+        _, pos = bencode._decode(data, pos)
+        if key == b"info":
+            return data[start:pos]
+    raise MagnetError(".torrent file has no info dict")
+
+
 def parse_metainfo(data: bytes) -> TorrentJob:
     """Parse a .torrent file; the info-hash is the SHA-1 of the bencoded
     info dict exactly as it appeared in the file (BEP 3)."""
     try:
         meta = bencode.decode(data)
+        raw_info = _raw_info_span(data)
     except bencode.BencodeError as exc:
         raise MagnetError(f"invalid .torrent file: {exc}") from exc
     if not isinstance(meta, dict) or b"info" not in meta:
@@ -77,7 +95,7 @@ def parse_metainfo(data: bytes) -> TorrentJob:
     if not isinstance(info, dict):
         raise MagnetError(".torrent info is not a dict")
 
-    info_hash = hashlib.sha1(bencode.encode(info)).digest()
+    info_hash = hashlib.sha1(raw_info).digest()
 
     trackers: list[str] = []
     announce = meta.get(b"announce")

@@ -1,0 +1,346 @@
+"""In-process AMQP 0-9-1 server stub for integration tests and demos.
+
+Speaks the same protocol slice as the client (amqp.py) over real TCP
+sockets and bridges every operation onto a MemoryBroker, so the full
+QueueClient → AmqpConnection → TCP → server → broker path is testable
+hermetically — including outage simulation (``drop_clients``) and PLAIN
+auth verification. The reference has no integration test against its
+broker at all (SURVEY.md §4: "multi-node behavior ... is untested").
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+
+from ..utils import get_logger
+from . import amqp_wire as wire
+from .broker import BrokerError, Message
+from .memory import MemoryBroker
+
+log = get_logger("queue.amqp_server")
+
+
+class AmqpServerStub:
+    def __init__(
+        self,
+        broker: MemoryBroker | None = None,
+        username: str = "",
+        password: str = "",
+    ):
+        self.broker = broker or MemoryBroker()
+        self.username = username
+        self.password = password
+        self.connections_accepted = 0
+        stub = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    _ClientSession(stub, self.request).run()
+                except (wire.AmqpWireError, OSError, struct.error):
+                    pass
+
+        self._server = socketserver.ThreadingTCPServer(("127.0.0.1", 0), Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._sessions: list[_ClientSession] = []
+        self._lock = threading.Lock()
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self) -> "AmqpServerStub":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.drop_clients()
+        self._server.shutdown()
+        self._server.server_close()
+
+    def drop_clients(self) -> None:
+        """Kill all client connections (simulated broker restart);
+        unacked messages requeue via the memory broker."""
+        with self._lock:
+            sessions, self._sessions = list(self._sessions), []
+        for session in sessions:
+            session.kill()
+
+    def __enter__(self) -> "AmqpServerStub":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _register(self, session: "_ClientSession") -> None:
+        with self._lock:
+            self._sessions.append(session)
+            self.connections_accepted += 1
+
+
+class _ClientSession:
+    def __init__(self, stub: AmqpServerStub, sock: socket.socket):
+        self._stub = stub
+        self._sock = sock
+        self._write_lock = threading.Lock()
+        self._mem = stub.broker.connect()
+        self._channels: dict[int, object] = {}  # number -> MemoryChannel
+        self._consumer_tags = 0
+        self._alive = True
+
+    # -- plumbing --------------------------------------------------------
+
+    def _send_method(self, channel: int, method: tuple[int, int], args: bytes):
+        with self._write_lock:
+            wire.write_method(self._sock, channel, method, args)
+
+    def kill(self) -> None:
+        self._alive = False
+        try:
+            # shutdown (not just close) so threads blocked in recv on either
+            # side wake up with EOF immediately
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._mem.close()
+
+    # -- handshake + main loop -------------------------------------------
+
+    def run(self) -> None:
+        header = self._recv_exact(8)
+        if header != wire.PROTOCOL_HEADER:
+            self._sock.sendall(wire.PROTOCOL_HEADER)  # version rejection
+            return
+        start = (
+            wire.Writer()
+            .octet(0)
+            .octet(9)
+            .table({"product": "downloader_tpu-stub"})
+            .longstr(b"PLAIN")
+            .longstr(b"en_US")
+            .done()
+        )
+        self._send_method(0, wire.CONNECTION_START, start)
+
+        method, reader = self._read_method()
+        if method != wire.CONNECTION_START_OK:
+            return
+        reader.table()
+        mechanism = reader.shortstr()
+        response = reader.longstr()
+        if self._stub.username:
+            parts = response.split(b"\x00")
+            if (
+                mechanism != "PLAIN"
+                or len(parts) != 3
+                or parts[1].decode() != self._stub.username
+                or parts[2].decode() != self._stub.password
+            ):
+                close = (
+                    wire.Writer()
+                    .short(403)
+                    .shortstr("ACCESS_REFUSED - bad credentials")
+                    .short(0)
+                    .short(0)
+                    .done()
+                )
+                self._send_method(0, wire.CONNECTION_CLOSE, close)
+                return
+
+        tune = wire.Writer().short(2047).long(131072).short(0).done()
+        self._send_method(0, wire.CONNECTION_TUNE, tune)
+        method, _ = self._read_method()
+        if method != wire.CONNECTION_TUNE_OK:
+            return
+        method, _ = self._read_method()
+        if method != wire.CONNECTION_OPEN:
+            return
+        self._send_method(0, wire.CONNECTION_OPEN_OK, wire.Writer().shortstr("").done())
+
+        self._stub._register(self)
+        try:
+            self._loop()
+        finally:
+            self._mem.close()
+
+    def _recv_exact(self, count: int) -> bytes:
+        data = bytearray()
+        while len(data) < count:
+            chunk = self._sock.recv(count - len(data))
+            if not chunk:
+                raise OSError("client disconnected")
+            data += chunk
+        return bytes(data)
+
+    def _read_method(self):
+        while True:
+            frame_type, channel, payload = wire.read_frame(self._sock)
+            if frame_type == wire.FRAME_HEARTBEAT:
+                continue
+            if frame_type == wire.FRAME_METHOD:
+                return wire.parse_method(payload)
+
+    def _loop(self) -> None:
+        pending_publish = None  # (channel_num, exchange, rk, body_size, props, chunks)
+        while self._alive:
+            frame_type, channel_num, payload = wire.read_frame(self._sock)
+            if frame_type == wire.FRAME_HEARTBEAT:
+                continue
+            if frame_type == wire.FRAME_HEADER and pending_publish:
+                body_size, props = wire.decode_content_header(payload)
+                pending_publish[3] = body_size
+                pending_publish[4] = props
+                if body_size == 0:
+                    self._finish_publish(pending_publish)
+                    pending_publish = None
+                continue
+            if frame_type == wire.FRAME_BODY and pending_publish:
+                pending_publish[5].append(payload)
+                if sum(len(c) for c in pending_publish[5]) >= pending_publish[3]:
+                    self._finish_publish(pending_publish)
+                    pending_publish = None
+                continue
+            if frame_type != wire.FRAME_METHOD:
+                continue
+            method, reader = wire.parse_method(payload)
+
+            if method == wire.CONNECTION_CLOSE:
+                self._send_method(0, wire.CONNECTION_CLOSE_OK, b"")
+                return
+            if method == wire.CHANNEL_OPEN:
+                self._channels[channel_num] = self._mem.channel()
+                self._send_method(
+                    channel_num, wire.CHANNEL_OPEN_OK, wire.Writer().longstr(b"").done()
+                )
+                continue
+
+            channel = self._channels.get(channel_num)
+            if channel is None:
+                continue
+
+            if method == wire.CHANNEL_CLOSE:
+                channel.close()
+                self._send_method(channel_num, wire.CHANNEL_CLOSE_OK, b"")
+            elif method == wire.EXCHANGE_DECLARE:
+                reader.short()
+                name = reader.shortstr()
+                channel.declare_exchange(name)
+                self._send_method(channel_num, wire.EXCHANGE_DECLARE_OK, b"")
+            elif method == wire.QUEUE_DECLARE:
+                reader.short()
+                name = reader.shortstr()
+                channel.declare_queue(name)
+                ok = wire.Writer().shortstr(name).long(0).long(0).done()
+                self._send_method(channel_num, wire.QUEUE_DECLARE_OK, ok)
+            elif method == wire.QUEUE_BIND:
+                reader.short()
+                queue = reader.shortstr()
+                exchange = reader.shortstr()
+                routing_key = reader.shortstr()
+                try:
+                    channel.bind_queue(queue, exchange, routing_key)
+                except BrokerError as exc:
+                    self._close_channel_with_error(channel_num, 404, str(exc))
+                    continue
+                self._send_method(channel_num, wire.QUEUE_BIND_OK, b"")
+            elif method == wire.BASIC_QOS:
+                reader.long()
+                channel.set_prefetch(reader.short())
+                self._send_method(channel_num, wire.BASIC_QOS_OK, b"")
+            elif method == wire.BASIC_CONSUME:
+                reader.short()
+                queue = reader.shortstr()
+                requested_tag = reader.shortstr()
+                self._consumer_tags += 1
+                tag = requested_tag or f"stub-ctag-{self._consumer_tags}"
+                try:
+                    channel.consume(
+                        queue,
+                        lambda message, t=tag, cn=channel_num: self._deliver(
+                            cn, t, message
+                        ),
+                    )
+                except BrokerError as exc:
+                    self._close_channel_with_error(channel_num, 404, str(exc))
+                    continue
+                ok = wire.Writer().shortstr(tag).done()
+                self._send_method(channel_num, wire.BASIC_CONSUME_OK, ok)
+            elif method == wire.BASIC_PUBLISH:
+                reader.short()
+                exchange = reader.shortstr()
+                routing_key = reader.shortstr()
+                pending_publish = [channel_num, exchange, routing_key, 0, {}, []]
+            elif method == wire.BASIC_ACK:
+                tag = reader.longlong()
+                channel.ack(tag)
+            elif method == wire.BASIC_NACK:
+                tag = reader.longlong()
+                reader.bit()  # multiple
+                requeue = reader.bit()
+                channel.nack(tag, requeue=requeue)
+
+    def _finish_publish(self, pending) -> None:
+        channel_num, exchange, routing_key, _, props, chunks = pending
+        channel = self._channels.get(channel_num)
+        if channel is None:
+            return
+        try:
+            channel.publish(
+                exchange,
+                routing_key,
+                b"".join(chunks),
+                headers=props.get("headers", {}),
+            )
+        except BrokerError as exc:
+            self._close_channel_with_error(channel_num, 404, str(exc))
+
+    def _close_channel_with_error(self, channel_num: int, code: int, text: str):
+        args = (
+            wire.Writer().short(code).shortstr(text[:250]).short(0).short(0).done()
+        )
+        self._send_method(channel_num, wire.CHANNEL_CLOSE, args)
+        channel = self._channels.pop(channel_num, None)
+        if channel is not None:
+            channel.close()
+
+    def _deliver(self, channel_num: int, consumer_tag: str, message: Message) -> None:
+        if not self._alive:
+            return
+        args = (
+            wire.Writer()
+            .shortstr(consumer_tag)
+            .longlong(message.delivery_tag)
+            .bit(message.redelivered)
+            .shortstr(message.exchange)
+            .shortstr(message.routing_key)
+            .done()
+        )
+        header = wire.encode_content_header(
+            len(message.body), headers=message.headers or None
+        )
+        try:
+            with self._write_lock:
+                wire.write_method(self._sock, channel_num, wire.BASIC_DELIVER, args)
+                wire.write_frame(self._sock, wire.FRAME_HEADER, channel_num, header)
+                for start in range(0, len(message.body), 65536):
+                    wire.write_frame(
+                        self._sock,
+                        wire.FRAME_BODY,
+                        channel_num,
+                        message.body[start : start + 65536],
+                    )
+                if not message.body:
+                    pass
+        except OSError:
+            self.kill()

@@ -1,0 +1,79 @@
+"""Broker abstraction for the queue transport.
+
+The reference talks AMQP 0-9-1 through streadway/amqp directly
+(internal/rabbitmq/client.go). This rebuild splits the same behavior into
+two layers: a small connection-level interface (this module) with two
+implementations — a real AMQP 0-9-1 wire client (amqp.py) and an in-memory
+broker (memory.py) for hermetic tests, standalone mode, and benchmarks —
+and the reference-semantics client on top (client.py): sharded queues,
+round-robin publish, supervisor, reconnect, drain.
+
+The interface mirrors the slice of AMQP the reference uses: durable direct
+exchanges (client.go:333), durable queue declare + bind (client.go:344-353),
+qos/prefetch (client.go:367), publish with persistent delivery mode
+(client.go:224, Publish :386-398), consume with explicit ack/nack
+(delivery.go:55-63), and connection liveness checks (client.go:169).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Protocol
+
+
+class BrokerError(Exception):
+    """Connection-level failure; the supervisor reacts by reconnecting."""
+
+
+@dataclass
+class Message:
+    """A delivered message, with enough identity to ack/nack it."""
+
+    body: bytes
+    delivery_tag: int
+    exchange: str = ""
+    routing_key: str = ""
+    headers: dict = field(default_factory=dict)
+    redelivered: bool = False
+
+
+class Channel(Protocol):
+    """One multiplexed unit of work on a connection (AMQP channel)."""
+
+    def declare_exchange(self, name: str) -> None: ...
+
+    def declare_queue(self, name: str) -> None: ...
+
+    def bind_queue(self, queue: str, exchange: str, routing_key: str) -> None: ...
+
+    def set_prefetch(self, count: int) -> None: ...
+
+    def publish(
+        self,
+        exchange: str,
+        routing_key: str,
+        body: bytes,
+        headers: dict | None = None,
+        persistent: bool = True,
+    ) -> None: ...
+
+    def consume(self, queue: str, on_message: Callable[[Message], None]) -> str: ...
+
+    def ack(self, delivery_tag: int) -> None: ...
+
+    def nack(self, delivery_tag: int, requeue: bool) -> None: ...
+
+    def close(self) -> None: ...
+
+
+class Connection(Protocol):
+    """A broker connection; channels are cheap, connections are supervised."""
+
+    def channel(self) -> Channel: ...
+
+    def is_closed(self) -> bool: ...
+
+    def close(self) -> None: ...
+
+
+ConnectionFactory = Callable[[], Connection]

@@ -1,0 +1,441 @@
+"""Self-healing queue client: sharded queues, supervised workers,
+round-robin publishing, reconnect with backoff, graceful drain.
+
+Rebuild of the reference's ``internal/rabbitmq/client.go``. Kept semantics
+(citations into /root/reference):
+
+- N durable queues per topic named ``<topic>-<i>`` bound to a durable
+  direct exchange ``<topic>`` with rk == queue name (client.go:326-357),
+  numConsumerQueues defaulting to 2 (client.go:108).
+- ``consume(topic)`` declares the topology and multiplexes all shard
+  consumers into one stream (client.go:405-421).
+- Publishes round-robin across the shard routing keys via a dedicated
+  publisher thread fed by an internal buffer (client.go:189-237, 386-398).
+- A supervisor ticks every second: recreates dead shard consumers and the
+  publisher, and when the connection is closed tears down workers and
+  reconnects with exponential backoff (client.go:116-184, 303-322).
+- ``done()`` blocks until in-flight work drains and the connection closes
+  after cancellation (client.go:400-402, 119-138).
+
+Reference defects deliberately designed out (SURVEY.md §7 step 6):
+
+- publish retry uses real exponential backoff with jitter, not the
+  ``backoff ^ 2`` XOR oscillation bug (client.go:226),
+- no dead error channel (client.go:421): consumer-level failures are
+  logged and surfaced via ``stats()``,
+- prefetch can be set any time before ``consume`` without ordering traps
+  (the reference nil-derefs if NewClient failed, cmd:62-63),
+- drain waits for unsettled deliveries, so jobs finishing during shutdown
+  still ack on a live channel rather than being redelivered.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..utils import get_logger
+from ..utils.cancel import CancelToken
+from .broker import BrokerError, Channel, Connection, ConnectionFactory, Message
+from .delivery import Delivery
+
+log = get_logger("queue")
+
+DEFAULT_CONSUMER_QUEUES = 2  # reference client.go:108
+SUPERVISOR_INTERVAL = 1.0  # reference client.go:113
+DEFAULT_PREFETCH = 10  # reference client.go:107
+
+
+@dataclass
+class _PendingPublish:
+    topic: str
+    body: bytes
+    headers: dict
+    attempts: int = 0
+    not_before: float = 0.0
+
+
+@dataclass
+class _Shard:
+    queue_name: str
+    sink: "queue_mod.Queue[Delivery]"
+    channel: Channel | None = None
+
+    def alive(self) -> bool:
+        channel = self.channel
+        return channel is not None and not getattr(channel, "closed", False)
+
+
+@dataclass
+class ClientStats:
+    published: int = 0
+    delivered: int = 0
+    publish_retries: int = 0
+    reconnects: int = 0
+    consumer_errors: int = 0
+
+
+class QueueClient:
+    def __init__(
+        self,
+        token: CancelToken,
+        connect: ConnectionFactory,
+        num_consumer_queues: int = DEFAULT_CONSUMER_QUEUES,
+        supervisor_interval: float = SUPERVISOR_INTERVAL,
+        max_connect_backoff: float = 30.0,
+        publish_backoff_base: float = 0.1,
+        publish_backoff_cap: float = 5.0,
+        drain_timeout: float = 60.0,
+    ):
+        self._token = token
+        self._connect = connect
+        self._num_queues = num_consumer_queues
+        self._interval = supervisor_interval
+        self._max_connect_backoff = max_connect_backoff
+        self._publish_backoff_base = publish_backoff_base
+        self._publish_backoff_cap = publish_backoff_cap
+        self._drain_timeout = drain_timeout
+
+        self._lock = threading.RLock()
+        self._prefetch = DEFAULT_PREFETCH
+        self._connection: Connection | None = None
+        self._shards: dict[str, _Shard] = {}  # queue_name -> shard
+        self._publish_buffer: "queue_mod.Queue[_PendingPublish]" = queue_mod.Queue()
+        self._publish_rk: dict[str, int] = {}
+        self._ensured_topics: set[str] = set()  # reset on reconnect
+        self._publisher_alive = False
+        self._publisher_channel: Channel | None = None
+        self._unsettled = 0
+        self._publishes_pending = 0  # buffered but not yet on the broker
+        self._reconcile_lock = threading.Lock()
+        self._done = threading.Event()
+        self.stats = ClientStats()
+
+        self._create_connection()  # blocks with backoff, like NewClient
+        self._supervisor = threading.Thread(
+            target=self._supervise, name="queue-supervisor", daemon=True
+        )
+        self._supervisor.start()
+
+    # -- connection ------------------------------------------------------
+
+    def _create_connection(self) -> None:
+        backoff = 0.5
+        while True:
+            self._token.raise_if_cancelled()
+            try:
+                self._connection = self._connect()
+                return
+            except (BrokerError, OSError) as exc:
+                log.error(f"failed to dial broker: {exc}")
+                if self._token.wait(backoff + random.uniform(0, backoff / 2)):
+                    self._token.raise_if_cancelled()
+                backoff = min(backoff * 2, self._max_connect_backoff)
+
+    def _channel(self) -> Channel:
+        with self._lock:
+            if self._connection is None or self._connection.is_closed():
+                raise BrokerError("connection is closed")
+            channel = self._connection.channel()
+        channel.set_prefetch(self._prefetch)
+        return channel
+
+    # -- public API ------------------------------------------------------
+
+    def set_prefetch(self, prefetch: int) -> None:
+        self._prefetch = prefetch
+
+    @staticmethod
+    def shard_name(topic: str, index: int) -> str:
+        return f"{topic}-{index}"  # reference getRk, client.go:376-378
+
+    def consume(self, topic: str) -> "queue_mod.Queue[Delivery]":
+        """Declare the sharded topology for ``topic`` and return the
+        multiplexed delivery stream; shard consumers are created (and
+        recreated after failures) by the supervisor."""
+        channel = self._channel()
+        try:
+            channel.declare_exchange(topic)
+            for i in range(self._num_queues):
+                name = self.shard_name(topic, i)
+                channel.declare_queue(name)
+                channel.bind_queue(name, topic, name)
+        finally:
+            channel.close()
+
+        sink: "queue_mod.Queue[Delivery]" = queue_mod.Queue()
+        with self._lock:
+            for i in range(self._num_queues):
+                name = self.shard_name(topic, i)
+                self._shards[name] = _Shard(queue_name=name, sink=sink)
+        self._reconcile()  # start consumers now, not at the next tick
+        return sink
+
+    def publish(self, topic: str, body: bytes, headers: dict | None = None) -> None:
+        """Enqueue for the publisher thread; survives broker outages by
+        retrying with exponential backoff, and is drained (not dropped) at
+        shutdown before done() completes."""
+        with self._lock:
+            self._publishes_pending += 1
+        self._publish_buffer.put(
+            _PendingPublish(topic=topic, body=body, headers=headers or {})
+        )
+
+    def done(self, poll_interval: float | None = None) -> None:
+        """Block until, after cancellation, in-flight deliveries settle and
+        the connection is closed (reference Done, client.go:400-402)."""
+        self._done.wait()
+
+    # -- delivery accounting ---------------------------------------------
+
+    def _on_delivery(self, shard: _Shard, channel: Channel, message: Message) -> None:
+        # bind to the channel the message arrived on: if the shard has
+        # reconnected since, settling on the stale channel must fail softly
+        # (the broker already requeued it), never touch the new channel
+        with self._lock:
+            self._unsettled += 1
+            self.stats.delivered += 1
+        delivery = Delivery(
+            message,
+            channel,
+            on_settled=self._on_settled,
+            # error() retries route through the buffered publisher so they
+            # survive outages and are drained at shutdown
+            publisher=self.publish,
+        )
+        shard.sink.put(delivery)
+
+    def _on_settled(self, delivery: Delivery) -> None:
+        with self._lock:
+            self._unsettled -= 1
+
+    # -- supervisor ------------------------------------------------------
+
+    def _reconcile(self) -> None:
+        # serialized: consume() and the supervisor may call this
+        # concurrently, and two racing alive-checks would create duplicate
+        # consumers on the same shard
+        with self._reconcile_lock:
+            self._reconcile_locked()
+
+    def _reconcile_locked(self) -> None:
+        with self._lock:
+            shards = list(self._shards.values())
+        for shard in shards:
+            if shard.alive():
+                continue
+            try:
+                channel = self._channel()
+                channel.consume(
+                    shard.queue_name,
+                    lambda message, s=shard, ch=channel: self._on_delivery(
+                        s, ch, message
+                    ),
+                )
+                shard.channel = channel
+                log.info(f"worker on queue '{shard.queue_name}' started")
+            except BrokerError as exc:
+                self.stats.consumer_errors += 1
+                log.error(f"failed to create worker '{shard.queue_name}': {exc}")
+
+        with self._lock:
+            need_publisher = not self._publisher_alive
+        if need_publisher:
+            try:
+                channel = self._channel()
+            except BrokerError as exc:
+                log.error(f"failed to create publisher channel: {exc}")
+                return
+            with self._lock:
+                self._publisher_channel = channel
+                self._publisher_alive = True
+            threading.Thread(
+                target=self._publish_loop, name="queue-publisher", daemon=True
+            ).start()
+            log.info("publisher created")
+
+    def _supervise(self) -> None:
+        while True:
+            if self._token.wait(self._interval):
+                self._drain_and_close()
+                return
+            with self._lock:
+                connection = self._connection
+            if connection is not None and connection.is_closed():
+                log.warning("connection lost; reconnecting")
+                self.stats.reconnects += 1
+                self._teardown_workers()
+                try:
+                    self._create_connection()
+                except Exception:
+                    return  # cancelled during reconnect; drain path follows
+            self._reconcile()
+
+    def _teardown_workers(self) -> None:
+        with self._lock:
+            shards = list(self._shards.values())
+            publisher_channel = self._publisher_channel
+            self._publisher_channel = None
+            self._publisher_alive = False
+            self._ensured_topics.clear()
+        for shard in shards:
+            if shard.channel is not None:
+                try:
+                    shard.channel.close()
+                except BrokerError:
+                    pass
+                shard.channel = None
+        if publisher_channel is not None:
+            try:
+                publisher_channel.close()
+            except BrokerError:
+                pass
+
+    def _drain_and_close(self) -> None:
+        """After cancellation: wait (bounded) for unsettled deliveries
+        (in-flight jobs) to ack/nack and for buffered publishes to reach
+        the broker, then close everything and signal done(). Deliveries
+        still unsettled at the timeout are abandoned — closing their
+        channels requeues them, preserving at-least-once."""
+        deadline = time.monotonic() + self._drain_timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                deliveries_pending = self._unsettled
+                publishes_pending = self._publishes_pending
+            if deliveries_pending <= 0 and publishes_pending <= 0:
+                break
+            # keep the publisher alive during drain (it may have died on a
+            # publish error and needs a fresh channel to finish the buffer)
+            with self._lock:
+                connection = self._connection
+            if connection is not None and connection.is_closed():
+                # one dial attempt per drain iteration (the normal
+                # _create_connection refuses to run once cancelled)
+                try:
+                    fresh = self._connect()
+                except (BrokerError, OSError):
+                    time.sleep(min(self._interval, 0.5))
+                    continue
+                with self._lock:
+                    self._connection = fresh
+                self.stats.reconnects += 1
+            self._reconcile()
+            log.info(
+                f"waiting on {deliveries_pending} unsettled deliveries and "
+                f"{publishes_pending} unpublished messages ..."
+            )
+            time.sleep(min(self._interval, 0.5))
+        with self._lock:
+            deliveries_pending = self._unsettled
+            publishes_pending = self._publishes_pending
+        if deliveries_pending > 0 or publishes_pending > 0:
+            log.warning(
+                f"drain timed out ({deliveries_pending} unsettled, "
+                f"{publishes_pending} unpublished); unsettled messages will "
+                "be redelivered"
+            )
+        self._teardown_workers()
+        with self._lock:
+            connection, self._connection = self._connection, None
+        if connection is not None and not connection.is_closed():
+            try:
+                connection.close()
+            except BrokerError as exc:
+                log.warning(f"failed to close connection gracefully: {exc}")
+        self._done.set()
+
+    # -- publisher -------------------------------------------------------
+
+    def _ensure_topology(self, channel: Channel, topic: str) -> None:
+        """Declare the exchange and bound shard queues for a publish topic,
+        once per connection. The reference only ensures topology on the
+        consume side (client.go:405-409), so a publish to a topic nobody
+        has consumed yet is silently dropped by the broker; declaring the
+        shard queues here makes the pipeline hand-off durable either way."""
+        with self._lock:
+            if topic in self._ensured_topics:
+                return
+        channel.declare_exchange(topic)
+        for i in range(self._num_queues):
+            name = self.shard_name(topic, i)
+            channel.declare_queue(name)
+            channel.bind_queue(name, topic, name)
+        with self._lock:
+            self._ensured_topics.add(topic)
+
+    def _next_rk(self, topic: str) -> str:
+        with self._lock:
+            index = self._publish_rk.get(topic, 0)
+            self._publish_rk[topic] = (index + 1) % self._num_queues
+        return self.shard_name(topic, index)
+
+    def _publish_loop(self) -> None:
+        # keeps running after cancellation until the buffer drains (or the
+        # drain deadline passes), so Convert messages enqueued by jobs that
+        # were just acked are not dropped on shutdown
+        drain_deadline: float | None = None
+        while True:
+            if self._token.cancelled():
+                if drain_deadline is None:
+                    drain_deadline = time.monotonic() + self._drain_timeout
+                if time.monotonic() > drain_deadline:
+                    break
+                with self._lock:
+                    if self._publishes_pending == 0:
+                        break
+            try:
+                pending = self._publish_buffer.get(timeout=0.2)
+            except queue_mod.Empty:
+                continue
+            delay = pending.not_before - time.monotonic()
+            if delay > 0:
+                time.sleep(min(delay, 0.5))
+                if time.monotonic() < pending.not_before:
+                    self._publish_buffer.put(pending)
+                    continue
+            routing_key = self._next_rk(pending.topic)
+            with self._lock:
+                channel = self._publisher_channel
+            try:
+                if channel is None:
+                    raise BrokerError("no publisher channel")
+                self._ensure_topology(channel, pending.topic)
+                channel.publish(
+                    pending.topic,
+                    routing_key,
+                    pending.body,
+                    headers=pending.headers,
+                    persistent=True,
+                )
+                with self._lock:
+                    self.stats.published += 1
+                    self._publishes_pending -= 1
+                log.with_fields(topic=pending.topic, rk=routing_key).debug(
+                    "published message"
+                )
+            except BrokerError as exc:
+                # real exponential backoff with jitter — the reference's
+                # `backoff ^ 2` XOR bug oscillated 0↔2ms (client.go:226)
+                pending.attempts += 1
+                backoff = min(
+                    self._publish_backoff_base * (2 ** (pending.attempts - 1)),
+                    self._publish_backoff_cap,
+                )
+                pending.not_before = time.monotonic() + backoff * (
+                    1 + random.uniform(0, 0.25)
+                )
+                with self._lock:
+                    self.stats.publish_retries += 1
+                log.warning(
+                    f"publish failed ({exc}); retry {pending.attempts} "
+                    f"in {backoff:.2f}s"
+                )
+                self._publish_buffer.put(pending)
+                with self._lock:
+                    self._publisher_alive = False
+                return  # thread exits; supervisor recreates with a fresh channel
+        with self._lock:
+            self._publisher_alive = False

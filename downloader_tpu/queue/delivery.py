@@ -1,0 +1,115 @@
+"""Delivery wrapper: ack/nack/error with retry metadata.
+
+Rebuild of the reference's ``internal/rabbitmq/delivery.go``. A Delivery
+wraps a broker message with the retry count parsed from the ``X-Retries``
+header (delivery.go:31-42, tolerating missing/garbage values) and exposes:
+
+- ``ack()``   — remove from the queue (delivery.go:55),
+- ``nack()``  — drop without requeue (delivery.go:60-63 passes
+  requeue=false), with ``requeue=True`` opt-in for transient failures —
+  the knob whose absence causes the reference's starve-on-failure bug
+  (cmd:119-149 leaves failures unacked forever),
+- ``error()`` — the retry path: ack, then republish with X-Retries+1 after
+  a delay (delivery.go:66-84's self-described dead-letter HACK — dead code
+  there, wired up and non-blocking here: the delay is a timer, not a
+  10-second sleep on the worker thread).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from ..utils import get_logger
+from .broker import BrokerError, Channel, Message
+
+log = get_logger("queue")
+
+RETRY_HEADER = "X-Retries"
+
+
+class Delivery:
+    def __init__(
+        self,
+        message: Message,
+        channel: Channel,
+        on_settled: Callable[["Delivery"], None] = lambda d: None,
+        publisher: Callable[[str, bytes, dict], None] | None = None,
+    ):
+        self.message = message
+        self.body = message.body
+        retries = message.headers.get(RETRY_HEADER, 0)
+        self.retries = retries if isinstance(retries, int) else 0
+        self._channel = channel
+        self._on_settled = on_settled
+        self._publisher = publisher
+        self._settled = False
+        self._lock = threading.Lock()
+
+    def _settle(self) -> bool:
+        with self._lock:
+            if self._settled:
+                return False
+            self._settled = True
+        self._on_settled(self)
+        return True
+
+    @property
+    def settled(self) -> bool:
+        return self._settled
+
+    def ack(self) -> None:
+        if not self._settle():
+            return
+        try:
+            self._channel.ack(self.message.delivery_tag)
+        except BrokerError as exc:
+            # connection died: the broker will redeliver (at-least-once)
+            log.warning(f"failed to ack message: {exc}")
+
+    def nack(self, requeue: bool = False) -> None:
+        if not self._settle():
+            return
+        try:
+            self._channel.nack(self.message.delivery_tag, requeue=requeue)
+        except BrokerError as exc:
+            log.warning(f"failed to nack message: {exc}")
+
+    def error(self) -> None:
+        """Retry the message: republish with an incremented X-Retries, then
+        ack the original. Republish happens FIRST and — when the delivery
+        came through a QueueClient — through its buffered publisher, which
+        survives broker outages with backoff and is drained at shutdown, so
+        a broker hiccup between ack and republish cannot lose the job (the
+        reference's ack-sleep-republish hack can, delivery.go:73-84).
+        Retry pacing is the consumer's job (the daemon delays retried
+        messages before processing)."""
+        if not self._settle():
+            return
+        headers = dict(self.message.headers)
+        headers[RETRY_HEADER] = self.retries + 1
+        try:
+            if self._publisher is not None:
+                self._publisher(self.message.exchange, self.body, headers)
+            else:
+                self._channel.publish(
+                    self.message.exchange,
+                    self.message.routing_key,
+                    self.body,
+                    headers=headers,
+                )
+        except BrokerError as exc:
+            # republish failed: requeue-nack so the broker redelivers the
+            # original — never ack what we failed to hand off
+            log.warning(f"failed to republish retried message: {exc}")
+            try:
+                self._channel.nack(self.message.delivery_tag, requeue=True)
+            except BrokerError as nack_exc:
+                log.warning(f"failed to requeue message: {nack_exc}")
+            return
+        try:
+            self._channel.ack(self.message.delivery_tag)
+        except BrokerError as exc:
+            # ack lost -> original redelivers -> duplicate retry; that is
+            # at-least-once, not loss
+            log.warning(f"failed to ack message post-retry: {exc}")

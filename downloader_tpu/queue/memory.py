@@ -1,0 +1,281 @@
+"""In-memory broker with real at-least-once semantics.
+
+A faithful stand-in for RabbitMQ at the Connection/Channel interface:
+direct exchanges route by exact routing key to bound queues; consumed
+messages stay unacked (and counted against prefetch) until acked; nack and
+connection loss requeue them with the redelivered flag, exactly the
+redelivery behavior the reference leans on for its crash-retry story
+(SURVEY.md §5 "checkpoint/resume"). ``MemoryBroker.drop_connections()``
+simulates a broker outage so supervisor/reconnect paths are testable — the
+reference has no test double at all for this (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import deque
+from typing import Callable
+
+from .broker import BrokerError, Message
+
+
+class MemoryBroker:
+    """The shared 'server' state; create connections with ``connect``."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._exchanges: dict[str, dict[str, set[str]]] = {}  # name -> rk -> queues
+        self._queues: dict[str, deque] = {}
+        self._consumers: dict[str, list["_Consumer"]] = {}
+        self._connections: list[MemoryConnection] = []
+        self._tag_counter = itertools.count(1)
+        self.published: int = 0  # observability for tests/bench
+        self.publish_log: list[tuple[str, str]] = []  # (exchange, routing_key)
+        self._pump_state_lock = threading.Lock()
+        self._pumping: set[int] = set()  # thread idents currently pumping
+        self._pump_again: set[int] = set()
+
+    # -- wiring ----------------------------------------------------------
+
+    def connect(self) -> "MemoryConnection":
+        conn = MemoryConnection(self)
+        with self._lock:
+            self._connections.append(conn)
+        return conn
+
+    def drop_connections(self) -> None:
+        """Simulate a broker outage: every connection dies, unacked
+        messages return to their queues (as RabbitMQ does)."""
+        with self._lock:
+            connections = list(self._connections)
+        for conn in connections:
+            conn._die()
+
+    # -- server-side ops (called via channels, under lock) ----------------
+
+    def _declare_exchange(self, name: str) -> None:
+        with self._lock:
+            self._exchanges.setdefault(name, {})
+
+    def _declare_queue(self, name: str) -> None:
+        with self._lock:
+            self._queues.setdefault(name, deque())
+
+    def _bind(self, queue: str, exchange: str, routing_key: str) -> None:
+        with self._lock:
+            if exchange not in self._exchanges:
+                raise BrokerError(f"no such exchange '{exchange}'")
+            if queue not in self._queues:
+                raise BrokerError(f"no such queue '{queue}'")
+            self._exchanges[exchange].setdefault(routing_key, set()).add(queue)
+
+    def _publish(
+        self, exchange: str, routing_key: str, body: bytes, headers: dict
+    ) -> None:
+        with self._lock:
+            if exchange not in self._exchanges:
+                raise BrokerError(f"no such exchange '{exchange}'")
+            targets = self._exchanges[exchange].get(routing_key, set())
+            for queue in targets:
+                self._queues[queue].append(
+                    (body, dict(headers), False, exchange, routing_key)
+                )
+            self.published += 1
+            self.publish_log.append((exchange, routing_key))
+        self._pump()
+
+    def _requeue(
+        self, queue: str, body: bytes, headers: dict, exchange: str, routing_key: str
+    ) -> None:
+        with self._lock:
+            if queue in self._queues:
+                self._queues[queue].appendleft(
+                    (body, headers, True, exchange, routing_key)
+                )
+        self._pump()
+
+    def _pump(self) -> None:
+        """Deliver queued messages to consumers with prefetch headroom.
+
+        Non-reentrant per thread: a callback that acks (triggering another
+        pump) marks the outer pump to loop again instead of recursing, so
+        inline-ack consumers can drain arbitrarily deep queues."""
+        ident = threading.get_ident()
+        with self._pump_state_lock:
+            if ident in self._pumping:
+                self._pump_again.add(ident)
+                return
+            self._pumping.add(ident)
+        try:
+            while True:
+                self._pump_once()
+                with self._pump_state_lock:
+                    if ident not in self._pump_again:
+                        return
+                    self._pump_again.discard(ident)
+        finally:
+            with self._pump_state_lock:
+                self._pumping.discard(ident)
+                self._pump_again.discard(ident)
+
+    def _pump_once(self) -> None:
+        while True:
+            with self._lock:
+                delivery = None
+                for queue_name, consumers in self._consumers.items():
+                    backlog = self._queues.get(queue_name)
+                    if not backlog:
+                        continue
+                    for consumer in consumers:
+                        if consumer.has_capacity():
+                            delivery = (queue_name, consumer, backlog.popleft())
+                            break
+                    if delivery:
+                        break
+                if delivery is None:
+                    return
+                queue_name, consumer, entry = delivery
+                body, headers, redelivered, exchange, routing_key = entry
+                tag = next(self._tag_counter)
+                message = Message(
+                    body=body,
+                    delivery_tag=tag,
+                    exchange=exchange,
+                    routing_key=routing_key,
+                    headers=headers,
+                    redelivered=redelivered,
+                )
+                consumer.track(tag, queue_name, body, headers, exchange, routing_key)
+            # deliver outside the lock: callbacks may publish/ack inline
+            consumer.deliver(message)
+
+    def queue_depth(self, queue: str) -> int:
+        with self._lock:
+            return len(self._queues.get(queue, ()))
+
+
+class _Consumer:
+    def __init__(self, channel: "MemoryChannel", callback: Callable[[Message], None]):
+        self.channel = channel
+        self.callback = callback
+
+    def has_capacity(self) -> bool:
+        channel = self.channel
+        if channel.closed:
+            return False
+        prefetch = channel.prefetch
+        return prefetch == 0 or len(channel.unacked) < prefetch
+
+    def track(self, tag, queue, body, headers, exchange, routing_key) -> None:
+        self.channel.unacked[tag] = (queue, body, headers, exchange, routing_key)
+
+    def deliver(self, message: Message) -> None:
+        try:
+            self.callback(message)
+        except Exception:
+            # consumer callbacks must not kill the pump; leave unacked so
+            # the message redelivers on connection teardown
+            pass
+
+
+class MemoryChannel:
+    def __init__(self, connection: "MemoryConnection"):
+        self._connection = connection
+        self._broker = connection._broker
+        self.prefetch = 0
+        self.unacked: dict[int, tuple[str, bytes, dict]] = {}
+        self.closed = False
+        self._consumer_names: list[str] = []
+
+    def _check(self) -> None:
+        if self.closed or self._connection.is_closed():
+            raise BrokerError("channel is closed")
+
+    def declare_exchange(self, name: str) -> None:
+        self._check()
+        self._broker._declare_exchange(name)
+
+    def declare_queue(self, name: str) -> None:
+        self._check()
+        self._broker._declare_queue(name)
+
+    def bind_queue(self, queue: str, exchange: str, routing_key: str) -> None:
+        self._check()
+        self._broker._bind(queue, exchange, routing_key)
+
+    def set_prefetch(self, count: int) -> None:
+        self._check()
+        self.prefetch = count
+
+    def publish(self, exchange, routing_key, body, headers=None, persistent=True):
+        self._check()
+        self._broker._publish(exchange, routing_key, body, headers or {})
+
+    def consume(self, queue: str, on_message: Callable[[Message], None]) -> str:
+        self._check()
+        consumer = _Consumer(self, on_message)
+        with self._broker._lock:
+            if queue not in self._broker._queues:
+                raise BrokerError(f"no such queue '{queue}'")
+            self._broker._consumers.setdefault(queue, []).append(consumer)
+        self._consumer_names.append(queue)
+        self._broker._pump()
+        return f"ctag-{id(consumer)}"
+
+    def ack(self, delivery_tag: int) -> None:
+        self._check()
+        self.unacked.pop(delivery_tag, None)
+        self._broker._pump()
+
+    def nack(self, delivery_tag: int, requeue: bool) -> None:
+        self._check()
+        entry = self.unacked.pop(delivery_tag, None)
+        if entry is not None and requeue:
+            queue, body, headers, exchange, routing_key = entry
+            self._broker._requeue(queue, body, headers, exchange, routing_key)
+        self._broker._pump()
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        broker = self._broker
+        with broker._lock:
+            for queue in self._consumer_names:
+                broker._consumers[queue] = [
+                    c for c in broker._consumers.get(queue, []) if c.channel is not self
+                ]
+            unacked, self.unacked = dict(self.unacked), {}
+        for queue, body, headers, exchange, routing_key in unacked.values():
+            broker._requeue(queue, body, headers, exchange, routing_key)
+
+
+class MemoryConnection:
+    def __init__(self, broker: MemoryBroker):
+        self._broker = broker
+        self._channels: list[MemoryChannel] = []
+        self._closed = False
+
+    def channel(self) -> MemoryChannel:
+        if self._closed:
+            raise BrokerError("connection is closed")
+        channel = MemoryChannel(self)
+        self._channels.append(channel)
+        return channel
+
+    def is_closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        self._die()
+
+    def _die(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for channel in self._channels:
+            channel.close()
+        with self._broker._lock:
+            if self in self._broker._connections:
+                self._broker._connections.remove(self)

@@ -1,0 +1,225 @@
+"""AMQP 0-9-1 integration tests: the from-scratch wire client against the
+in-process TCP server stub — handshake/auth, topology declare, publish/
+consume/ack with headers, frame splitting for large bodies, error and
+outage paths, and the full QueueClient running over real sockets."""
+
+import time
+
+import pytest
+
+from downloader_tpu.queue import MemoryBroker, QueueClient
+from downloader_tpu.queue.amqp import AmqpConnection, AmqpError
+from downloader_tpu.queue.amqp_server import AmqpServerStub
+from downloader_tpu.queue.broker import BrokerError
+from downloader_tpu.utils.cancel import CancelToken
+
+
+def wait_for(predicate, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def server():
+    with AmqpServerStub() as stub:
+        yield stub
+
+
+@pytest.fixture
+def conn(server):
+    connection = AmqpConnection.dial(server.endpoint)
+    yield connection
+    connection.close()
+
+
+class TestHandshake:
+    def test_dial_and_close(self, server):
+        connection = AmqpConnection.dial(server.endpoint)
+        assert not connection.is_closed()
+        connection.close()
+        assert connection.is_closed()
+        assert server.connections_accepted == 1
+
+    def test_plain_auth_accepted(self):
+        with AmqpServerStub(username="guest", password="secret") as stub:
+            connection = AmqpConnection.dial(
+                stub.endpoint, username="guest", password="secret"
+            )
+            channel = connection.channel()
+            channel.declare_exchange("t")
+            connection.close()
+
+    def test_bad_credentials_rejected(self):
+        with AmqpServerStub(username="guest", password="secret") as stub:
+            with pytest.raises(AmqpError) as excinfo:
+                AmqpConnection.dial(stub.endpoint, username="guest", password="wrong")
+            assert "403" in str(excinfo.value) or "REFUSED" in str(excinfo.value)
+
+    def test_dial_refused(self):
+        with pytest.raises(BrokerError):
+            AmqpConnection.dial("127.0.0.1:1")
+
+
+class TestChannelOps:
+    def test_declare_publish_consume_ack(self, server, conn):
+        channel = conn.channel()
+        channel.declare_exchange("v1.download")
+        channel.declare_queue("v1.download-0")
+        channel.bind_queue("v1.download-0", "v1.download", "v1.download-0")
+        got = []
+        channel.consume("v1.download-0", got.append)
+        channel.publish(
+            "v1.download", "v1.download-0", b"job-bytes", headers={"X-Retries": 2}
+        )
+        assert wait_for(lambda: len(got) == 1)
+        message = got[0]
+        assert message.body == b"job-bytes"
+        assert message.headers["X-Retries"] == 2
+        assert message.exchange == "v1.download"
+        channel.ack(message.delivery_tag)
+        assert wait_for(lambda: server.broker.queue_depth("v1.download-0") == 0)
+
+    def test_large_body_split_frames(self, server, conn):
+        channel = conn.channel()
+        channel.declare_exchange("t")
+        channel.declare_queue("t-0")
+        channel.bind_queue("t-0", "t", "t-0")
+        got = []
+        channel.consume("t-0", got.append)
+        big = bytes(range(256)) * 2048  # 512 KiB > frame_max
+        channel.publish("t", "t-0", big)
+        assert wait_for(lambda: len(got) == 1)
+        assert got[0].body == big
+        channel.ack(got[0].delivery_tag)
+
+    def test_empty_body(self, server, conn):
+        channel = conn.channel()
+        channel.declare_exchange("t")
+        channel.declare_queue("t-0")
+        channel.bind_queue("t-0", "t", "t-0")
+        got = []
+        channel.consume("t-0", got.append)
+        channel.publish("t", "t-0", b"")
+        assert wait_for(lambda: len(got) == 1)
+        assert got[0].body == b""
+
+    def test_nack_requeue_redelivers(self, server, conn):
+        channel = conn.channel()
+        channel.declare_exchange("t")
+        channel.declare_queue("t-0")
+        channel.bind_queue("t-0", "t", "t-0")
+        got = []
+        channel.consume("t-0", got.append)
+        channel.publish("t", "t-0", b"again")
+        assert wait_for(lambda: len(got) == 1)
+        channel.nack(got[0].delivery_tag, requeue=True)
+        assert wait_for(lambda: len(got) == 2)
+        assert got[1].redelivered
+
+    def test_prefetch_respected(self, server, conn):
+        channel = conn.channel()
+        channel.declare_exchange("t")
+        channel.declare_queue("t-0")
+        channel.bind_queue("t-0", "t", "t-0")
+        channel.set_prefetch(1)
+        got = []
+        channel.consume("t-0", got.append)
+        for i in range(3):
+            channel.publish("t", "t-0", b"%d" % i)
+        time.sleep(0.3)
+        assert len(got) == 1
+        channel.ack(got[0].delivery_tag)
+        assert wait_for(lambda: len(got) == 2)
+
+    def test_bind_to_missing_exchange_closes_channel(self, server, conn):
+        channel = conn.channel()
+        channel.declare_queue("q")
+        with pytest.raises(BrokerError):
+            channel.bind_queue("q", "ghost-exchange", "rk")
+        # connection still usable on a fresh channel
+        fresh = conn.channel()
+        fresh.declare_exchange("ok")
+
+    def test_server_drop_marks_connection_closed(self, server, conn):
+        channel = conn.channel()
+        channel.declare_exchange("t")
+        server.drop_clients()
+        assert wait_for(lambda: conn.is_closed())
+        with pytest.raises(BrokerError):
+            conn.channel()
+
+
+class TestQueueClientOverAmqp:
+    def test_end_to_end(self, server):
+        token = CancelToken()
+        try:
+            client = QueueClient(
+                token,
+                lambda: AmqpConnection.dial(server.endpoint),
+                supervisor_interval=0.05,
+                drain_timeout=2,
+            )
+            client.set_prefetch(1)
+            deliveries = client.consume("v1.download")
+            client.publish("v1.download", b"payload", headers={"X-Retries": 1})
+            delivery = deliveries.get(timeout=10)
+            assert delivery.body == b"payload"
+            assert delivery.retries == 1
+            delivery.ack()
+        finally:
+            token.cancel()
+
+    def test_reconnects_after_broker_restart(self, server):
+        token = CancelToken()
+        try:
+            client = QueueClient(
+                token,
+                lambda: AmqpConnection.dial(server.endpoint),
+                supervisor_interval=0.05,
+                drain_timeout=2,
+            )
+            deliveries = client.consume("t")
+            client.publish("t", b"one")
+            deliveries.get(timeout=10).ack()
+            # wait for the async ack to land server-side, else dropping now
+            # legitimately redelivers "one" (at-least-once)
+            assert wait_for(
+                lambda: all(
+                    not ch.unacked
+                    for s in server._sessions
+                    for ch in s._channels.values()
+                )
+            )
+            server.drop_clients()
+            assert wait_for(lambda: client.stats.reconnects >= 1)
+            client.publish("t", b"two")
+            delivery = deliveries.get(timeout=10)
+            assert delivery.body == b"two"
+            delivery.ack()
+        finally:
+            token.cancel()
+
+    def test_unacked_redelivered_after_restart(self, server):
+        token = CancelToken()
+        try:
+            client = QueueClient(
+                token,
+                lambda: AmqpConnection.dial(server.endpoint),
+                supervisor_interval=0.05,
+                drain_timeout=2,
+            )
+            deliveries = client.consume("t")
+            client.publish("t", b"inflight")
+            first = deliveries.get(timeout=10)  # never acked
+            server.drop_clients()
+            second = deliveries.get(timeout=10)
+            assert second.body == b"inflight"
+            assert second.message.redelivered
+            second.ack()
+            first.ack()  # stale settle fails softly
+        finally:
+            token.cancel()

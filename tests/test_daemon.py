@@ -1,0 +1,257 @@
+"""Daemon tests: the full queue-driven pipeline over the memory broker —
+happy path, malformed/unroutable/missing-media drops, transient-failure
+retry with X-Retries cap, N-way concurrency, and graceful shutdown that
+finishes in-flight jobs (the starvation bug the reference shipped)."""
+
+import base64
+import http.server
+import threading
+import time
+
+import pytest
+
+from downloader_tpu.daemon.app import Daemon
+from downloader_tpu.daemon.config import Config
+from downloader_tpu.fetch import DispatchClient, HTTPBackend
+from downloader_tpu.queue import MemoryBroker, QueueClient
+from downloader_tpu.store import Credentials, S3Client, Uploader
+from downloader_tpu.store.stub import S3Stub
+from downloader_tpu.utils.cancel import CancelToken
+from downloader_tpu.wire import Convert, Download, Media
+
+MOVIE = b"\x1aFAKEMKV" * 2048
+
+
+def wait_for(predicate, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def file_server():
+    class Handler(http.server.BaseHTTPRequestHandler):
+        fail_next = {}
+
+        def log_message(self, *args):
+            pass
+
+        def do_GET(self):
+            remaining = Handler.fail_next.get(self.path, 0)
+            if remaining > 0:
+                Handler.fail_next[self.path] = remaining - 1
+                self.send_error(503)
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(MOVIE)))
+            self.end_headers()
+            self.wfile.write(MOVIE)
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    Handler.base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield Handler
+    httpd.shutdown()
+
+
+@pytest.fixture
+def harness(file_server, tmp_path):
+    """A fully wired daemon over memory broker + S3 stub; yields helpers."""
+    token = CancelToken()
+    broker = MemoryBroker()
+    stub = S3Stub(credentials=Credentials("k", "s")).start()
+    config = Config(
+        broker="memory",
+        base_dir=str(tmp_path),
+        concurrency=2,
+        max_job_retries=2,
+        retry_delay=0.05,
+    )
+    client = QueueClient(
+        token, broker.connect, supervisor_interval=0.05, drain_timeout=5
+    )
+    client.set_prefetch(config.prefetch)
+    dispatcher = DispatchClient(
+        token, str(tmp_path), [HTTPBackend(progress_interval=0.01, timeout=5)]
+    )
+    uploader = Uploader(config.bucket, S3Client(stub.endpoint, Credentials("k", "s")))
+    daemon = Daemon(token, client, dispatcher, uploader, config)
+
+    runner = threading.Thread(target=daemon.run, daemon=True)
+    runner.start()
+    time.sleep(0.1)  # let consumers come up
+
+    producer_channel = broker.connect().channel()
+
+    class Harness:
+        pass
+
+    h = Harness()
+    h.daemon, h.broker, h.stub, h.token = daemon, broker, stub, token
+    h.config, h.runner, h.file_server = config, runner, file_server
+
+    def enqueue(media_id, url):
+        body = Download(media=Media(id=media_id, source_uri=url)).marshal()
+        # round-robin like an upstream publisher; shard 0 is fine
+        producer_channel.publish("v1.download", "v1.download-0", body)
+
+    h.enqueue = enqueue
+    consumed = []
+
+    convert_channel = broker.connect().channel()
+    convert_channel.declare_exchange("v1.convert")
+    convert_channel.declare_queue("convert-sink")
+    convert_channel.bind_queue("convert-sink", "v1.convert", "v1.convert-0")
+    convert_channel.bind_queue("convert-sink", "v1.convert", "v1.convert-1")
+
+    def on_convert(message):
+        consumed.append(Convert.unmarshal(message.body))
+        convert_channel.ack(message.delivery_tag)
+
+    convert_channel.consume("convert-sink", on_convert)
+    h.converts = consumed
+
+    yield h
+    token.cancel()
+    runner.join(timeout=10)
+    stub.stop()
+
+
+def test_end_to_end_job(harness):
+    harness.enqueue("m-1", f"{harness.file_server.base}/movie.mkv")
+    assert wait_for(lambda: harness.daemon.stats.processed == 1)
+    key = f"m-1/original/{base64.b64encode(b'movie.mkv').decode()}"
+    assert harness.stub.buckets["triton-staging"][key] == MOVIE
+    assert wait_for(lambda: len(harness.converts) == 1)
+    convert = harness.converts[0]
+    assert convert.media.id == "m-1"
+    assert convert.created_at  # stamped
+
+def test_malformed_message_dropped(harness):
+    channel = harness.broker.connect().channel()
+    channel.publish("v1.download", "v1.download-0", b"\xff\xff not proto")
+    assert wait_for(lambda: harness.daemon.stats.dropped == 1)
+    assert harness.daemon.stats.processed == 0
+    # consumer is NOT starved: a good job still processes (reference bug)
+    harness.enqueue("m-2", f"{harness.file_server.base}/movie.mkv")
+    assert wait_for(lambda: harness.daemon.stats.processed == 1)
+
+
+def test_missing_media_dropped(harness):
+    channel = harness.broker.connect().channel()
+    channel.publish("v1.download", "v1.download-0", Download().marshal())
+    assert wait_for(lambda: harness.daemon.stats.dropped == 1)
+
+
+def test_unsupported_scheme_dropped(harness):
+    harness.enqueue("m-3", "gopher://nope/file")
+    assert wait_for(lambda: harness.daemon.stats.dropped == 1)
+
+
+def test_transient_failure_retries_then_succeeds(harness):
+    harness.file_server.fail_next["/flaky.mkv"] = 1
+    harness.enqueue("m-4", f"{harness.file_server.base}/flaky.mkv")
+    assert wait_for(lambda: harness.daemon.stats.retried >= 1)
+    assert wait_for(lambda: harness.daemon.stats.processed == 1, timeout=15)
+
+
+def test_permanent_failure_dropped_after_max_retries(harness):
+    harness.file_server.fail_next["/dead.mkv"] = 99
+    harness.enqueue("m-5", f"{harness.file_server.base}/dead.mkv")
+    assert wait_for(lambda: harness.daemon.stats.failed == 1, timeout=30)
+    # retried exactly max_job_retries times before giving up
+    assert harness.daemon.stats.retried == harness.config.max_job_retries
+
+
+def test_concurrent_jobs(harness):
+    for i in range(6):
+        harness.enqueue(f"c-{i}", f"{harness.file_server.base}/movie.mkv")
+    assert wait_for(lambda: harness.daemon.stats.processed == 6, timeout=30)
+    for i in range(6):
+        key = f"c-{i}/original/{base64.b64encode(b'movie.mkv').decode()}"
+        assert harness.stub.buckets["triton-staging"][key] == MOVIE
+
+
+def test_graceful_shutdown_finishes_inflight(harness):
+    harness.enqueue("m-6", f"{harness.file_server.base}/movie.mkv")
+    time.sleep(0.05)  # job likely picked up
+    harness.token.cancel()
+    harness.runner.join(timeout=10)
+    assert not harness.runner.is_alive()
+    # the job either completed (acked+uploaded) or was requeued; never lost
+    depth = harness.broker.queue_depth("v1.download-0") + harness.broker.queue_depth(
+        "v1.download-1"
+    )
+    assert harness.daemon.stats.processed + depth >= 1
+
+
+def test_serve_end_to_end_over_amqp(file_server, tmp_path, monkeypatch):
+    """Full operator path: serve() against a real (stub) AMQP broker over
+    TCP, job enqueued by a foreign AMQP client, S3 upload verified."""
+    from downloader_tpu.daemon.app import serve
+    from downloader_tpu.queue.amqp import AmqpConnection
+    from downloader_tpu.queue.amqp_server import AmqpServerStub
+
+    token = CancelToken()
+    with AmqpServerStub(username="u", password="p") as amqp, S3Stub(
+        credentials=Credentials("k", "s")
+    ) as stub:
+        monkeypatch.setenv("S3_ENDPOINT", f"http://{stub.endpoint}")
+        monkeypatch.setenv("S3_ACCESS_KEY", "k")
+        monkeypatch.setenv("S3_SECRET_KEY", "s")
+        config = Config(
+            broker="amqp",
+            amqp_endpoint=amqp.endpoint,
+            amqp_username="u",
+            amqp_password="p",
+            base_dir=str(tmp_path),
+            concurrency=2,
+            retry_delay=0.05,
+        )
+        server_thread = threading.Thread(
+            target=serve,
+            kwargs=dict(config=config, token=token, install_signal_handlers=False),
+            daemon=True,
+        )
+        server_thread.start()
+
+        # wait for the daemon's topology, then enqueue like a producer would
+        producer = AmqpConnection.dial(amqp.endpoint, username="u", password="p")
+        channel = producer.channel()
+        body = Download(media=Media(id="sv-1", source_uri=f"{file_server.base}/movie.mkv")).marshal()
+        assert wait_for(lambda: amqp.broker.queue_depth("v1.download-0") == 0 and "v1.download" in amqp.broker._exchanges)
+        channel.publish("v1.download", "v1.download-0", body)
+
+        key = f"sv-1/original/{base64.b64encode(b'movie.mkv').decode()}"
+        assert wait_for(
+            lambda: stub.buckets.get("triton-staging", {}).get(key) == MOVIE,
+            timeout=15,
+        )
+        # the Convert message reached the v1.convert shards
+        assert wait_for(
+            lambda: amqp.broker.queue_depth("v1.convert-0")
+            + amqp.broker.queue_depth("v1.convert-1")
+            == 1
+        )
+        producer.close()
+        token.cancel()
+        server_thread.join(timeout=10)
+        assert not server_thread.is_alive()
+
+
+def test_poison_message_capped(harness, monkeypatch):
+    """An exception outside the caught tuple must still respect the retry
+    cap instead of looping forever (review finding)."""
+    calls = []
+
+    def explode(media_id, url):
+        calls.append(1)
+        raise RuntimeError("poison")
+
+    monkeypatch.setattr(harness.daemon._dispatcher, "download", explode)
+    harness.enqueue("poison-1", "http://x/file.mkv")
+    assert wait_for(lambda: harness.daemon.stats.failed == 1, timeout=20)
+    assert len(calls) == harness.config.max_job_retries + 1

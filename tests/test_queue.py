@@ -1,0 +1,287 @@
+"""Queue transport tests: memory-broker at-least-once semantics, client
+topology/sharding/round-robin, prefetch, delivery settle paths (ack, nack,
+requeue, error-retry), supervisor reconnect after outages, and graceful
+drain — the paths the reference left completely untested (SURVEY.md §4)."""
+
+import queue as queue_mod
+import threading
+import time
+
+import pytest
+
+from downloader_tpu.queue import MemoryBroker, QueueClient
+from downloader_tpu.queue.broker import BrokerError
+from downloader_tpu.utils.cancel import CancelToken
+
+
+def wait_for(predicate, timeout=5.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def broker():
+    return MemoryBroker()
+
+
+@pytest.fixture
+def token():
+    t = CancelToken()
+    yield t
+    t.cancel()
+
+
+def make_client(broker, token, **kwargs):
+    kwargs.setdefault("supervisor_interval", 0.05)
+    kwargs.setdefault("drain_timeout", 1.0)
+    return QueueClient(token, broker.connect, **kwargs)
+
+
+class TestMemoryBroker:
+    def test_publish_route_consume_ack(self, broker):
+        conn = broker.connect()
+        ch = conn.channel()
+        ch.declare_exchange("t")
+        ch.declare_queue("t-0")
+        ch.bind_queue("t-0", "t", "t-0")
+        got = []
+        ch.consume("t-0", got.append)
+        ch.publish("t", "t-0", b"one")
+        assert wait_for(lambda: len(got) == 1)
+        assert got[0].body == b"one"
+        ch.ack(got[0].delivery_tag)
+        assert broker.queue_depth("t-0") == 0
+
+    def test_prefetch_limits_inflight(self, broker):
+        conn = broker.connect()
+        ch = conn.channel()
+        ch.declare_exchange("t")
+        ch.declare_queue("t-0")
+        ch.bind_queue("t-0", "t", "t-0")
+        ch.set_prefetch(1)
+        got = []
+        ch.consume("t-0", got.append)
+        for i in range(3):
+            ch.publish("t", "t-0", b"%d" % i)
+        assert len(got) == 1  # only one unacked at a time
+        ch.ack(got[0].delivery_tag)
+        assert len(got) == 2
+
+    def test_nack_requeue_redelivers(self, broker):
+        conn = broker.connect()
+        ch = conn.channel()
+        ch.declare_exchange("t")
+        ch.declare_queue("t-0")
+        ch.bind_queue("t-0", "t", "t-0")
+        got = []
+        ch.consume("t-0", got.append)
+        ch.publish("t", "t-0", b"x")
+        ch.nack(got[0].delivery_tag, requeue=True)
+        assert wait_for(lambda: len(got) == 2)
+        assert got[1].redelivered
+
+    def test_connection_drop_requeues_unacked(self, broker):
+        conn = broker.connect()
+        ch = conn.channel()
+        ch.declare_exchange("t")
+        ch.declare_queue("t-0")
+        ch.bind_queue("t-0", "t", "t-0")
+        got = []
+        ch.consume("t-0", got.append)
+        ch.publish("t", "t-0", b"x")
+        assert len(got) == 1
+        broker.drop_connections()
+        assert broker.queue_depth("t-0") == 1  # back in the queue
+        with pytest.raises(BrokerError):
+            ch.publish("t", "t-0", b"y")
+
+    def test_publish_to_missing_exchange_errors(self, broker):
+        ch = broker.connect().channel()
+        with pytest.raises(BrokerError):
+            ch.publish("ghost", "rk", b"x")
+
+    def test_inline_ack_deep_queue_no_recursion(self, broker):
+        conn = broker.connect()
+        ch = conn.channel()
+        ch.declare_exchange("t")
+        ch.declare_queue("t-0")
+        ch.bind_queue("t-0", "t", "t-0")
+        ch.set_prefetch(1)
+        seen = []
+
+        def inline_ack(msg):
+            seen.append(msg.body)
+            ch.ack(msg.delivery_tag)
+
+        # enqueue deep BEFORE consuming, then one pump drains it all
+        for i in range(3000):
+            ch2 = conn.channel()
+            ch2.publish("t", "t-0", b"%d" % i)
+        ch.consume("t-0", inline_ack)
+        assert wait_for(lambda: len(seen) == 3000)
+
+
+class TestQueueClient:
+    def test_consume_declares_sharded_topology(self, broker, token):
+        client = make_client(broker, token)
+        client.consume("v1.download")
+        assert "v1.download-0" in broker._queues
+        assert "v1.download-1" in broker._queues
+        assert broker._exchanges["v1.download"]["v1.download-0"] == {"v1.download-0"}
+
+    def test_publish_round_robins_shards(self, broker, token):
+        client = make_client(broker, token)
+        deliveries = client.consume("t")
+        for i in range(4):
+            client.publish("t", b"%d" % i)
+        for _ in range(4):
+            deliveries.get(timeout=5).ack()
+        routing_keys = [rk for _, rk in broker.publish_log]
+        assert routing_keys == ["t-0", "t-1", "t-0", "t-1"]
+
+    def test_end_to_end_consume_ack(self, broker, token):
+        client = make_client(broker, token)
+        deliveries = client.consume("t")
+        client.publish("t", b"job")
+        delivery = deliveries.get(timeout=5)
+        assert delivery.body == b"job"
+        delivery.ack()
+        assert broker.queue_depth("t-0") == 0 and broker.queue_depth("t-1") == 0
+
+    def test_prefetch_one_serializes(self, broker, token):
+        client = make_client(broker, token)
+        client.set_prefetch(1)
+        deliveries = client.consume("t")
+        for i in range(4):
+            client.publish("t", b"%d" % i)
+        first = deliveries.get(timeout=5)
+        # with prefetch 1 per shard channel and 2 shards, at most 2 in flight
+        time.sleep(0.2)
+        assert deliveries.qsize() <= 1
+        first.ack()
+        second = deliveries.get(timeout=5)
+        assert second.body != first.body
+
+    def test_retry_header_roundtrip(self, broker, token):
+        client = make_client(broker, token)
+        deliveries = client.consume("t")
+        client.publish("t", b"flaky")
+        first = deliveries.get(timeout=5)
+        assert first.retries == 0
+        first.error()  # republish with X-Retries+1
+        second = deliveries.get(timeout=5)
+        assert second.body == b"flaky"
+        assert second.retries == 1
+        second.ack()
+
+    def test_reconnect_after_broker_outage(self, broker, token):
+        client = make_client(broker, token)
+        deliveries = client.consume("t")
+        client.publish("t", b"before")
+        deliveries.get(timeout=5).ack()
+
+        broker.drop_connections()
+        assert wait_for(lambda: client.stats.reconnects >= 1)
+        client.publish("t", b"after")
+        delivery = deliveries.get(timeout=5)
+        assert delivery.body == b"after"
+        delivery.ack()
+
+    def test_unacked_at_outage_is_redelivered(self, broker, token):
+        client = make_client(broker, token)
+        deliveries = client.consume("t")
+        client.publish("t", b"inflight")
+        first = deliveries.get(timeout=5)  # not acked
+        broker.drop_connections()
+        second = deliveries.get(timeout=5)
+        assert second.body == b"inflight"
+        assert second.message.redelivered
+        second.ack()
+        # settling the zombie delivery is a no-op, not a crash
+        first.ack()
+
+    def test_publish_survives_outage_with_backoff(self, broker, token):
+        client = make_client(broker, token, publish_backoff_base=0.01)
+        deliveries = client.consume("t")
+        broker.drop_connections()
+        client.publish("t", b"queued-during-outage")
+        assert wait_for(lambda: client.stats.publish_retries >= 1)
+        # after reconnect, the buffered message reaches the broker exactly once
+        assert wait_for(lambda: client.stats.published == 1, timeout=10)
+        delivery = deliveries.get(timeout=5)
+        assert delivery.body == b"queued-during-outage"
+        delivery.ack()
+        assert len(broker.publish_log) == 1
+
+    def test_graceful_drain_waits_for_inflight(self, broker, token):
+        client = make_client(broker, token)
+        deliveries = client.consume("t")
+        client.publish("t", b"slow-job")
+        delivery = deliveries.get(timeout=5)
+
+        done_flag = []
+
+        def wait_done():
+            client.done()
+            done_flag.append(True)
+
+        waiter = threading.Thread(target=wait_done, daemon=True)
+        waiter.start()
+        token.cancel()
+        time.sleep(0.3)
+        assert not done_flag  # still waiting on our unsettled delivery
+        delivery.ack()
+        waiter.join(timeout=5)
+        assert done_flag == [True]
+        assert broker.queue_depth("t-0") == 0 and broker.queue_depth("t-1") == 0
+
+    def test_connect_retries_with_backoff(self, broker, token):
+        attempts = []
+
+        def flaky_connect():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise BrokerError("broker down")
+            return broker.connect()
+
+        client = QueueClient(token, flaky_connect, supervisor_interval=0.05)
+        assert len(attempts) == 3
+        client.consume("t")
+        client.publish("t", b"x")
+        assert wait_for(lambda: client.stats.published == 1)
+
+
+class TestShutdownDurability:
+    def test_buffered_publishes_drain_on_shutdown(self, broker, token):
+        """Convert messages enqueued just before cancel must reach the
+        broker before done() completes (review finding: dropped buffer)."""
+        client = make_client(broker, token, drain_timeout=5)
+        client.consume("t")
+        for i in range(5):
+            client.publish("t", b"late-%d" % i)
+        token.cancel()
+        client.done()
+        assert client.stats.published == 5
+
+    def test_error_republish_survives_channel_loss(self, broker, token):
+        """error() must not lose the job when its channel is dead: the
+        buffered publisher path carries the retry."""
+        client = make_client(broker, token)
+        deliveries = client.consume("t")
+        client.publish("t", b"retry-me")
+        delivery = deliveries.get(timeout=5)
+        broker.drop_connections()  # kill the delivery's channel
+        wait_for(lambda: client.stats.reconnects >= 1)
+        delivery.error()  # routed via buffered publisher, not dead channel
+        # the dead channel's unacked original redelivers AND the retry copy
+        # arrives (the post-retry ack could not reach the dead channel):
+        # duplicates are correct at-least-once behavior; loss would not be
+        got = [deliveries.get(timeout=10), deliveries.get(timeout=10)]
+        assert {d.body for d in got} == {b"retry-me"}
+        assert max(d.retries for d in got) == 1
+        for d in got:
+            d.ack()

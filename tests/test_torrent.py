@@ -232,3 +232,16 @@ def test_deep_nesting_raises_bencode_error_not_recursion():
         decode(b"l" * 2000)
     with pytest.raises(BencodeError):
         decode(b"l" * 2000 + b"e" * 2000)
+
+
+def test_metainfo_info_hash_uses_raw_bytes():
+    """A .torrent with missorted info-dict keys must hash the bytes as
+    they appear in the file, not a re-canonicalized encoding."""
+    # hand-build a dict with keys out of order: 'piece length' before 'name'
+    # would be sorted差 — use 'pieces' before 'length' (wrong order)
+    import hashlib as _hl
+
+    inner = b"d6:pieces20:" + b"\x11" * 20 + b"6:lengthi5e4:name1:xe"
+    raw = b"d4:info" + inner + b"e"
+    job = parse_metainfo(raw)
+    assert job.info_hash == _hl.sha1(inner).digest()
