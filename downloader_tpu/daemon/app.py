@@ -139,7 +139,20 @@ class Daemon:
         convert = Convert(
             created_at=time.strftime("%Y-%m-%d %H:%M:%S %z"), media=media
         )
-        self._client.publish(self._config.publish_topic, convert.marshal())
+        confirmed = self._client.publish(
+            self._config.publish_topic,
+            convert.marshal(),
+            wait=self._config.publish_confirm_timeout,
+        )
+        if not confirmed:
+            # the Convert hand-off is the job's whole point: never ack a
+            # download whose pipeline hand-off is not durably on the
+            # broker (an unflushed in-memory buffer dies with the
+            # process). Requeue; re-running the job is at-least-once.
+            job_log.error("convert publish unconfirmed; requeueing job")
+            delivery.nack(requeue=True)
+            self.stats.bump(retried=1)
+            return
         job_log.info("finished processing")
         delivery.ack()
         self.stats.bump(processed=1)
@@ -184,15 +197,18 @@ class Daemon:
         self._token.wait()  # block until cancelled
         for worker in self._workers:
             worker.join()
-        # deliveries still sitting in the sink were never picked up by a
-        # worker; hand them straight back so the client's drain doesn't
-        # wait out its timeout on messages nobody will process
+        # stop the shard consumers FIRST: closing their channels requeues
+        # everything unacked at the broker and stops redelivery. Only then
+        # settle the deliveries stranded in the sink — nacking them while
+        # a consumer is still live would bounce each message straight
+        # back into the sink in a hot loop until the drain timeout.
+        self._client.stop_consuming()
         while True:
             try:
                 leftover = deliveries.get_nowait()
             except queue_mod.Empty:
                 break
-            leftover.nack(requeue=True)
+            leftover.nack(requeue=True)  # channel closed → already requeued
         self._client.done()
         log.info("finished shutdown")
 

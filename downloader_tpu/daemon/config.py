@@ -44,6 +44,7 @@ class Config:
     concurrency: int = 1
     max_job_retries: int = 3
     retry_delay: float = 10.0  # reference delivery.go:75
+    publish_confirm_timeout: float = 30.0  # Convert hand-off confirmation
     health_port: int = 0  # 0 = disabled
 
     @classmethod
@@ -71,5 +72,8 @@ class Config:
             env.get("MAX_JOB_RETRIES", config.max_job_retries)
         )
         config.retry_delay = float(env.get("RETRY_DELAY", config.retry_delay))
+        config.publish_confirm_timeout = float(
+            env.get("PUBLISH_CONFIRM_TIMEOUT", config.publish_confirm_timeout)
+        )
         config.health_port = int(env.get("HEALTH_PORT", config.health_port))
         return config

@@ -19,6 +19,7 @@ import struct
 import threading
 import urllib.parse
 
+from ..parallel import default_engine
 from . import bencode
 from .peer import (
     BLOCK_SIZE,
@@ -58,10 +59,13 @@ def make_torrent(
             b"piece length": piece_length,
             b"length": len(blob),
         }
-    pieces = b"".join(
-        hashlib.sha1(blob[i : i + piece_length]).digest()
-        for i in range(0, max(len(blob), 1), piece_length)
+    piece_digests = default_engine().sha1_many(
+        [
+            blob[i : i + piece_length]
+            for i in range(0, max(len(blob), 1), piece_length)
+        ]
     )
+    pieces = b"".join(piece_digests)
     info[b"pieces"] = pieces
     meta: dict = {b"info": info}
     if trackers:

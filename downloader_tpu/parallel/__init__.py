@@ -1,0 +1,42 @@
+"""TPU-native compute path: batched, mesh-sharded piece digests.
+
+The reference pipeline's only compute-bound work is SHA-1 verification of
+BitTorrent pieces (reference internal/downloader/torrent delegates it to
+anacrolix/torrent, which hashes every piece on the CPU; our own peer
+engine does it in fetch/peer.py:364). Everything else in the service is
+network or disk I/O.
+
+This package lifts that hot op onto the accelerator the idiomatic JAX
+way: pieces are packed on the host into padded message-schedule blocks,
+the SHA-1 compression runs as a single fused XLA computation batched over
+pieces (``lax.scan`` over blocks, vectorised uint32 ops over the piece
+axis — VPU work, static shapes, no host round-trips per piece), and the
+batch shards over a ``jax.sharding.Mesh`` with ``shard_map`` so a
+multi-chip host verifies N× pieces per step, with a single ``psum``
+reducing the mismatch count across the mesh.
+
+``DigestEngine`` is the facade the rest of the framework uses; it falls
+back to hashlib for tiny batches or when JAX is unavailable, so the I/O
+pipeline never depends on an accelerator being present.
+"""
+
+from .engine import DigestEngine, default_engine
+from .pack import pack_pieces
+
+__all__ = [
+    "DigestEngine",
+    "default_engine",
+    "pack_pieces",
+    "sha1_blocks",
+    "digest_to_bytes",
+]
+
+
+def __getattr__(name):
+    # sha1/mesh import jax at module load; keep that lazy so the I/O
+    # pipeline (and the hashlib fallback) works on jax-less installs.
+    if name in ("sha1_blocks", "digest_to_bytes"):
+        from . import sha1
+
+        return getattr(sha1, name)
+    raise AttributeError(name)

@@ -1,0 +1,182 @@
+"""DigestEngine: the facade the I/O pipeline hashes through.
+
+Policy lives here, math lives in sha1.py/mesh.py:
+
+- **Backend selection.** ``auto`` uses the accelerator batch path when
+  JAX imports and the batch is at least ``min_batch`` pieces; tiny
+  batches and JAX-less installs fall back to hashlib (per-piece stream
+  hashing beats device dispatch overhead for one piece). ``hashlib``
+  forces the fallback; ``jax`` forces the device path.
+- **Mesh sharding.** With more than one device the batch is padded to a
+  multiple of the mesh size and verified via shard_map + psum
+  (parallel/mesh.py); single-device just jits.
+- **Shape bucketing.** Piece counts are padded up to the next power of
+  two (times the mesh size) so repeated batches reuse the compiled
+  executable instead of re-tracing per torrent.
+
+The pipeline's callers are fetch/peer.py (resume re-verification of
+on-disk pieces) and fetch/seeder.py (hashing pieces when building test
+torrents). The streaming per-piece check on the live peer path stays on
+hashlib by design: pieces arrive one at a time there.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Sequence
+
+import numpy as np
+
+from ..utils import get_logger
+from .pack import digests_to_bytes, pack_pieces
+
+log = get_logger("parallel")
+
+_DEFAULT_MIN_BATCH = 8
+
+
+def _next_pow2(n: int) -> int:
+    power = 1
+    while power < n:
+        power *= 2
+    return power
+
+
+class DigestEngine:
+    """Batched SHA-1 with automatic accelerator offload."""
+
+    def __init__(
+        self,
+        backend: str = "auto",
+        min_batch: int = _DEFAULT_MIN_BATCH,
+        devices=None,
+    ):
+        if backend not in ("auto", "jax", "hashlib"):
+            raise ValueError(f"unknown digest backend {backend!r}")
+        self._backend = backend
+        self._min_batch = max(1, min_batch)
+        self._devices = devices
+        self._lock = threading.Lock()
+        self._jax_state = None  # lazily built: (pad_to, verify_fn, digest_fn)
+        self._jax_failed = False
+
+    # -- backend plumbing ------------------------------------------------
+
+    def _jax(self):
+        """Build (or recall) the device path; None if unavailable."""
+        if self._backend == "hashlib":
+            return None
+        if self._jax_failed:
+            if self._backend == "jax":
+                raise RuntimeError(
+                    "digest backend 'jax' was forced but device "
+                    "initialisation failed earlier this process"
+                )
+            return None
+        with self._lock:
+            if self._jax_state is not None:
+                return self._jax_state
+            try:
+                import jax
+
+                from . import mesh as mesh_mod
+                from .sha1 import sha1_blocks_jit
+
+                devices = self._devices or jax.devices()
+                if len(devices) > 1:
+                    device_mesh = mesh_mod.default_mesh(devices)
+                    verify_fn = mesh_mod.sharded_verify_fn(device_mesh)
+                    digest_fn = mesh_mod.sharded_digest_fn(device_mesh)
+                    pad_to = len(devices)
+                    kind = f"jax-sharded[{len(devices)}]"
+                else:
+                    verify_fn = mesh_mod.verify_step_jit
+                    digest_fn = sha1_blocks_jit
+                    pad_to = 1
+                    kind = "jax"
+                self._jax_state = (pad_to, verify_fn, digest_fn, kind)
+                log.with_field("backend", kind).info("digest engine ready")
+                return self._jax_state
+            except Exception as exc:  # pragma: no cover - env-dependent
+                self._jax_failed = True
+                if self._backend == "jax":
+                    raise
+                log.warning(f"jax digest path unavailable ({exc}); "
+                            "falling back to hashlib")
+                return None
+
+    def _use_device(self, batch_size: int) -> bool:
+        if self._backend == "hashlib":
+            return False
+        if self._backend == "auto" and batch_size < self._min_batch:
+            return False
+        return self._jax() is not None
+
+    def _bucket(self, count: int) -> int:
+        """Batch padding target: a power-of-two number of whole shards.
+
+        Must stay a multiple of the mesh size (shard_map requires the
+        piece axis to divide evenly) while bucketing to limit re-traces.
+        """
+        pad_to, _, _, _ = self._jax_state
+        shards = -(-count // pad_to)
+        return pad_to * _next_pow2(shards)
+
+    # -- public API ------------------------------------------------------
+
+    def sha1_many(self, pieces: Sequence[bytes]) -> list[bytes]:
+        """Digest a batch of byte strings; order-preserving."""
+        if not pieces:
+            return []
+        if not self._use_device(len(pieces)):
+            return [hashlib.sha1(p).digest() for p in pieces]
+        pad_to, _, digest_fn, _ = self._jax_state
+        blocks, nblocks = pack_pieces(pieces, pad_to=self._bucket(len(pieces)))
+        out = digest_fn(blocks, nblocks)
+        return digests_to_bytes(np.asarray(out), len(pieces))
+
+    def verify_pieces(
+        self, pieces: Sequence[bytes], expected: Sequence[bytes]
+    ) -> list[bool]:
+        """Check each piece against its expected 20-byte digest."""
+        if len(pieces) != len(expected):
+            raise ValueError("pieces and expected digests length mismatch")
+        if not pieces:
+            return []
+        if not self._use_device(len(pieces)):
+            return [
+                hashlib.sha1(piece).digest() == digest
+                for piece, digest in zip(pieces, expected)
+            ]
+        _, verify_fn, _, _ = self._jax_state
+        blocks, nblocks = pack_pieces(pieces, pad_to=self._bucket(len(pieces)))
+        want = np.zeros((blocks.shape[0], 5), dtype=np.uint32)
+        for lane, digest in enumerate(expected):
+            if len(digest) != 20:
+                raise ValueError("expected digests must be 20 bytes")
+            want[lane] = np.frombuffer(digest, dtype=">u4").astype(np.uint32)
+        ok, _ = verify_fn(blocks, nblocks, want)
+        return [bool(v) for v in np.asarray(ok)[: len(pieces)]]
+
+    @property
+    def backend_name(self) -> str:
+        state = self._jax_state
+        if self._backend == "hashlib" or self._jax_failed:
+            return "hashlib"
+        if state is None:
+            return f"{self._backend} (lazy)"
+        return state[3]
+
+
+_default_lock = threading.Lock()
+_default: DigestEngine | None = None
+
+
+def default_engine() -> DigestEngine:
+    """Process-wide shared engine (compiled executables are expensive)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = DigestEngine()
+        return _default

@@ -1,0 +1,71 @@
+"""Host-side packing: raw pieces → padded SHA-1 message blocks.
+
+SHA-1 consumes 512-bit (64-byte) blocks of big-endian uint32 words after
+the standard Merkle–Damgård padding (0x80, zeros, 64-bit bit length).
+Packing happens once on the host with numpy so the device computation
+(parallel/sha1.py) sees only static-shaped uint32 arrays: a batch of P
+pieces becomes ``blocks`` of shape (P, B, 16) plus a per-piece valid-block
+mask — pieces of different lengths (a torrent's final piece is usually
+short) batch together, with the mask freezing each lane's state once its
+own blocks run out.
+
+Shapes are bucketed (piece count to a multiple of ``pad_to``, block count
+implicitly by the dominant piece length) so repeated calls hit the same
+compiled XLA executable instead of re-tracing.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+def pad_piece(piece: bytes) -> np.ndarray:
+    """Pad one message per FIPS 180-4 → (B, 16) big-endian uint32 words."""
+    length = len(piece)
+    num_blocks = (length + 9 + 63) // 64
+    buf = np.zeros(num_blocks * 64, dtype=np.uint8)
+    buf[:length] = np.frombuffer(piece, dtype=np.uint8)
+    buf[length] = 0x80
+    bit_length = np.array([length * 8], dtype=">u8")
+    buf[-8:] = np.frombuffer(bit_length.tobytes(), dtype=np.uint8)
+    words = buf.view(">u4").astype(np.uint32)
+    return words.reshape(num_blocks, 16)
+
+
+def pack_pieces(
+    pieces: Sequence[bytes], pad_to: int = 1
+) -> tuple[np.ndarray, np.ndarray]:
+    """Pack a batch of pieces for the batched SHA-1 kernel.
+
+    Returns ``(blocks, nblocks)``:
+
+    - ``blocks``: (P, B, 16) uint32, where P = len(pieces) rounded up to a
+      multiple of ``pad_to`` and B = max block count in the batch. Padding
+      lanes and padding blocks are zero.
+    - ``nblocks``: (P,) int32, valid block count per lane (0 for padding
+      lanes — their digests are garbage and must be ignored).
+    """
+    if not pieces:
+        padded_count = max(pad_to, 1)
+        return (
+            np.zeros((padded_count, 1, 16), dtype=np.uint32),
+            np.zeros(padded_count, dtype=np.int32),
+        )
+    padded = [pad_piece(piece) for piece in pieces]
+    count = len(padded)
+    padded_count = -(-count // pad_to) * pad_to
+    max_blocks = max(p.shape[0] for p in padded)
+    blocks = np.zeros((padded_count, max_blocks, 16), dtype=np.uint32)
+    nblocks = np.zeros(padded_count, dtype=np.int32)
+    for lane, words in enumerate(padded):
+        blocks[lane, : words.shape[0]] = words
+        nblocks[lane] = words.shape[0]
+    return blocks, nblocks
+
+
+def digests_to_bytes(digests: np.ndarray, count: int) -> list[bytes]:
+    """(P, 5) uint32 state words → ``count`` 20-byte digests."""
+    words = np.asarray(digests, dtype=np.uint32)[:count].astype(">u4")
+    return [row.tobytes() for row in words]

@@ -56,6 +56,10 @@ class _PendingPublish:
     headers: dict
     attempts: int = 0
     not_before: float = 0.0
+    # set once the message is actually on the broker; publish(wait=...)
+    # blocks on this so callers can ack upstream work only after the
+    # hand-off is durable
+    flushed: threading.Event = field(default_factory=threading.Event)
 
 
 @dataclass
@@ -174,15 +178,48 @@ class QueueClient:
         self._reconcile()  # start consumers now, not at the next tick
         return sink
 
-    def publish(self, topic: str, body: bytes, headers: dict | None = None) -> None:
+    def publish(
+        self,
+        topic: str,
+        body: bytes,
+        headers: dict | None = None,
+        wait: float | None = None,
+    ) -> bool:
         """Enqueue for the publisher thread; survives broker outages by
         retrying with exponential backoff, and is drained (not dropped) at
-        shutdown before done() completes."""
+        shutdown before done() completes.
+
+        With ``wait`` set, blocks up to that many seconds until the
+        message is confirmed on the broker and returns whether it was —
+        callers that must not lose the message (the daemon's Convert
+        hand-off, Delivery.error retries) pass a timeout and only ack
+        their upstream delivery on True. Fire-and-forget (`wait=None`)
+        returns True immediately."""
+        pending = _PendingPublish(topic=topic, body=body, headers=headers or {})
         with self._lock:
             self._publishes_pending += 1
-        self._publish_buffer.put(
-            _PendingPublish(topic=topic, body=body, headers=headers or {})
-        )
+        self._publish_buffer.put(pending)
+        if wait is None:
+            return True
+        return pending.flushed.wait(wait)
+
+    def stop_consuming(self) -> None:
+        """Close all shard consumers and forget them so the supervisor
+        does not recreate them. Closing a channel with unacked deliveries
+        requeues them at the broker (AMQP semantics; the memory broker
+        matches), so messages sitting undispatched in the sink at
+        shutdown go straight back to the queue instead of ping-ponging
+        between a live consumer and the drain loop."""
+        with self._lock:
+            shards = list(self._shards.values())
+            self._shards = {}
+        for shard in shards:
+            if shard.channel is not None:
+                try:
+                    shard.channel.close()
+                except BrokerError:
+                    pass
+                shard.channel = None
 
     def done(self, poll_interval: float | None = None) -> None:
         """Block until, after cancellation, in-flight deliveries settle and
@@ -253,7 +290,10 @@ class QueueClient:
                 self._publisher_channel = channel
                 self._publisher_alive = True
             threading.Thread(
-                target=self._publish_loop, name="queue-publisher", daemon=True
+                target=self._publish_loop,
+                args=(channel,),
+                name="queue-publisher",
+                daemon=True,
             ).start()
             log.info("publisher created")
 
@@ -372,12 +412,22 @@ class QueueClient:
             self._publish_rk[topic] = (index + 1) % self._num_queues
         return self.shard_name(topic, index)
 
-    def _publish_loop(self) -> None:
+    def _publish_loop(self, my_channel: Channel) -> None:
         # keeps running after cancellation until the buffer drains (or the
         # drain deadline passes), so Convert messages enqueued by jobs that
-        # were just acked are not dropped on shutdown
+        # were just acked are not dropped on shutdown.
+        #
+        # Generation guard: ``my_channel`` is the channel this thread was
+        # spawned with. After a reconnect the supervisor installs a fresh
+        # channel and thread; a stale thread that wakes up later must exit
+        # without touching shared publisher state (it no longer owns it),
+        # otherwise publisher threads accumulate across flapping
+        # reconnects.
         drain_deadline: float | None = None
         while True:
+            with self._lock:
+                if self._publisher_channel is not my_channel:
+                    return  # superseded; a newer generation owns the state
             if self._token.cancelled():
                 if drain_deadline is None:
                     drain_deadline = time.monotonic() + self._drain_timeout
@@ -390,6 +440,10 @@ class QueueClient:
                 pending = self._publish_buffer.get(timeout=0.2)
             except queue_mod.Empty:
                 continue
+            with self._lock:
+                if self._publisher_channel is not my_channel:
+                    self._publish_buffer.put(pending)  # hand to successor
+                    return
             delay = pending.not_before - time.monotonic()
             if delay > 0:
                 time.sleep(min(delay, 0.5))
@@ -397,13 +451,9 @@ class QueueClient:
                     self._publish_buffer.put(pending)
                     continue
             routing_key = self._next_rk(pending.topic)
-            with self._lock:
-                channel = self._publisher_channel
             try:
-                if channel is None:
-                    raise BrokerError("no publisher channel")
-                self._ensure_topology(channel, pending.topic)
-                channel.publish(
+                self._ensure_topology(my_channel, pending.topic)
+                my_channel.publish(
                     pending.topic,
                     routing_key,
                     pending.body,
@@ -413,6 +463,7 @@ class QueueClient:
                 with self._lock:
                     self.stats.published += 1
                     self._publishes_pending -= 1
+                pending.flushed.set()
                 log.with_fields(topic=pending.topic, rk=routing_key).debug(
                     "published message"
                 )
@@ -435,7 +486,11 @@ class QueueClient:
                 )
                 self._publish_buffer.put(pending)
                 with self._lock:
-                    self._publisher_alive = False
+                    if self._publisher_channel is my_channel:
+                        self._publisher_alive = False
+                        self._publisher_channel = None
                 return  # thread exits; supervisor recreates with a fresh channel
         with self._lock:
-            self._publisher_alive = False
+            if self._publisher_channel is my_channel:
+                self._publisher_alive = False
+                self._publisher_channel = None

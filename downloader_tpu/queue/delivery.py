@@ -9,10 +9,11 @@ header (delivery.go:31-42, tolerating missing/garbage values) and exposes:
   requeue=false), with ``requeue=True`` opt-in for transient failures —
   the knob whose absence causes the reference's starve-on-failure bug
   (cmd:119-149 leaves failures unacked forever),
-- ``error()`` — the retry path: ack, then republish with X-Retries+1 after
-  a delay (delivery.go:66-84's self-described dead-letter HACK — dead code
-  there, wired up and non-blocking here: the delay is a timer, not a
-  10-second sleep on the worker thread).
+- ``error()`` — the retry path: republish with X-Retries+1, confirm the
+  republish reached the broker, then ack the original (delivery.go:66-84's
+  self-described dead-letter HACK — dead code there, wired up here; and
+  no 10-second sleep on the worker thread: retry pacing happens on the
+  consume side).
 """
 
 from __future__ import annotations
@@ -34,7 +35,8 @@ class Delivery:
         message: Message,
         channel: Channel,
         on_settled: Callable[["Delivery"], None] = lambda d: None,
-        publisher: Callable[[str, bytes, dict], None] | None = None,
+        publisher: "Callable[..., bool] | None" = None,
+        publish_confirm_timeout: float = 30.0,
     ):
         self.message = message
         self.body = message.body
@@ -43,6 +45,7 @@ class Delivery:
         self._channel = channel
         self._on_settled = on_settled
         self._publisher = publisher
+        self._publish_confirm_timeout = publish_confirm_timeout
         self._settled = False
         self._lock = threading.Lock()
 
@@ -77,20 +80,29 @@ class Delivery:
 
     def error(self) -> None:
         """Retry the message: republish with an incremented X-Retries, then
-        ack the original. Republish happens FIRST and — when the delivery
-        came through a QueueClient — through its buffered publisher, which
-        survives broker outages with backoff and is drained at shutdown, so
-        a broker hiccup between ack and republish cannot lose the job (the
-        reference's ack-sleep-republish hack can, delivery.go:73-84).
-        Retry pacing is the consumer's job (the daemon delays retried
-        messages before processing)."""
+        ack the original. The republish must be CONFIRMED on the broker
+        before the ack — when the delivery came through a QueueClient the
+        publisher is its buffered publish with ``wait=`` (blocks until the
+        message is actually on the wire); a buffered-but-unflushed
+        republish followed by an ack would lose the job if the process
+        died before the flush (the reference's ack-sleep-republish hack
+        has the same window, delivery.go:73-84). If the hand-off cannot
+        be confirmed in time, the original is requeue-nacked instead —
+        the broker redelivers it and the retry count stalls one round,
+        which is at-least-once, not loss. Retry pacing is the consumer's
+        job (the daemon delays retried messages before processing)."""
         if not self._settle():
             return
         headers = dict(self.message.headers)
         headers[RETRY_HEADER] = self.retries + 1
         try:
             if self._publisher is not None:
-                self._publisher(self.message.exchange, self.body, headers)
+                confirmed = self._publisher(
+                    self.message.exchange,
+                    self.body,
+                    headers,
+                    wait=self._publish_confirm_timeout,
+                )
             else:
                 self._channel.publish(
                     self.message.exchange,
@@ -98,10 +110,13 @@ class Delivery:
                     self.body,
                     headers=headers,
                 )
+                confirmed = True
         except BrokerError as exc:
-            # republish failed: requeue-nack so the broker redelivers the
-            # original — never ack what we failed to hand off
             log.warning(f"failed to republish retried message: {exc}")
+            confirmed = False
+        if not confirmed:
+            # never ack what we failed to hand off: requeue the original
+            log.warning("retry republish unconfirmed; requeueing original")
             try:
                 self._channel.nack(self.message.delivery_tag, requeue=True)
             except BrokerError as nack_exc:

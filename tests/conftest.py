@@ -3,13 +3,28 @@
 Forces JAX onto a virtual 8-device CPU mesh so the sharded digest path
 (downloader_tpu/parallel) is exercised hermetically, per the driver's
 multi-chip validation scheme. Must run before jax is imported anywhere.
+
+The environment already exports ``JAX_PLATFORMS=axon`` (the real-TPU
+tunnel), so a plain ``setdefault`` would silently leave tests on the one
+real chip: both the env var and ``xla_force_host_platform_device_count``
+must be overridden, and ``jax.config`` updated in case a plugin
+re-asserts the platform after import.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+
+def pytest_configure(config):
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except ImportError:  # pragma: no cover - jax is baked into the image
+        pass

@@ -11,6 +11,7 @@ import pytest
 
 from downloader_tpu.queue import MemoryBroker, QueueClient
 from downloader_tpu.queue.broker import BrokerError
+from downloader_tpu.queue.delivery import Delivery
 from downloader_tpu.utils.cancel import CancelToken
 
 
@@ -285,3 +286,104 @@ class TestShutdownDurability:
         assert max(d.retries for d in got) == 1
         for d in got:
             d.ack()
+
+
+class TestPublishConfirm:
+    def test_publish_wait_confirms(self, broker, token):
+        client = make_client(broker, token)
+        # no consumer: the publish path ensures topology itself
+        assert client.publish("t", b"x", wait=5.0) is True
+        assert broker.queue_depth("t-0") + broker.queue_depth("t-1") == 1
+
+    def test_publish_wait_times_out_when_broker_down(self, broker, token):
+        down = {"v": False}
+
+        def connect():
+            if down["v"]:
+                raise BrokerError("down")
+            return broker.connect()
+
+        client = QueueClient(
+            token, connect, supervisor_interval=0.05, drain_timeout=1.0
+        )
+        client.consume("t")
+        assert client.publish("t", b"warm", wait=5.0)  # publisher is up
+        down["v"] = True
+        broker.drop_connections()
+        assert client.publish("t", b"x", wait=0.3) is False
+        down["v"] = False
+
+    def test_fire_and_forget_returns_true(self, broker, token):
+        client = make_client(broker, token)
+        client.consume("t")
+        assert client.publish("t", b"x") is True
+
+
+class TestStopConsuming:
+    def test_stop_consuming_requeues_undispatched(self, broker, token):
+        client = make_client(broker, token)
+        client.set_prefetch(0)
+        sink = client.consume("t")
+        for i in range(5):
+            client.publish("t", b"m%d" % i, wait=5.0)
+        deliveries = [sink.get(timeout=2) for _ in range(5)]
+        client.stop_consuming()
+        # closing the shard channels requeued all unacked messages
+        assert broker.queue_depth("t-0") + broker.queue_depth("t-1") == 5
+        # and nacking the stranded deliveries afterwards is harmless
+        for d in deliveries:
+            d.nack(requeue=True)
+        assert broker.queue_depth("t-0") + broker.queue_depth("t-1") == 5
+
+    def test_supervisor_does_not_resurrect_stopped_consumers(
+        self, broker, token
+    ):
+        client = make_client(broker, token)
+        sink = client.consume("t")
+        client.stop_consuming()
+        time.sleep(0.2)  # several supervisor ticks
+        client.publish("t", b"x", wait=5.0)
+        with pytest.raises(queue_mod.Empty):
+            sink.get(timeout=0.3)
+
+
+class TestPublisherGeneration:
+    def test_no_duplicate_publisher_threads_after_flapping(self, broker, token):
+        client = make_client(broker, token)
+        client.consume("t")
+        for _ in range(5):
+            broker.drop_connections()
+            time.sleep(0.15)
+        time.sleep(0.5)  # let stale generations notice and exit
+        publishers = [
+            t for t in threading.enumerate() if t.name == "queue-publisher"
+        ]
+        assert len(publishers) <= 1
+        # and the surviving generation still publishes
+        assert client.publish("t", b"after-flap", wait=5.0) is True
+
+
+class TestErrorConfirmation:
+    def test_error_with_unconfirmed_publish_requeues_original(self, broker):
+        # wire a Delivery whose publisher buffers but never flushes
+        connection = broker.connect()
+        channel = connection.channel()
+        channel.declare_exchange("t")
+        channel.declare_queue("t-0")
+        channel.bind_queue("t-0", "t", "t-0")
+        channel.publish("t", "t-0", b"job")
+        got = []
+        channel.consume("t-0", got.append)
+        assert len(got) == 1
+        delivery = Delivery(
+            got[0],
+            channel,
+            publisher=lambda *a, **k: False,  # unconfirmed hand-off
+            publish_confirm_timeout=0.1,
+        )
+        delivery.error()
+        # original requeued and redelivered, not lost — and no retried
+        # copy with an incremented X-Retries was ever acked through
+        assert len(got) == 2
+        assert got[1].body == b"job" and got[1].redelivered
+        assert got[1].headers.get("X-Retries", 0) == 0
