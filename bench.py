@@ -1,0 +1,189 @@
+"""End-to-end benchmark: queue-driven fetch→scan→upload throughput.
+
+The reference publishes no numbers (BASELINE.md; its README has no
+performance claims), so the baseline measured here is the reference's
+own CONFIGURATION run on this machine: effective job concurrency 1
+(prefetch 1 + a single job goroutine, reference cmd/downloader/
+downloader.go:62,100-103). The headline value is the same pipeline at
+this framework's defaults (N concurrent workers); ``vs_baseline`` is the
+speedup over the reference-shaped run.
+
+Everything is hermetic and local: a threaded HTTP file server as the
+source, the in-memory at-least-once broker as the queue, and the
+in-process S3 stub as the object store, so the number measures the
+framework (dispatch, verification, disk, upload path), not the network.
+
+Prints exactly one JSON line on stdout:
+  {"metric": "e2e_fetch_upload_MBps", "value": N, "unit": "MB/s",
+   "vs_baseline": N}
+Details go to stderr.
+
+Env knobs: BENCH_JOBS (default 12), BENCH_MB (MB per job, default 32),
+BENCH_CONCURRENCY (default 6).
+"""
+
+from __future__ import annotations
+
+import functools
+import http.server
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+# the pipeline's per-job info logging is measurable overhead at loopback
+# speeds; bench at warning level unless asked otherwise
+os.environ.setdefault("LOG_LEVEL", "warning")
+
+from downloader_tpu.daemon.app import Daemon, build_connection_factory
+from downloader_tpu.daemon.config import Config
+from downloader_tpu.fetch import DispatchClient, HTTPBackend
+from downloader_tpu.queue import QueueClient
+from downloader_tpu.store import Credentials, S3Client, Uploader
+from downloader_tpu.store.stub import S3Stub
+from downloader_tpu.utils.cancel import CancelToken
+from downloader_tpu.wire import Convert, Download, Media
+
+
+def _log(message: str) -> None:
+    print(message, file=sys.stderr, flush=True)
+
+
+class _QuietHandler(http.server.SimpleHTTPRequestHandler):
+    def log_message(self, *args):
+        pass
+
+
+def _serve_payload(directory: str):
+    handler = functools.partial(_QuietHandler, directory=directory)
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def run_config(
+    jobs: int, mb_per_job: int, concurrency: int, prefetch: int, site: str
+) -> float:
+    """Drain ``jobs`` download jobs through the full daemon pipeline;
+    returns MB/s end-to-end (first enqueue → last Convert consumed)."""
+    workdir = tempfile.mkdtemp(prefix="bench-dl-")
+    token = CancelToken()
+    httpd, base_url = _serve_payload(site)
+    stub = S3Stub(credentials=Credentials("bench", "bench")).start()
+    try:
+        config = Config(
+            broker="memory",
+            base_dir=workdir,
+            concurrency=concurrency,
+            prefetch=prefetch,
+            publish_confirm_timeout=60.0,
+        )
+        connect = build_connection_factory(config)
+        client = QueueClient(token, connect, drain_timeout=10.0)
+        client.set_prefetch(config.prefetch)
+        dispatcher = DispatchClient(
+            token,
+            workdir,
+            [HTTPBackend(progress_interval=5.0, timeout=120.0)],
+        )
+        uploader = Uploader(
+            config.bucket,
+            S3Client(stub.endpoint, Credentials("bench", "bench")),
+        )
+        daemon = Daemon(token, client, dispatcher, uploader, config)
+        runner = threading.Thread(target=daemon.run, daemon=True)
+        runner.start()
+
+        producer = connect().channel()
+        producer.declare_exchange(config.consume_topic)
+        for i in range(client._num_queues):
+            name = QueueClient.shard_name(config.consume_topic, i)
+            producer.declare_queue(name)
+            producer.bind_queue(name, config.consume_topic, name)
+
+        converts: list[Convert] = []
+        convert_channel = connect().channel()
+        convert_channel.declare_exchange(config.publish_topic)
+        convert_channel.declare_queue("bench-sink")
+        for i in range(client._num_queues):
+            convert_channel.bind_queue(
+                "bench-sink",
+                config.publish_topic,
+                QueueClient.shard_name(config.publish_topic, i),
+            )
+
+        def on_convert(message):
+            converts.append(Convert.unmarshal(message.body))
+            convert_channel.ack(message.delivery_tag)
+
+        convert_channel.consume("bench-sink", on_convert)
+
+        start = time.monotonic()
+        for i in range(jobs):
+            body = Download(
+                media=Media(id=f"bench-{i}", source_uri=f"{base_url}/payload.mkv")
+            ).marshal()
+            producer.publish(
+                config.consume_topic,
+                QueueClient.shard_name(config.consume_topic, i % client._num_queues),
+                body,
+            )
+        deadline = time.monotonic() + 600
+        while len(converts) < jobs:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"bench timed out: {len(converts)}/{jobs} converts"
+                )
+            time.sleep(0.02)
+        elapsed = time.monotonic() - start
+
+        token.cancel()
+        runner.join(timeout=30)
+        return jobs * mb_per_job / elapsed
+    finally:
+        token.cancel()
+        httpd.shutdown()
+        stub.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main() -> None:
+    jobs = int(os.environ.get("BENCH_JOBS", 12))
+    mb_per_job = int(os.environ.get("BENCH_MB", 32))
+    concurrency = int(os.environ.get("BENCH_CONCURRENCY", 6))
+
+    site = tempfile.mkdtemp(prefix="bench-site-")
+    try:
+        payload_path = os.path.join(site, "payload.mkv")
+        with open(payload_path, "wb") as sink:
+            chunk = os.urandom(1024 * 1024)
+            for _ in range(mb_per_job):
+                sink.write(chunk)
+
+        _log(f"bench: {jobs} jobs x {mb_per_job} MB")
+        _log("bench: reference-shaped baseline (concurrency 1, prefetch 1)")
+        baseline = run_config(jobs, mb_per_job, 1, 1, site)
+        _log(f"bench: baseline {baseline:.1f} MB/s")
+        _log(f"bench: framework defaults (concurrency {concurrency})")
+        value = run_config(jobs, mb_per_job, concurrency, concurrency, site)
+        _log(f"bench: framework {value:.1f} MB/s")
+
+        print(
+            json.dumps(
+                {
+                    "metric": "e2e_fetch_upload_MBps",
+                    "value": round(value, 1),
+                    "unit": "MB/s",
+                    "vs_baseline": round(value / baseline, 2),
+                }
+            )
+        )
+    finally:
+        shutil.rmtree(site, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

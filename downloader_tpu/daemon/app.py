@@ -74,6 +74,10 @@ class Daemon:
         self.stats = DaemonStats()
         self._workers: list[threading.Thread] = []
 
+    @property
+    def worker_count(self) -> int:
+        return len(self._workers)
+
     # -- job pipeline ----------------------------------------------------
 
     def process_delivery(self, delivery: Delivery) -> None:
@@ -276,5 +280,17 @@ def serve(
     uploader = Uploader.from_env(config.bucket)
 
     daemon = Daemon(token, client, dispatcher, uploader, config)
-    daemon.run()
+
+    health = None
+    if config.health_port > 0:
+        from .health import HealthServer
+
+        health = HealthServer(
+            daemon, client, config.health_port, config.health_host
+        ).start()
+    try:
+        daemon.run()
+    finally:
+        if health is not None:
+            health.stop()
     return 0

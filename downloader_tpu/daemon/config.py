@@ -46,6 +46,7 @@ class Config:
     retry_delay: float = 10.0  # reference delivery.go:75
     publish_confirm_timeout: float = 30.0  # Convert hand-off confirmation
     health_port: int = 0  # 0 = disabled
+    health_host: str = "127.0.0.1"  # bind loopback unless told otherwise
 
     @classmethod
     def from_env(cls, environ: Mapping[str, str] | None = None) -> "Config":
@@ -76,4 +77,5 @@ class Config:
             env.get("PUBLISH_CONFIRM_TIMEOUT", config.publish_confirm_timeout)
         )
         config.health_port = int(env.get("HEALTH_PORT", config.health_port))
+        config.health_host = env.get("HEALTH_HOST", config.health_host)
         return config

@@ -1,0 +1,112 @@
+"""Health and metrics endpoint.
+
+The reference has no health endpoint and no metrics — logging only
+(SURVEY.md §5 "Metrics / logging / observability"); this is one of the
+rebuild's deliberate additions (SURVEY.md §7 step 9). A tiny stdlib HTTP
+server exposes:
+
+- ``GET /healthz`` — JSON liveness: daemon worker count, broker
+  connection state, in-flight/processed counters. 200 when the broker
+  connection is up, 503 when it is down (so an orchestrator can restart
+  a wedged instance).
+- ``GET /metrics`` — Prometheus text exposition of the daemon and queue
+  counters (no client library needed; the format is plain text).
+
+Enabled by ``HEALTH_PORT`` (0 = disabled, the default); binds loopback
+unless ``HEALTH_HOST`` says otherwise.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+
+from ..utils import get_logger
+
+log = get_logger("daemon.health")
+
+
+class HealthServer:
+    def __init__(self, daemon, client, port: int, host: str = "127.0.0.1"):
+        self._daemon = daemon
+        self._client = client
+        health = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    code, body, ctype = health._healthz()
+                elif self.path == "/metrics":
+                    code, body, ctype = health._metrics()
+                else:
+                    code, body, ctype = 404, b"not found\n", "text/plain"
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="health", daemon=True
+        )
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "HealthServer":
+        self._thread.start()
+        log.with_field("port", self.port).info("health endpoint listening")
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()  # release the listening socket now
+
+    # -- views -----------------------------------------------------------
+
+    def _connected(self) -> bool:
+        return bool(self._client.connected())
+
+    def _counters(self) -> dict:
+        stats = self._daemon.stats
+        queue_stats = self._client.stats
+        return {
+            "jobs_processed": stats.processed,
+            "jobs_failed": stats.failed,
+            "jobs_retried": stats.retried,
+            "jobs_dropped": stats.dropped,
+            "queue_published": queue_stats.published,
+            "queue_delivered": queue_stats.delivered,
+            "queue_publish_retries": queue_stats.publish_retries,
+            "queue_reconnects": queue_stats.reconnects,
+            "queue_consumer_errors": queue_stats.consumer_errors,
+        }
+
+    def _healthz(self) -> tuple[int, bytes, str]:
+        connected = self._connected()
+        payload = {
+            "status": "ok" if connected else "degraded",
+            "broker_connected": connected,
+            "workers": self._daemon.worker_count,
+            **self._counters(),
+        }
+        code = 200 if connected else 503
+        return code, (json.dumps(payload) + "\n").encode(), "application/json"
+
+    def _metrics(self) -> tuple[int, bytes, str]:
+        lines = []
+        for name, value in self._counters().items():
+            metric = f"downloader_{name}"
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} {value}")
+        metric = "downloader_broker_connected"
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {1 if self._connected() else 0}")
+        body = ("\n".join(lines) + "\n").encode()
+        return 200, body, "text/plain; version=0.0.4"

@@ -152,6 +152,15 @@ class QueueClient:
     def set_prefetch(self, prefetch: int) -> None:
         self._prefetch = prefetch
 
+    def connected(self) -> bool:
+        """Whether the broker connection is currently up (health checks)."""
+        with self._lock:
+            connection = self._connection
+        try:
+            return connection is not None and not connection.is_closed()
+        except BrokerError:
+            return False
+
     @staticmethod
     def shard_name(topic: str, index: int) -> str:
         return f"{topic}-{index}"  # reference getRk, client.go:376-378

@@ -35,10 +35,15 @@ class MemoryBroker:
         self._pump_state_lock = threading.Lock()
         self._pumping: set[int] = set()  # thread idents currently pumping
         self._pump_again: set[int] = set()
+        # simulate a sustained outage: drop_connections() alone lets
+        # clients reconnect on their next supervisor tick
+        self.refuse_connections = False
 
     # -- wiring ----------------------------------------------------------
 
     def connect(self) -> "MemoryConnection":
+        if self.refuse_connections:
+            raise BrokerError("connection refused (simulated outage)")
         conn = MemoryConnection(self)
         with self._lock:
             self._connections.append(conn)

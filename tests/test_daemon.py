@@ -284,3 +284,73 @@ def test_shutdown_with_backlog_requeues_without_spinning(harness):
     # the ping-pong manifests as the client re-consuming each nacked
     # message over and over: delivered would be in the thousands
     assert harness.daemon._client.stats.delivered < 50
+
+
+def test_health_endpoint(harness):
+    """/healthz and /metrics — observability the reference lacks
+    (SURVEY.md §5: logging only, 'No Prometheus/StatsD/health checks')."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from downloader_tpu.daemon.health import HealthServer
+
+    server = HealthServer(harness.daemon, harness.daemon._client, 0, "127.0.0.1")
+    server.start()
+    try:
+        harness.enqueue("h-1", f"{harness.file_server.base}/movie.mkv")
+        assert wait_for(lambda: harness.daemon.stats.processed == 1)
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/healthz"
+        ) as resp:
+            assert resp.status == 200
+            payload = json.loads(resp.read())
+        assert payload["status"] == "ok"
+        assert payload["broker_connected"] is True
+        assert payload["jobs_processed"] == 1
+        assert payload["workers"] == 2
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics"
+        ) as resp:
+            body = resp.read().decode()
+        assert "downloader_jobs_processed 1" in body
+        assert "downloader_broker_connected 1" in body
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/nope"
+        ) as resp:
+            raise AssertionError("expected 404")
+    except urllib.error.HTTPError as err:
+        assert err.code == 404
+    finally:
+        server.stop()
+
+
+def test_health_degraded_when_broker_down(harness):
+    import json
+    import urllib.error
+    import urllib.request
+
+    from downloader_tpu.daemon.health import HealthServer
+
+    server = HealthServer(harness.daemon, harness.daemon._client, 0, "127.0.0.1")
+    server.start()
+    try:
+        # refuse reconnects too — drop alone loses the race against the
+        # supervisor's auto-reconnect (50ms tick in this harness)
+        harness.broker.refuse_connections = True
+        harness.broker.drop_connections()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/healthz"
+            ) as resp:
+                raise AssertionError("expected 503")
+        except urllib.error.HTTPError as err:
+            assert err.code == 503
+            payload = json.loads(err.read())
+            assert payload["status"] == "degraded"
+    finally:
+        harness.broker.refuse_connections = False  # let teardown drain
+        server.stop()
