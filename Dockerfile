@@ -1,0 +1,22 @@
+# 2-stage image, mirroring the reference's builder -> minimal-runtime
+# shape (reference Dockerfile:1-18: golang:alpine build stage, static
+# binary copied into a bare alpine stage). Stage 1 builds the wheel;
+# stage 2 is a slim runtime with only the installed package.
+
+FROM python:3.12-alpine AS builder
+WORKDIR /src
+COPY pyproject.toml README.md ./
+COPY downloader_tpu ./downloader_tpu
+RUN pip install --no-cache-dir build && \
+    python -m build --wheel --outdir /dist
+
+FROM python:3.12-alpine
+RUN adduser -D -u 1000 downloader
+COPY --from=builder /dist/*.whl /tmp/
+RUN pip install --no-cache-dir /tmp/*.whl && rm /tmp/*.whl
+USER downloader
+WORKDIR /home/downloader
+# same operational contract as the reference image (Dockerfile:17-18:
+# ENTRYPOINT of the binary); config is env-var driven, see README.
+ENTRYPOINT ["downloader"]
+CMD ["serve"]

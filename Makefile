@@ -1,0 +1,57 @@
+# Build system, mirroring the reference's Makefile targets
+# (reference Makefile:18-46: all / dep / build / docker-build / gofmt /
+# test / render-circle). The Go static binary's analogue is a stdlib
+# zipapp: one self-contained executable file under bin/.
+
+PYTHON      ?= python3
+APP         := downloader
+BINDIR      := bin
+DOCKER_IMAGE ?= downloader-tpu
+
+.PHONY: all dep build wheel docker-build fmt fmt-fix test bench clean
+
+all: dep build
+
+# The reference's `make dep` fetches Go modules (Makefile:31-33). Runtime
+# deps here are stdlib-only (jax optional); this just verifies the tree
+# imports cleanly so breakage is caught before packaging.
+dep:
+	$(PYTHON) -c "import downloader_tpu, downloader_tpu.cli"
+
+# Single-file executable (zipapp), the static-binary analogue
+# (reference Makefile:24-28 builds bin/downloader with -ldflags '-w -s').
+build:
+	rm -rf $(BINDIR)/.staging
+	mkdir -p $(BINDIR)/.staging
+	cp -r downloader_tpu $(BINDIR)/.staging/
+	find $(BINDIR)/.staging -name '__pycache__' -type d -exec rm -rf {} +
+	printf 'from downloader_tpu.cli import main\nimport sys\nsys.exit(main())\n' \
+	  > $(BINDIR)/.staging/__main__.py
+	$(PYTHON) -m zipapp $(BINDIR)/.staging -o $(BINDIR)/$(APP).pyz \
+	  -p "/usr/bin/env python3" -c
+	rm -rf $(BINDIR)/.staging
+	@echo "built $(BINDIR)/$(APP).pyz"
+
+wheel:
+	$(PYTHON) -m build --wheel --no-isolation --outdir $(BINDIR)/
+
+docker-build:
+	docker build -t $(DOCKER_IMAGE) .
+
+# gofmt analogue (reference Makefile:35-37). No third-party formatter is
+# assumed; hack/fmt.py enforces whitespace/newline/tab hygiene with the
+# stdlib tokenizer. `make fmt` checks, `make fmt-fix` rewrites.
+fmt:
+	$(PYTHON) hack/fmt.py downloader_tpu tests bench.py __graft_entry__.py
+
+fmt-fix:
+	$(PYTHON) hack/fmt.py --fix downloader_tpu tests bench.py __graft_entry__.py
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+bench:
+	$(PYTHON) bench.py
+
+clean:
+	rm -rf $(BINDIR) build dist *.egg-info

@@ -1,0 +1,116 @@
+#!/usr/bin/env python3
+"""Stdlib-only formatting gate — the rebuild's gofmt analogue
+(reference Makefile:35-37 runs gofmt over all packages; CI fails on
+drift). No third-party formatter is assumed in the image, so this
+enforces the mechanical invariants a formatter would: no tabs in
+indentation, no trailing whitespace, exactly one newline at EOF, and
+the file parses. ``--fix`` rewrites files in place; without it the
+script exits 1 listing offenders (the CI mode).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import io
+import sys
+import tokenize
+from pathlib import Path
+
+
+def _string_interior_lines(text: str) -> set[int]:
+    """Line numbers touched by a multi-line string token. Rewriting any
+    of them (including trailing whitespace after the opening quotes or
+    before the closing ones) would change the runtime value of the
+    literal, so the gate leaves every spanned line alone — a gofmt
+    analogue never rewrites string contents. Code sharing those lines is
+    deliberately unchecked; safety beats coverage here."""
+    interior: set[int] = set()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(text).readline)
+        for tok in tokens:
+            if tok.type in (tokenize.STRING, tokenize.FSTRING_MIDDLE):
+                start, end = tok.start[0], tok.end[0]
+                if end > start:
+                    interior.update(range(start, end + 1))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass  # unparsable text is reported by the ast gate instead
+    return interior
+
+
+def check_source(text: str) -> list[str]:
+    problems = []
+    skip = _string_interior_lines(text)
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if lineno in skip:
+            continue
+        stripped = line.rstrip("\n")
+        if stripped != stripped.rstrip():
+            problems.append(f"{lineno}: trailing whitespace")
+        indent = stripped[: len(stripped) - len(stripped.lstrip())]
+        if "\t" in indent:
+            problems.append(f"{lineno}: tab in indentation")
+    if text and not text.endswith("\n"):
+        problems.append("EOF: missing trailing newline")
+    if text.endswith("\n\n"):
+        problems.append("EOF: multiple trailing newlines")
+    return problems
+
+
+def fix_source(text: str) -> str:
+    skip = _string_interior_lines(text)
+    lines = text.splitlines()
+    for i, line in enumerate(lines):
+        if i + 1 in skip:
+            continue
+        line = line.rstrip()
+        indent = line[: len(line) - len(line.lstrip())]
+        if "\t" in indent:
+            line = indent.replace("\t", "    ") + line.lstrip()
+        lines[i] = line
+    return "\n".join(lines).rstrip("\n") + "\n" if lines else ""
+
+
+def iter_py_files(targets: list[str]):
+    for target in targets:
+        path = Path(target)
+        if path.is_dir():
+            yield from sorted(path.rglob("*.py"))
+        elif path.suffix == ".py":
+            yield path
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("targets", nargs="+")
+    parser.add_argument("--fix", action="store_true")
+    args = parser.parse_args()
+
+    failed = 0
+    for path in iter_py_files(args.targets):
+        text = path.read_text()
+        try:
+            ast.parse(text, filename=str(path))
+        except SyntaxError as exc:
+            print(f"{path}:{exc.lineno}: syntax error: {exc.msg}")
+            failed += 1
+            continue
+        problems = check_source(text)
+        if not problems:
+            continue
+        if args.fix:
+            path.write_text(fix_source(text))
+            print(f"fixed {path}")
+        else:
+            for problem in problems:
+                print(f"{path}:{problem}")
+            failed += 1
+
+    if failed and not args.fix:
+        print(f"\n{failed} file(s) need formatting; run `make fmt-fix`")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

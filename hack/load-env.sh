@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Source a .env file into the current shell, for local development —
+# the reference's hack/load-env.sh equivalent (used by its VS Code
+# launch config and modd workflow). Usage: source hack/load-env.sh [file]
+ENV_FILE="${1:-.env}"
+if [[ -f "$ENV_FILE" ]]; then
+  set -a
+  # shellcheck disable=SC1090
+  source "$ENV_FILE"
+  set +a
+  echo "loaded $ENV_FILE"
+else
+  echo "no $ENV_FILE found" >&2
+fi

@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Dependency-drift gate — the rebuild's verify-go-mod.sh analogue
+# (reference hack/verify-go-mod.sh runs `go mod tidy` and fails CI if
+# go.mod/go.sum change). The dependency contract: the package uses only
+# the stdlib plus numpy; jax (the accelerator path) may be imported at
+# module level ONLY under downloader_tpu/parallel/, and must stay lazy
+# everywhere else so the I/O pipeline runs on jax-less installs.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python3 - <<'EOF'
+import ast
+import sys
+from pathlib import Path
+
+STDLIB = sys.stdlib_module_names
+CORE_DEPS = {"numpy"}        # declared in pyproject [project].dependencies
+ACCEL_ONLY = {"jax"}         # allowed at top level only under parallel/
+LAZY_OK = CORE_DEPS | ACCEL_ONLY
+
+failed = 0
+for path in sorted(Path("downloader_tpu").rglob("*.py")):
+    in_parallel = path.parts[1] == "parallel"
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            names = [alias.name.split(".")[0] for alias in node.names]
+        elif isinstance(node, ast.ImportFrom) and node.level == 0:
+            names = [(node.module or "").split(".")[0]]
+        else:
+            continue
+        for name in names:
+            if not name or name in STDLIB or name == "downloader_tpu":
+                continue
+            if name in CORE_DEPS:
+                continue
+            if name in ACCEL_ONLY and (in_parallel or node.col_offset > 0):
+                continue
+            print(f"{path}:{node.lineno}: disallowed import {name!r}")
+            failed += 1
+sys.exit(1 if failed else 0)
+EOF
+echo "verify-deps: OK (stdlib+numpy core, jax confined to parallel/)"

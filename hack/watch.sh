@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Dev watch loop — the reference's modd.conf equivalent (modd.conf:1-4:
+# watch **/*.go -> make -> restart ./bin/downloader). Rebuilds the
+# zipapp and restarts the daemon whenever a source file changes.
+# Stdlib/coreutils only: polls mtimes, no inotify dependency.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+CMD=(${DOWNLOADER_CMD:-python3 -m downloader_tpu serve})
+PID=""
+
+fingerprint() {
+  find downloader_tpu -name '*.py' -newer .watch-stamp 2>/dev/null | head -1
+}
+
+restart() {
+  if [[ -n "$PID" ]] && kill -0 "$PID" 2>/dev/null; then
+    kill "$PID" 2>/dev/null || true
+    wait "$PID" 2>/dev/null || true
+  fi
+  # a broken save must not kill the watch loop (modd keeps watching);
+  # skip the relaunch and wait for the next change instead
+  if ! make build; then
+    echo "watch: build failed, waiting for next change" >&2
+    PID=""
+    return 0
+  fi
+  "${CMD[@]}" &
+  PID=$!
+  echo "watch: restarted (pid $PID)"
+}
+
+trap '[[ -n "$PID" ]] && kill "$PID" 2>/dev/null; rm -f .watch-stamp; exit 0' INT TERM
+
+touch .watch-stamp
+restart
+while sleep 1; do
+  if [[ -n "$(fingerprint)" ]]; then
+    touch .watch-stamp
+    restart
+  fi
+done

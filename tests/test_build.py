@@ -1,0 +1,96 @@
+"""Build-system gates stay green (SURVEY.md §2 rows 9-10: the reference
+ships a Makefile + CI whose `tests` job runs gofmt and a go-mod drift
+check; these are the rebuild's equivalents)."""
+
+import subprocess
+import sys
+import zipfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_fmt_gate_passes():
+    result = subprocess.run(
+        [
+            sys.executable,
+            str(REPO / "hack" / "fmt.py"),
+            "downloader_tpu",
+            "tests",
+            "bench.py",
+            "__graft_entry__.py",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+
+
+def test_dependency_gate_passes():
+    result = subprocess.run(
+        ["bash", str(REPO / "hack" / "verify-deps.sh")],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+
+
+def test_fmt_detects_and_fixes_problems(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("x = 1 \nif x:\n\ty = 2\n\n\n")
+    check = subprocess.run(
+        [sys.executable, str(REPO / "hack" / "fmt.py"), str(bad)],
+        capture_output=True,
+        text=True,
+    )
+    assert check.returncode == 1
+    assert "trailing whitespace" in check.stdout
+    fix = subprocess.run(
+        [sys.executable, str(REPO / "hack" / "fmt.py"), "--fix", str(bad)],
+        capture_output=True,
+        text=True,
+    )
+    assert fix.returncode == 0
+    assert bad.read_text() == "x = 1\nif x:\n    y = 2\n"
+
+
+def test_fmt_leaves_multiline_string_contents_alone(tmp_path):
+    # rewriting the interior of a literal would change runtime behavior
+    # (e.g. a tab-separated template); a gofmt analogue never does that
+    src = 'T = """a\t \nb  \n"""\n'
+    mod = tmp_path / "mod.py"
+    mod.write_text(src)
+    check = subprocess.run(
+        [sys.executable, str(REPO / "hack" / "fmt.py"), str(mod)],
+        capture_output=True,
+        text=True,
+    )
+    assert check.returncode == 0, check.stdout
+    subprocess.run(
+        [sys.executable, str(REPO / "hack" / "fmt.py"), "--fix", str(mod)],
+        capture_output=True,
+        text=True,
+    )
+    assert mod.read_text() == src
+
+
+def test_zipapp_build(tmp_path):
+    subprocess.run(
+        ["make", "build", f"BINDIR={tmp_path}"],
+        cwd=REPO,
+        check=True,
+        capture_output=True,
+    )
+    pyz = tmp_path / "downloader.pyz"
+    assert pyz.exists()
+    with zipfile.ZipFile(pyz) as zf:
+        names = zf.namelist()
+    assert "__main__.py" in names
+    assert any(n.startswith("downloader_tpu/") for n in names)
+    result = subprocess.run(
+        [sys.executable, str(pyz), "--help"], capture_output=True, text=True
+    )
+    assert result.returncode == 0
+    assert "download-once" in result.stdout

@@ -318,12 +318,12 @@ def test_health_endpoint(harness):
         assert "downloader_jobs_processed 1" in body
         assert "downloader_broker_connected 1" in body
 
-        with urllib.request.urlopen(
-            f"http://127.0.0.1:{server.port}/nope"
-        ) as resp:
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{server.port}/nope"):
+                pass
             raise AssertionError("expected 404")
-    except urllib.error.HTTPError as err:
-        assert err.code == 404
+        except urllib.error.HTTPError as err:
+            assert err.code == 404
     finally:
         server.stop()
 
