@@ -20,6 +20,8 @@ from __future__ import annotations
 import email.message
 import os
 import re
+import select
+import socket
 import time
 import urllib.error
 import urllib.parse
@@ -31,8 +33,67 @@ from .dispatch import BackendRegistration, ProgressFn
 
 log = get_logger("fetch.http")
 
-_CHUNK_SIZE = 256 * 1024
+_CHUNK_SIZE = 1024 * 1024
+_SPLICE_WINDOW = 1024 * 1024
 _SAFE_NAME = re.compile(r"[^\w.\- ()\[\]]")
+
+
+def _plain_socket_of(response) -> socket.socket | None:
+    """The plain TCP socket behind an http.client response, or None when
+    the transport is TLS (the fd would yield ciphertext) or anything but
+    a real socket. Used to decide whether the zero-copy splice path is
+    safe; every lookup is defensive because these are stdlib internals."""
+    raw = getattr(getattr(response, "fp", None), "raw", None)
+    sock = getattr(raw, "_sock", None)
+    if not isinstance(sock, socket.socket):
+        return None
+    try:
+        import ssl
+
+        if isinstance(sock, ssl.SSLSocket):
+            return None
+    except ImportError:
+        pass
+    return sock
+
+
+def _splice_body(
+    response, sock: socket.socket, sink, remaining: int, on_chunk
+) -> int:
+    """Kernel-side copy of ``remaining`` body bytes: socket → pipe → file
+    via os.splice, so payload bytes never enter userspace (the analogue of
+    keeping a hot loop on-chip instead of round-tripping through host
+    memory). Returns bytes actually moved; short counts mean early EOF.
+
+    The response's BufferedReader may already hold body bytes read along
+    with the headers — the caller MUST have drained that buffer first
+    (see download(): read1 loop) or those bytes would be skipped.
+    """
+    sink.flush()
+    timeout = sock.gettimeout()
+    pipe_r, pipe_w = os.pipe()
+    moved = 0
+    try:
+        while remaining > 0:
+            window = min(_SPLICE_WINDOW, remaining)
+            try:
+                got = os.splice(sock.fileno(), pipe_w, window)
+            except BlockingIOError:
+                if not select.select([sock], [], [], timeout)[0]:
+                    raise TimeoutError("splice read timed out") from None
+                continue
+            if got == 0:
+                break
+            drained = 0
+            while drained < got:
+                drained += os.splice(pipe_r, sink.fileno(), got - drained)
+            moved += got
+            remaining -= got
+            on_chunk(got)
+        return moved
+    finally:
+        os.close(pipe_r)
+        os.close(pipe_w)
 
 
 class TransferError(Exception):
@@ -130,24 +191,60 @@ class HTTPBackend:
                         continue
 
                     total = _total_size(response, offset)
+
+                    def tick(got: int) -> None:
+                        nonlocal offset, last_tick
+                        if token.cancelled():
+                            raise Cancelled()
+                        offset += got
+                        now = time.monotonic()
+                        if now - last_tick >= self._progress_interval:
+                            last_tick = now
+                            if total:
+                                progress(url, min(offset / total * 100, 99.9))
+
                     try:
                         with open(part_path, "r+b" if offset else "wb") as sink:
                             sink.seek(offset)
-                            while True:
-                                if token.cancelled():
-                                    raise Cancelled()
-                                chunk = response.read(_CHUNK_SIZE)
-                                if not chunk:
-                                    break
-                                sink.write(chunk)
-                                offset += len(chunk)
-                                now = time.monotonic()
-                                if now - last_tick >= self._progress_interval:
-                                    last_tick = now
-                                    if total:
-                                        progress(
-                                            url, min(offset / total * 100, 99.9)
-                                        )
+                            sock = _plain_socket_of(response)
+                            if (
+                                sock is not None
+                                and total
+                                and not getattr(response, "chunked", False)
+                                and hasattr(response, "read1")
+                                and hasattr(os, "splice")
+                            ):
+                                # zero-copy path: drain the bytes the
+                                # header parse buffered, then splice the
+                                # rest kernel-side
+                                head = response.read1(_CHUNK_SIZE)
+                                if head:
+                                    sink.write(head)
+                                    tick(len(head))
+                                _splice_body(
+                                    response, sock, sink, total - offset, tick
+                                )
+                            else:
+                                # userspace loop: reusable buffer +
+                                # readinto (optional, so custom openers
+                                # with plain file-like responses work)
+                                buffer = memoryview(bytearray(_CHUNK_SIZE))
+                                read_into = getattr(response, "readinto", None)
+                                while True:
+                                    if token.cancelled():
+                                        raise Cancelled()
+                                    if read_into is not None:
+                                        got = read_into(buffer)
+                                        if not got:
+                                            break
+                                        sink.write(buffer[:got])
+                                    else:
+                                        chunk = response.read(_CHUNK_SIZE)
+                                        if not chunk:
+                                            break
+                                        got = len(chunk)
+                                        sink.write(chunk)
+                                    tick(got)
                     except (urllib.error.URLError, OSError, TimeoutError) as exc:
                         token.raise_if_cancelled()  # closed by the cancel hook
                         attempts += 1

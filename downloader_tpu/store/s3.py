@@ -17,6 +17,8 @@ import hashlib
 import http.client
 import io
 import os
+import select
+import stat
 import time
 import urllib.parse
 from typing import BinaryIO
@@ -26,6 +28,26 @@ from . import sigv4
 from .credentials import Credentials
 
 _STREAM_CHUNK = 1024 * 1024
+_SENDFILE_WINDOW = 4 * 1024 * 1024
+
+
+def _fileno_of(body) -> int | None:
+    """The descriptor behind ``body`` if it is a REGULAR os-level file on
+    a platform with os.sendfile, else None (BytesIO, pipes, sockets, and
+    sendfile-less platforms take the copy loop — pipes would crash at
+    tell(), and sendfile wants mmap-able input)."""
+    if not hasattr(os, "sendfile"):
+        return None
+    fileno = getattr(body, "fileno", None)
+    if fileno is None:
+        return None
+    try:
+        fd = fileno()
+        if not stat.S_ISREG(os.fstat(fd).st_mode):
+            return None
+        return fd
+    except (OSError, ValueError, io.UnsupportedOperation):
+        return None
 
 
 class S3Error(Exception):
@@ -121,18 +143,55 @@ class S3Client:
                 conn.putheader(name, value)
             conn.endheaders()
             if body is not None:
-                while True:
-                    if token is not None:
-                        token.raise_if_cancelled()
-                    chunk = body.read(_STREAM_CHUNK)
-                    if not chunk:
-                        break
-                    conn.send(chunk)
+                self._send_body(conn, body, content_length, token)
             response = conn.getresponse()
             return response.status, response.read()
         finally:
             remove_hook()
             conn.close()
+
+    def _send_body(
+        self,
+        conn: http.client.HTTPConnection,
+        body: BinaryIO,
+        content_length: int,
+        token: CancelToken | None,
+    ) -> None:
+        """Stream the request body. Plain-socket PUTs of real files go
+        zero-copy via os.sendfile in bounded windows (so cancellation
+        still gets a look-in), never past the declared Content-Length;
+        TLS and non-file bodies fall back to a chunked userspace loop."""
+        sock = getattr(conn, "sock", None)
+        in_fd = _fileno_of(body) if not self._secure and sock is not None else None
+        if in_fd is not None:
+            offset = body.tell()
+            remaining = content_length
+            while remaining > 0:
+                if token is not None:
+                    token.raise_if_cancelled()
+                window = min(_SENDFILE_WINDOW, remaining)
+                try:
+                    sent = os.sendfile(sock.fileno(), in_fd, offset, window)
+                except BlockingIOError:
+                    # socket has a timeout => non-blocking; wait until the
+                    # send buffer drains, honoring the configured timeout
+                    ready = select.select([], [sock], [], self._timeout)[1]
+                    if not ready:
+                        raise TimeoutError("s3: send timed out") from None
+                    continue
+                if sent == 0:
+                    break  # EOF before Content-Length; server sees short body
+                offset += sent
+                remaining -= sent
+            body.seek(offset)
+            return
+        while True:
+            if token is not None:
+                token.raise_if_cancelled()
+            chunk = body.read(_STREAM_CHUNK)
+            if not chunk:
+                break
+            conn.send(chunk)
 
     @staticmethod
     def _object_path(bucket: str, key: str) -> str:

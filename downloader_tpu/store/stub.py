@@ -47,9 +47,25 @@ class S3Stub:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _read_body(self) -> bytes:
+            def _read_body(self) -> bytes | bytearray:
                 length = int(self.headers.get("Content-Length", "0"))
-                return self.rfile.read(length) if length else b""
+                if not length:
+                    return b""
+                # readinto a preallocated buffer: one copy per byte, no
+                # chunk-list churn (peak memory is the full body either
+                # way — objects are stored in memory)
+                body = bytearray(length)
+                with memoryview(body) as view:
+                    read = 0
+                    while read < length:
+                        got = self.rfile.readinto(view[read:])
+                        if not got:
+                            break
+                        read += got
+                # bytearray supports everything downstream (hashing,
+                # storage, wfile.write); skip a full-body copy on 1 vCPU
+                del body[read:]
+                return body
 
             def _verify_auth(self, body: bytes) -> bool:
                 if stub.credentials is None or stub.credentials.anonymous:
