@@ -216,10 +216,10 @@ def run_config(
         return jobs * mb_per_job / elapsed
     finally:
         token.cancel()
-        if httpd is not None:
-            httpd.kill()
-        if stub_proc is not None:
-            stub_proc.kill()
+        for proc in (httpd, stub_proc):
+            if proc is not None:
+                proc.kill()
+                proc.wait()  # reap; zombies skew the next measured run
         if workdir is not None:
             shutil.rmtree(workdir, ignore_errors=True)
 
@@ -237,12 +237,20 @@ def main() -> None:
             for _ in range(mb_per_job):
                 sink.write(chunk)
 
-        _log(f"bench: {jobs} jobs x {mb_per_job} MB")
+        repeats = int(os.environ.get("BENCH_REPEATS", 2))
+        _log(f"bench: {jobs} jobs x {mb_per_job} MB, best of {repeats}")
         _log("bench: reference-shaped baseline (concurrency 1, prefetch 1)")
-        baseline = run_config(jobs, mb_per_job, 1, 1, site)
+        # best-of-N per configuration: on a small shared-CPU box the
+        # scheduler noise across runs dwarfs the framework's own spread
+        baseline = max(
+            run_config(jobs, mb_per_job, 1, 1, site) for _ in range(repeats)
+        )
         _log(f"bench: baseline {baseline:.1f} MB/s")
         _log(f"bench: framework defaults (concurrency {concurrency})")
-        value = run_config(jobs, mb_per_job, concurrency, concurrency, site)
+        value = max(
+            run_config(jobs, mb_per_job, concurrency, concurrency, site)
+            for _ in range(repeats)
+        )
         _log(f"bench: framework {value:.1f} MB/s")
 
         print(

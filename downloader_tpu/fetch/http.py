@@ -18,9 +18,9 @@ final 100% (http.go:45-67) — with three deliberate upgrades:
 from __future__ import annotations
 
 import email.message
+import fcntl
 import os
 import re
-import select
 import socket
 import time
 import urllib.error
@@ -28,6 +28,7 @@ import urllib.parse
 import urllib.request
 
 from ..utils import get_logger
+from ..utils.netio import wait_readable
 from ..utils.cancel import Cancelled, CancelToken
 from .dispatch import BackendRegistration, ProgressFn
 
@@ -72,6 +73,12 @@ def _splice_body(
     sink.flush()
     timeout = sock.gettimeout()
     pipe_r, pipe_w = os.pipe()
+    try:
+        # the pipe caps a single splice at its capacity (64 KiB default);
+        # grow it or the 1 MiB window costs ~16 syscall pairs per MiB
+        fcntl.fcntl(pipe_w, fcntl.F_SETPIPE_SZ, _SPLICE_WINDOW)
+    except OSError:
+        pass  # over /proc/sys/fs/pipe-max-size for unprivileged: keep 64K
     moved = 0
     try:
         while remaining > 0:
@@ -79,8 +86,7 @@ def _splice_body(
             try:
                 got = os.splice(sock.fileno(), pipe_w, window)
             except BlockingIOError:
-                if not select.select([sock], [], [], timeout)[0]:
-                    raise TimeoutError("splice read timed out") from None
+                wait_readable(sock, timeout)
                 continue
             if got == 0:
                 break

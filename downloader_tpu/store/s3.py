@@ -17,13 +17,13 @@ import hashlib
 import http.client
 import io
 import os
-import select
 import stat
 import time
 import urllib.parse
 from typing import BinaryIO
 
 from ..utils.cancel import CancelToken
+from ..utils.netio import wait_writable
 from . import sigv4
 from .credentials import Credentials
 
@@ -175,9 +175,7 @@ class S3Client:
                 except BlockingIOError:
                     # socket has a timeout => non-blocking; wait until the
                     # send buffer drains, honoring the configured timeout
-                    ready = select.select([], [sock], [], self._timeout)[1]
-                    if not ready:
-                        raise TimeoutError("s3: send timed out") from None
+                    wait_writable(sock, self._timeout)
                     continue
                 if sent == 0:
                     break  # EOF before Content-Length; server sees short body

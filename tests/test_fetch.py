@@ -264,3 +264,61 @@ def test_resume_restarts_when_part_file_vanishes(server, tmp_path):
     backend = PartDeletingBackend(progress_interval=0.01, timeout=5)
     backend.download(CancelToken(), str(tmp_path), lambda u, p: None, f"{server}/flaky3")
     assert (tmp_path / "flaky3").read_bytes() == PAYLOAD  # not corrupt
+
+
+def test_splice_fast_path_engages(server, tmp_path, monkeypatch):
+    """Plain socket + known length must take the zero-copy splice path;
+    a silent fall-through to the userspace loop is a perf regression."""
+    import downloader_tpu.fetch.http as http_mod
+
+    calls = []
+    real = http_mod._splice_body
+
+    def counting(*args, **kwargs):
+        moved = real(*args, **kwargs)
+        calls.append(moved)
+        return moved
+
+    monkeypatch.setattr(http_mod, "_splice_body", counting)
+    backend = HTTPBackend(progress_interval=0.01, timeout=5)
+    backend.download(
+        CancelToken(), str(tmp_path), lambda u, p: None, f"{server}/file.mkv"
+    )
+    assert (tmp_path / "file.mkv").read_bytes() == PAYLOAD
+    assert calls, "splice path never engaged"
+
+
+def test_chunked_response_takes_fallback_path(tmp_path):
+    """No Content-Length => no splice; the userspace loop must still
+    deliver identical bytes."""
+
+    class ChunkedHandler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):
+            pass
+
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            for start in range(0, len(PAYLOAD), 64 * 1024):
+                chunk = PAYLOAD[start : start + 64 * 1024]
+                self.wfile.write(f"{len(chunk):x}\r\n".encode())
+                self.wfile.write(chunk)
+                self.wfile.write(b"\r\n")
+            self.wfile.write(b"0\r\n\r\n")
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), ChunkedHandler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        backend = HTTPBackend(progress_interval=0.01, timeout=5)
+        backend.download(
+            CancelToken(),
+            str(tmp_path),
+            lambda u, p: None,
+            f"http://127.0.0.1:{httpd.server_address[1]}/chunky.mkv",
+        )
+        assert (tmp_path / "chunky.mkv").read_bytes() == PAYLOAD
+    finally:
+        httpd.shutdown()

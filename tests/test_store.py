@@ -200,3 +200,27 @@ def test_signed_payload_opt_in(tmp_path):
 
         client.put_object("b", "k", _io.BytesIO(b"payload"), 7, sign_payload=True)
         assert stub.buckets["b"]["k"] == b"payload"
+
+
+def test_put_object_from_pipe_falls_back_to_copy_loop():
+    """A pipe-backed stream has a working fileno() but cannot seek/tell;
+    the sendfile eligibility check must route it to the copy loop
+    instead of crashing with ESPIPE."""
+    import os
+    import threading
+
+    with S3Stub(credentials=CREDS) as stub:
+        client = S3Client(stub.endpoint, CREDS)
+        client.make_bucket("pipes")
+        payload = b"streamed-through-a-pipe" * 1024
+        read_fd, write_fd = os.pipe()
+        writer = threading.Thread(
+            target=lambda: (os.write(write_fd, payload), os.close(write_fd))
+        )
+        writer.start()
+        try:
+            with os.fdopen(read_fd, "rb") as stream:
+                client.put_object("pipes", "obj", stream, len(payload))
+        finally:
+            writer.join()
+        assert bytes(stub.buckets["pipes"]["obj"]) == payload
