@@ -237,7 +237,7 @@ def main() -> None:
             for _ in range(mb_per_job):
                 sink.write(chunk)
 
-        repeats = int(os.environ.get("BENCH_REPEATS", 2))
+        repeats = max(1, int(os.environ.get("BENCH_REPEATS", 2)))
         _log(f"bench: {jobs} jobs x {mb_per_job} MB, best of {repeats}")
         _log("bench: reference-shaped baseline (concurrency 1, prefetch 1)")
         # best-of-N per configuration: on a small shared-CPU box the

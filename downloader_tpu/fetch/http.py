@@ -18,7 +18,7 @@ final 100% (http.go:45-67) — with three deliberate upgrades:
 from __future__ import annotations
 
 import email.message
-import fcntl
+import errno
 import os
 import re
 import socket
@@ -26,6 +26,11 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
+
+try:
+    import fcntl
+except ImportError:  # non-Unix: the splice path is gated off with it
+    fcntl = None  # type: ignore[assignment]
 
 from ..utils import get_logger
 from ..utils.netio import wait_readable
@@ -58,6 +63,26 @@ def _plain_socket_of(response) -> socket.socket | None:
     return sock
 
 
+class SpliceUnsupported(Exception):
+    """os.splice cannot operate on this socket/file pair (e.g. the sink
+    lives on a filesystem without splice_write support). Bytes already
+    moved were accounted through ``on_chunk``; ``moved`` carries the
+    count so the caller can re-sync http.client's ``response.length``
+    (splice consumed those bytes behind the response object's back)
+    before falling back to the userspace copy loop."""
+
+    def __init__(self, moved: int = 0):
+        super().__init__(moved)
+        self.moved = moved
+
+
+# errnos that mean "splice will never work on these fds", as opposed to
+# transient transfer errors that the resume path should retry
+_SPLICE_FALLBACK_ERRNOS = frozenset(
+    {errno.EINVAL, errno.ENOSYS, errno.EOPNOTSUPP, errno.EPERM}
+)
+
+
 def _splice_body(
     response, sock: socket.socket, sink, remaining: int, on_chunk
 ) -> int:
@@ -76,7 +101,8 @@ def _splice_body(
     try:
         # the pipe caps a single splice at its capacity (64 KiB default);
         # grow it or the 1 MiB window costs ~16 syscall pairs per MiB
-        fcntl.fcntl(pipe_w, fcntl.F_SETPIPE_SZ, _SPLICE_WINDOW)
+        if fcntl is not None:
+            fcntl.fcntl(pipe_w, fcntl.F_SETPIPE_SZ, _SPLICE_WINDOW)
     except OSError:
         pass  # over /proc/sys/fs/pipe-max-size for unprivileged: keep 64K
     moved = 0
@@ -88,11 +114,34 @@ def _splice_body(
             except BlockingIOError:
                 wait_readable(sock, timeout)
                 continue
+            except OSError as exc:
+                if exc.errno in _SPLICE_FALLBACK_ERRNOS:
+                    raise SpliceUnsupported(moved) from exc
+                raise
             if got == 0:
                 break
             drained = 0
             while drained < got:
-                drained += os.splice(pipe_r, sink.fileno(), got - drained)
+                try:
+                    drained += os.splice(pipe_r, sink.fileno(), got - drained)
+                except OSError as exc:
+                    if exc.errno not in _SPLICE_FALLBACK_ERRNOS:
+                        raise
+                    # the sink can't take a splice (e.g. FUSE mount):
+                    # rescue the bytes stranded in the pipe through
+                    # userspace, fd-level to match the splice writes
+                    while drained < got:
+                        chunk = os.read(pipe_r, got - drained)
+                        if not chunk:
+                            break
+                        view = memoryview(chunk)
+                        while view:
+                            view = view[os.write(sink.fileno(), view) :]
+                        drained += len(chunk)
+                    moved += drained
+                    remaining -= drained
+                    on_chunk(drained)
+                    raise SpliceUnsupported(moved) from exc
             moved += got
             remaining -= got
             on_chunk(got)
@@ -100,6 +149,28 @@ def _splice_body(
     finally:
         os.close(pipe_r)
         os.close(pipe_w)
+
+
+def _copy_body(response, sink, token: CancelToken, on_chunk) -> None:
+    """Userspace copy loop: reusable buffer + readinto when available
+    (optional, so custom openers with plain file-like responses work)."""
+    buffer = memoryview(bytearray(_CHUNK_SIZE))
+    read_into = getattr(response, "readinto", None)
+    while True:
+        if token.cancelled():
+            raise Cancelled()
+        if read_into is not None:
+            got = read_into(buffer)
+            if not got:
+                break
+            sink.write(buffer[:got])
+        else:
+            chunk = response.read(_CHUNK_SIZE)
+            if not chunk:
+                break
+            got = len(chunk)
+            sink.write(chunk)
+        on_chunk(got)
 
 
 class TransferError(Exception):
@@ -227,30 +298,33 @@ class HTTPBackend:
                                 if head:
                                     sink.write(head)
                                     tick(len(head))
-                                _splice_body(
-                                    response, sock, sink, total - offset, tick
-                                )
+                                try:
+                                    _splice_body(
+                                        response, sock, sink, total - offset, tick
+                                    )
+                                except SpliceUnsupported as unsup:
+                                    # e.g. the sink filesystem rejects
+                                    # splice_write; all fd-level writes so
+                                    # far are accounted in offset — re-sync
+                                    # the buffered writer and copy the rest
+                                    # through userspace
+                                    log.with_fields(url=url).info(
+                                        "splice unsupported for this "
+                                        "socket/file pair; using userspace copy"
+                                    )
+                                    # splice consumed bytes behind the
+                                    # response object's back; on keep-alive
+                                    # connections a stale length makes the
+                                    # copy loop wait for bytes that never
+                                    # arrive
+                                    if getattr(response, "length", None):
+                                        response.length = max(
+                                            0, response.length - unsup.moved
+                                        )
+                                    sink.seek(offset)
+                                    _copy_body(response, sink, token, tick)
                             else:
-                                # userspace loop: reusable buffer +
-                                # readinto (optional, so custom openers
-                                # with plain file-like responses work)
-                                buffer = memoryview(bytearray(_CHUNK_SIZE))
-                                read_into = getattr(response, "readinto", None)
-                                while True:
-                                    if token.cancelled():
-                                        raise Cancelled()
-                                    if read_into is not None:
-                                        got = read_into(buffer)
-                                        if not got:
-                                            break
-                                        sink.write(buffer[:got])
-                                    else:
-                                        chunk = response.read(_CHUNK_SIZE)
-                                        if not chunk:
-                                            break
-                                        got = len(chunk)
-                                        sink.write(chunk)
-                                    tick(got)
+                                _copy_body(response, sink, token, tick)
                     except (urllib.error.URLError, OSError, TimeoutError) as exc:
                         token.raise_if_cancelled()  # closed by the cancel hook
                         attempts += 1

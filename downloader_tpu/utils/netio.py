@@ -1,35 +1,36 @@
 """Socket readiness waits for the zero-copy data paths.
 
-``select.select`` is the wrong tool here twice over: it raises ValueError
-both for fds >= FD_SETSIZE (inevitable in a long-lived daemon) and for
-fds closed mid-wait by a cancellation hook (fileno() == -1). poll() has
-no fd limit, and any ValueError from a dead fd is converted to OSError so
-callers' existing error handling (resume / cancel / per-file failure)
-applies instead of an unhandled ValueError crossing the worker boundary.
+Bare ``select.select`` is the wrong tool here twice over: it raises
+ValueError both for fds >= FD_SETSIZE (inevitable in a long-lived daemon)
+and for fds closed mid-wait by a cancellation hook (fileno() == -1).
+``selectors.DefaultSelector`` picks the platform's FD_SETSIZE-free
+backend (epoll/kqueue/poll), and any ValueError from a dead fd is
+converted to OSError so callers' existing error handling (resume /
+cancel / per-file failure) applies instead of an unhandled ValueError
+crossing the worker boundary.
 """
 
 from __future__ import annotations
 
-import select
-
-_READ = select.POLLIN | select.POLLERR | select.POLLHUP
-_WRITE = select.POLLOUT | select.POLLERR | select.POLLHUP
+import selectors
 
 
-def _wait(sock, events: int, timeout: float | None, what: str) -> None:
+def _wait(sock, write: bool, timeout: float | None, what: str) -> None:
     try:
-        poller = select.poll()
-        poller.register(sock.fileno(), events)
-        ready = poller.poll(None if timeout is None else timeout * 1000)
-    except ValueError as exc:  # fd closed under us (cancel hook)
+        with selectors.DefaultSelector() as sel:
+            sel.register(
+                sock, selectors.EVENT_WRITE if write else selectors.EVENT_READ
+            )
+            ready = sel.select(timeout)
+    except (ValueError, KeyError) as exc:  # fd closed under us (cancel hook)
         raise OSError(f"socket closed while waiting to {what}") from exc
     if not ready:
         raise TimeoutError(f"timed out waiting to {what}")
 
 
 def wait_readable(sock, timeout: float | None) -> None:
-    _wait(sock, _READ, timeout, "read")
+    _wait(sock, False, timeout, "read")
 
 
 def wait_writable(sock, timeout: float | None) -> None:
-    _wait(sock, _WRITE, timeout, "write")
+    _wait(sock, True, timeout, "write")

@@ -266,6 +266,7 @@ def test_resume_restarts_when_part_file_vanishes(server, tmp_path):
     assert (tmp_path / "flaky3").read_bytes() == PAYLOAD  # not corrupt
 
 
+@pytest.mark.skipif(not hasattr(os, "splice"), reason="os.splice is Linux-only")
 def test_splice_fast_path_engages(server, tmp_path, monkeypatch):
     """Plain socket + known length must take the zero-copy splice path;
     a silent fall-through to the userspace loop is a perf regression."""
@@ -286,6 +287,119 @@ def test_splice_fast_path_engages(server, tmp_path, monkeypatch):
     )
     assert (tmp_path / "file.mkv").read_bytes() == PAYLOAD
     assert calls, "splice path never engaged"
+
+
+@pytest.mark.skipif(not hasattr(os, "splice"), reason="os.splice is Linux-only")
+def test_splice_unsupported_sink_falls_back_to_userspace(server, tmp_path, monkeypatch):
+    """A sink filesystem that rejects splice_write (FUSE-style EINVAL)
+    must not burn resume attempts: the download falls back to the
+    userspace loop mid-stream and still delivers identical bytes."""
+    import errno
+    import stat
+
+    real = os.splice
+
+    def fuse_sink(src, dst, count, *args, **kwargs):
+        if stat.S_ISREG(os.fstat(dst).st_mode):
+            raise OSError(errno.EINVAL, "splice_write unsupported")
+        return real(src, dst, count, *args, **kwargs)
+
+    monkeypatch.setattr(os, "splice", fuse_sink)
+    backend = HTTPBackend(progress_interval=0.01, timeout=5)
+    backend.download(
+        CancelToken(), str(tmp_path), lambda u, p: None, f"{server}/file.mkv"
+    )
+    assert (tmp_path / "file.mkv").read_bytes() == PAYLOAD
+
+
+@pytest.mark.skipif(not hasattr(os, "splice"), reason="os.splice is Linux-only")
+def test_splice_entirely_unavailable_falls_back(server, tmp_path, monkeypatch):
+    """ENOSYS from the very first splice (seccomp'd kernels) must also
+    route to the userspace loop, not the resume/retry path."""
+    import errno
+
+    def no_splice(*args, **kwargs):
+        raise OSError(errno.ENOSYS, "splice not permitted")
+
+    monkeypatch.setattr(os, "splice", no_splice)
+    backend = HTTPBackend(progress_interval=0.01, timeout=5)
+    backend.download(
+        CancelToken(), str(tmp_path), lambda u, p: None, f"{server}/file.mkv"
+    )
+    assert (tmp_path / "file.mkv").read_bytes() == PAYLOAD
+
+
+@pytest.mark.skipif(not hasattr(os, "splice"), reason="os.splice is Linux-only")
+def test_splice_fallback_keepalive_length_resync(tmp_path, monkeypatch):
+    """Mid-stream splice fallback on a KEEP-ALIVE connection: splice
+    consumed bytes behind http.client's back, so response.length must be
+    re-synced or the userspace loop waits out the socket timeout for
+    bytes that already arrived (then burns a resume attempt on a 416)."""
+    import errno
+    import http.client
+    import stat
+    import urllib.parse
+
+    # 4 MiB: a single read1 (1 MiB cap) cannot swallow the whole body,
+    # so the splice path — and with it the stale-length hazard — always
+    # engages regardless of how much the kernel buffered
+    big = bytes(range(256)) * (4 * 4096)
+
+    class KeepAliveHandler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):
+            pass
+
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(big)))
+            self.end_headers()
+            self.wfile.write(big)
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), KeepAliveHandler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    class KeepAliveOpener:
+        """urllib's default handler forces Connection: close; this one
+        keeps the connection alive like a pooling client would."""
+
+        def open(self, request, timeout=None):
+            parsed = urllib.parse.urlparse(request.full_url)
+            conn = http.client.HTTPConnection(
+                parsed.hostname, parsed.port, timeout=timeout
+            )
+            conn.request(
+                "GET", parsed.path or "/", headers=dict(request.header_items())
+            )
+            return conn.getresponse()
+
+    real = os.splice
+
+    def fuse_sink(src, dst, count, *args, **kwargs):
+        if stat.S_ISREG(os.fstat(dst).st_mode):
+            raise OSError(errno.EINVAL, "splice_write unsupported")
+        return real(src, dst, count, *args, **kwargs)
+
+    monkeypatch.setattr(os, "splice", fuse_sink)
+    try:
+        backend = HTTPBackend(
+            progress_interval=0.01, timeout=5, opener=KeepAliveOpener()
+        )
+        start = time.monotonic()
+        backend.download(
+            CancelToken(),
+            str(tmp_path),
+            lambda u, p: None,
+            f"http://127.0.0.1:{httpd.server_address[1]}/big.mkv",
+        )
+        elapsed = time.monotonic() - start
+        assert (tmp_path / "big.mkv").read_bytes() == big
+        assert elapsed < 4, (
+            f"stale response.length stalled the copy loop ({elapsed:.1f}s)"
+        )
+    finally:
+        httpd.shutdown()
 
 
 def test_chunked_response_takes_fallback_path(tmp_path):
