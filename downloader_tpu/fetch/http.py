@@ -33,7 +33,7 @@ except ImportError:  # non-Unix: the splice path is gated off with it
     fcntl = None  # type: ignore[assignment]
 
 from ..utils import get_logger
-from ..utils.netio import wait_readable
+from ..utils.netio import SocketWaiter
 from ..utils.cancel import Cancelled, CancelToken
 from .dispatch import BackendRegistration, ProgressFn
 
@@ -82,6 +82,20 @@ _SPLICE_FALLBACK_ERRNOS = frozenset(
     {errno.EINVAL, errno.ENOSYS, errno.EOPNOTSUPP, errno.EPERM}
 )
 
+# cleared on the first process-wide splice failure, so later downloads
+# skip the doomed pipe + splice + log cycle and go straight to the
+# userspace loop. ENOSYS (missing syscall) is permanent anywhere; EPERM
+# is permanent only at the socket→pipe site (seccomp SCMP_ACT_ERRNO's
+# historical default — the kernel proper never returns EPERM there),
+# while sink-side errors like EINVAL are per-mount and NOT memoized.
+_splice_works = True
+
+
+def _note_splice_errno(code: int | None, from_sink: bool = False) -> None:
+    global _splice_works
+    if code == errno.ENOSYS or (code == errno.EPERM and not from_sink):
+        _splice_works = False
+
 
 def _splice_body(
     response, sock: socket.socket, sink, remaining: int, on_chunk
@@ -107,44 +121,47 @@ def _splice_body(
         pass  # over /proc/sys/fs/pipe-max-size for unprivileged: keep 64K
     moved = 0
     try:
-        while remaining > 0:
-            window = min(_SPLICE_WINDOW, remaining)
-            try:
-                got = os.splice(sock.fileno(), pipe_w, window)
-            except BlockingIOError:
-                wait_readable(sock, timeout)
-                continue
-            except OSError as exc:
-                if exc.errno in _SPLICE_FALLBACK_ERRNOS:
-                    raise SpliceUnsupported(moved) from exc
-                raise
-            if got == 0:
-                break
-            drained = 0
-            while drained < got:
+        with SocketWaiter(sock, write=False, what="read") as waiter:
+            while remaining > 0:
+                window = min(_SPLICE_WINDOW, remaining)
                 try:
-                    drained += os.splice(pipe_r, sink.fileno(), got - drained)
+                    got = os.splice(sock.fileno(), pipe_w, window)
+                except BlockingIOError:
+                    waiter.wait(timeout)
+                    continue
                 except OSError as exc:
-                    if exc.errno not in _SPLICE_FALLBACK_ERRNOS:
-                        raise
-                    # the sink can't take a splice (e.g. FUSE mount):
-                    # rescue the bytes stranded in the pipe through
-                    # userspace, fd-level to match the splice writes
-                    while drained < got:
-                        chunk = os.read(pipe_r, got - drained)
-                        if not chunk:
-                            break
-                        view = memoryview(chunk)
-                        while view:
-                            view = view[os.write(sink.fileno(), view) :]
-                        drained += len(chunk)
-                    moved += drained
-                    remaining -= drained
-                    on_chunk(drained)
-                    raise SpliceUnsupported(moved) from exc
-            moved += got
-            remaining -= got
-            on_chunk(got)
+                    if exc.errno in _SPLICE_FALLBACK_ERRNOS:
+                        _note_splice_errno(exc.errno)
+                        raise SpliceUnsupported(moved) from exc
+                    raise
+                if got == 0:
+                    break
+                drained = 0
+                while drained < got:
+                    try:
+                        drained += os.splice(pipe_r, sink.fileno(), got - drained)
+                    except OSError as exc:
+                        if exc.errno not in _SPLICE_FALLBACK_ERRNOS:
+                            raise
+                        _note_splice_errno(exc.errno, from_sink=True)
+                        # the sink can't take a splice (e.g. FUSE mount):
+                        # rescue the bytes stranded in the pipe through
+                        # userspace, fd-level to match the splice writes
+                        while drained < got:
+                            chunk = os.read(pipe_r, got - drained)
+                            if not chunk:
+                                break
+                            view = memoryview(chunk)
+                            while view:
+                                view = view[os.write(sink.fileno(), view) :]
+                            drained += len(chunk)
+                        moved += drained
+                        remaining -= drained
+                        on_chunk(drained)
+                        raise SpliceUnsupported(moved) from exc
+                moved += got
+                remaining -= got
+                on_chunk(got)
         return moved
     finally:
         os.close(pipe_r)
@@ -237,8 +254,23 @@ class HTTPBackend:
             token.raise_if_cancelled()
             try:
                 response, offset = self._open(url, offset)
+            except urllib.error.HTTPError as exc:
+                # a deterministic server answer: retrying won't change it
+                raise TransferError(f"http status {exc.code}") from exc
             except (urllib.error.URLError, OSError) as exc:
-                raise TransferError(f"request failed: {exc}") from exc
+                # transient network failure (conn refused/reset mid-job,
+                # DNS blip): burns a resume attempt instead of killing
+                # the job outright — on loopback tests a reconnect can
+                # race the server's accept loop, and in production a
+                # broker redelivery is far costlier than a retry here
+                attempts += 1
+                if attempts > self._max_resume_attempts:
+                    raise TransferError(f"request failed: {exc}") from exc
+                log.with_fields(url=url, attempt=attempts).warning(
+                    "request failed; retrying"
+                )
+                time.sleep(min(0.2 * attempts, 1.0))
+                continue
 
             # cancellation closes the in-flight response so a blocking
             # socket read aborts promptly instead of draining the stream
@@ -290,6 +322,7 @@ class HTTPBackend:
                                 and not getattr(response, "chunked", False)
                                 and hasattr(response, "read1")
                                 and hasattr(os, "splice")
+                                and _splice_works
                             ):
                                 # zero-copy path: drain the bytes the
                                 # header parse buffered, then splice the

@@ -23,7 +23,7 @@ import urllib.parse
 from typing import BinaryIO
 
 from ..utils.cancel import CancelToken
-from ..utils.netio import wait_writable
+from ..utils.netio import SocketWaiter
 from . import sigv4
 from .credentials import Credentials
 
@@ -166,21 +166,22 @@ class S3Client:
         if in_fd is not None:
             offset = body.tell()
             remaining = content_length
-            while remaining > 0:
-                if token is not None:
-                    token.raise_if_cancelled()
-                window = min(_SENDFILE_WINDOW, remaining)
-                try:
-                    sent = os.sendfile(sock.fileno(), in_fd, offset, window)
-                except BlockingIOError:
-                    # socket has a timeout => non-blocking; wait until the
-                    # send buffer drains, honoring the configured timeout
-                    wait_writable(sock, self._timeout)
-                    continue
-                if sent == 0:
-                    break  # EOF before Content-Length; server sees short body
-                offset += sent
-                remaining -= sent
+            with SocketWaiter(sock, write=True, what="write") as waiter:
+                while remaining > 0:
+                    if token is not None:
+                        token.raise_if_cancelled()
+                    window = min(_SENDFILE_WINDOW, remaining)
+                    try:
+                        sent = os.sendfile(sock.fileno(), in_fd, offset, window)
+                    except BlockingIOError:
+                        # socket has a timeout => non-blocking; wait until
+                        # the send buffer drains, honoring the timeout
+                        waiter.wait(self._timeout)
+                        continue
+                    if sent == 0:
+                        break  # EOF before Content-Length: short body
+                    offset += sent
+                    remaining -= sent
             body.seek(offset)
             return
         while True:

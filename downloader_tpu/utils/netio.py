@@ -4,33 +4,64 @@ Bare ``select.select`` is the wrong tool here twice over: it raises
 ValueError both for fds >= FD_SETSIZE (inevitable in a long-lived daemon)
 and for fds closed mid-wait by a cancellation hook (fileno() == -1).
 ``selectors.DefaultSelector`` picks the platform's FD_SETSIZE-free
-backend (epoll/kqueue/poll), and any ValueError from a dead fd is
-converted to OSError so callers' existing error handling (resume /
-cancel / per-file failure) applies instead of an unhandled ValueError
-crossing the worker boundary.
+backend (epoll/kqueue/poll); errors from a dead fd are converted to
+OSError so callers' existing error handling (resume / cancel / per-file
+failure) applies instead of an unhandled ValueError crossing the worker
+boundary.
 """
 
 from __future__ import annotations
 
 import selectors
+import time
 
 
-def _wait(sock, write: bool, timeout: float | None, what: str) -> None:
-    try:
-        with selectors.DefaultSelector() as sel:
-            sel.register(
+class SocketWaiter:
+    """Re-armable readiness wait for one socket.
+
+    Register once per transfer: the EAGAIN path of the splice/sendfile
+    loops fires on most windows whenever the disk outpaces the network,
+    and re-polling one registered selector costs a single syscall per
+    wait instead of epoll_create + epoll_ctl + epoll_wait + close.
+    """
+
+    def __init__(self, sock, write: bool, what: str) -> None:
+        self._sock = sock
+        self._what = what
+        self._sel = selectors.DefaultSelector()
+        try:
+            self._sel.register(
                 sock, selectors.EVENT_WRITE if write else selectors.EVENT_READ
             )
-            ready = sel.select(timeout)
-    except (ValueError, KeyError) as exc:  # fd closed under us (cancel hook)
-        raise OSError(f"socket closed while waiting to {what}") from exc
-    if not ready:
-        raise TimeoutError(f"timed out waiting to {what}")
+        except (ValueError, KeyError, OSError) as exc:
+            self._sel.close()
+            raise OSError(f"socket closed while waiting to {what}") from exc
 
+    # epoll silently drops a registered fd when it is closed (the cancel
+    # hook does exactly that), so a close landing mid-select would stall
+    # the wait to its full timeout; waiting in slices and re-checking the
+    # fd bounds cancellation-detection latency to one slice
+    _SLICE = 0.5
 
-def wait_readable(sock, timeout: float | None) -> None:
-    _wait(sock, False, timeout, "read")
+    def wait(self, timeout: float | None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self._sock.fileno() == -1:
+                raise OSError(f"socket closed while waiting to {self._what}")
+            step = self._SLICE
+            if deadline is not None:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    raise TimeoutError(f"timed out waiting to {self._what}")
+                step = min(step, remain)
+            if self._sel.select(step):
+                return
 
+    def close(self) -> None:
+        self._sel.close()
 
-def wait_writable(sock, timeout: float | None) -> None:
-    _wait(sock, True, timeout, "write")
+    def __enter__(self) -> "SocketWaiter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

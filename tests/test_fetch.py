@@ -96,6 +96,48 @@ def backend():
     return HTTPBackend(progress_interval=0.01, timeout=5)
 
 
+# 4 MiB: a single read1 (1 MiB cap) cannot swallow the whole body, so
+# the splice path deterministically engages for the fallback tests
+BIG_PAYLOAD = bytes(range(256)) * (4 * 4096)
+
+
+def make_fuse_sink(on_call=None):
+    """An os.splice stand-in that rejects regular-file destinations with
+    EINVAL, like a FUSE mount whose filesystem lacks splice_write."""
+    import errno
+    import stat
+
+    real = os.splice
+
+    def fuse_sink(src, dst, count, *args, **kwargs):
+        if on_call is not None:
+            on_call()
+        if stat.S_ISREG(os.fstat(dst).st_mode):
+            raise OSError(errno.EINVAL, "splice_write unsupported")
+        return real(src, dst, count, *args, **kwargs)
+
+    return fuse_sink
+
+
+@pytest.fixture(scope="module")
+def big_server():
+    class BigHandler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(BIG_PAYLOAD)))
+            self.end_headers()
+            self.wfile.write(BIG_PAYLOAD)
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), BigHandler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
 def test_download_happy_path(server, backend, tmp_path):
     updates = []
     backend.download(CancelToken(), str(tmp_path), lambda u, p: updates.append(p), f"{server}/file.mkv")
@@ -121,6 +163,34 @@ def test_gives_up_after_max_resume_attempts(server, tmp_path):
     backend = HTTPBackend(progress_interval=0.01, timeout=5, max_resume_attempts=2)
     with pytest.raises(TransferError):
         backend.download(CancelToken(), str(tmp_path), lambda u, p: None, f"{server}/flaky2")
+
+
+def test_transient_open_failure_burns_attempt_not_job(server, tmp_path):
+    """A connection failure while (re)opening the request must consume a
+    resume attempt and retry, not kill the job — a broker redelivery is
+    far costlier than a retry here."""
+    import urllib.error
+
+    failures = [2]
+
+    class FlakyOpenBackend(HTTPBackend):
+        def _open(self, url, offset):
+            if failures[0] > 0:
+                failures[0] -= 1
+                raise urllib.error.URLError(ConnectionRefusedError(111, "refused"))
+            return super()._open(url, offset)
+
+    backend = FlakyOpenBackend(progress_interval=0.01, timeout=5)
+    backend.download(
+        CancelToken(), str(tmp_path), lambda u, p: None, f"{server}/file.mkv"
+    )
+    assert (tmp_path / "file.mkv").read_bytes() == PAYLOAD
+
+    failures[0] = 99  # never recovers => TransferError after max attempts
+    with pytest.raises(TransferError):
+        backend.download(
+            CancelToken(), str(tmp_path), lambda u, p: None, f"{server}/file.mkv"
+        )
 
 
 def test_http_error_propagates(server, backend, tmp_path):
@@ -290,43 +360,60 @@ def test_splice_fast_path_engages(server, tmp_path, monkeypatch):
 
 
 @pytest.mark.skipif(not hasattr(os, "splice"), reason="os.splice is Linux-only")
-def test_splice_unsupported_sink_falls_back_to_userspace(server, tmp_path, monkeypatch):
+def test_splice_unsupported_sink_falls_back_to_userspace(
+    big_server, tmp_path, monkeypatch
+):
     """A sink filesystem that rejects splice_write (FUSE-style EINVAL)
     must not burn resume attempts: the download falls back to the
-    userspace loop mid-stream and still delivers identical bytes."""
-    import errno
-    import stat
+    userspace loop mid-stream and still delivers identical bytes.
+    EINVAL is per-mount, so it must NOT memoize splice away globally."""
+    import downloader_tpu.fetch.http as http_mod
 
-    real = os.splice
-
-    def fuse_sink(src, dst, count, *args, **kwargs):
-        if stat.S_ISREG(os.fstat(dst).st_mode):
-            raise OSError(errno.EINVAL, "splice_write unsupported")
-        return real(src, dst, count, *args, **kwargs)
-
-    monkeypatch.setattr(os, "splice", fuse_sink)
+    splice_calls = []
+    monkeypatch.setattr(os, "splice", make_fuse_sink(lambda: splice_calls.append(1)))
     backend = HTTPBackend(progress_interval=0.01, timeout=5)
     backend.download(
-        CancelToken(), str(tmp_path), lambda u, p: None, f"{server}/file.mkv"
+        CancelToken(), str(tmp_path), lambda u, p: None, f"{big_server}/file.mkv"
     )
-    assert (tmp_path / "file.mkv").read_bytes() == PAYLOAD
+    assert (tmp_path / "file.mkv").read_bytes() == BIG_PAYLOAD
+    assert splice_calls, "splice never engaged; fallback untested"
+    assert http_mod._splice_works is True, "per-mount EINVAL wrongly memoized"
 
 
 @pytest.mark.skipif(not hasattr(os, "splice"), reason="os.splice is Linux-only")
-def test_splice_entirely_unavailable_falls_back(server, tmp_path, monkeypatch):
-    """ENOSYS from the very first splice (seccomp'd kernels) must also
-    route to the userspace loop, not the resume/retry path."""
+@pytest.mark.parametrize("blocked_errno", ["ENOSYS", "EPERM"])
+def test_splice_entirely_unavailable_falls_back(
+    big_server, tmp_path, monkeypatch, blocked_errno
+):
+    """ENOSYS (missing syscall) or EPERM (seccomp SCMP_ACT_ERRNO) from
+    the very first splice must route to the userspace loop, not the
+    resume/retry path — and the failure is memoized so later downloads
+    skip the doomed splice entirely."""
     import errno
 
+    import downloader_tpu.fetch.http as http_mod
+
+    calls = []
+
     def no_splice(*args, **kwargs):
-        raise OSError(errno.ENOSYS, "splice not permitted")
+        calls.append(1)
+        raise OSError(getattr(errno, blocked_errno), "splice not permitted")
 
     monkeypatch.setattr(os, "splice", no_splice)
+    monkeypatch.setattr(http_mod, "_splice_works", True)  # restore on exit
     backend = HTTPBackend(progress_interval=0.01, timeout=5)
     backend.download(
-        CancelToken(), str(tmp_path), lambda u, p: None, f"{server}/file.mkv"
+        CancelToken(), str(tmp_path), lambda u, p: None, f"{big_server}/one.mkv"
     )
-    assert (tmp_path / "file.mkv").read_bytes() == PAYLOAD
+    assert (tmp_path / "one.mkv").read_bytes() == BIG_PAYLOAD
+    assert calls, "splice never engaged; ENOSYS path untested"
+    assert http_mod._splice_works is False, "ENOSYS not memoized"
+
+    backend.download(
+        CancelToken(), str(tmp_path), lambda u, p: None, f"{big_server}/two.mkv"
+    )
+    assert (tmp_path / "two.mkv").read_bytes() == BIG_PAYLOAD
+    assert len(calls) == 1, "memoized failure re-tried splice"
 
 
 @pytest.mark.skipif(not hasattr(os, "splice"), reason="os.splice is Linux-only")
@@ -335,15 +422,8 @@ def test_splice_fallback_keepalive_length_resync(tmp_path, monkeypatch):
     consumed bytes behind http.client's back, so response.length must be
     re-synced or the userspace loop waits out the socket timeout for
     bytes that already arrived (then burns a resume attempt on a 416)."""
-    import errno
     import http.client
-    import stat
     import urllib.parse
-
-    # 4 MiB: a single read1 (1 MiB cap) cannot swallow the whole body,
-    # so the splice path — and with it the stale-length hazard — always
-    # engages regardless of how much the kernel buffered
-    big = bytes(range(256)) * (4 * 4096)
 
     class KeepAliveHandler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -353,9 +433,9 @@ def test_splice_fallback_keepalive_length_resync(tmp_path, monkeypatch):
 
         def do_GET(self):
             self.send_response(200)
-            self.send_header("Content-Length", str(len(big)))
+            self.send_header("Content-Length", str(len(BIG_PAYLOAD)))
             self.end_headers()
-            self.wfile.write(big)
+            self.wfile.write(BIG_PAYLOAD)
 
     httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), KeepAliveHandler)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
@@ -374,14 +454,7 @@ def test_splice_fallback_keepalive_length_resync(tmp_path, monkeypatch):
             )
             return conn.getresponse()
 
-    real = os.splice
-
-    def fuse_sink(src, dst, count, *args, **kwargs):
-        if stat.S_ISREG(os.fstat(dst).st_mode):
-            raise OSError(errno.EINVAL, "splice_write unsupported")
-        return real(src, dst, count, *args, **kwargs)
-
-    monkeypatch.setattr(os, "splice", fuse_sink)
+    monkeypatch.setattr(os, "splice", make_fuse_sink())
     try:
         backend = HTTPBackend(
             progress_interval=0.01, timeout=5, opener=KeepAliveOpener()
@@ -394,7 +467,7 @@ def test_splice_fallback_keepalive_length_resync(tmp_path, monkeypatch):
             f"http://127.0.0.1:{httpd.server_address[1]}/big.mkv",
         )
         elapsed = time.monotonic() - start
-        assert (tmp_path / "big.mkv").read_bytes() == big
+        assert (tmp_path / "big.mkv").read_bytes() == BIG_PAYLOAD
         assert elapsed < 4, (
             f"stale response.length stalled the copy loop ({elapsed:.1f}s)"
         )

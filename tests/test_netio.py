@@ -1,0 +1,62 @@
+"""SocketWaiter: timeout, readiness, and prompt detection of a socket
+closed under the wait by a cancellation hook (the epoll silent-drop
+case — a plain blocking select would stall to the full timeout)."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from downloader_tpu.utils.netio import SocketWaiter
+
+
+def test_wait_times_out():
+    a, b = socket.socketpair()
+    try:
+        with SocketWaiter(a, write=False, what="read") as waiter:
+            start = time.monotonic()
+            with pytest.raises(TimeoutError):
+                waiter.wait(0.3)
+            assert time.monotonic() - start < 2
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wait_returns_when_ready():
+    a, b = socket.socketpair()
+    try:
+        with SocketWaiter(a, write=False, what="read") as waiter:
+            b.send(b"x")
+            waiter.wait(2)  # must not raise
+    finally:
+        a.close()
+        b.close()
+
+
+def test_close_mid_wait_detected_within_slice():
+    a, b = socket.socketpair()
+    try:
+        with SocketWaiter(a, write=False, what="read") as waiter:
+            threading.Timer(0.2, a.close).start()
+            start = time.monotonic()
+            with pytest.raises(OSError) as excinfo:
+                waiter.wait(10)
+            assert not isinstance(excinfo.value, TimeoutError)
+            assert time.monotonic() - start < 2, "close not detected promptly"
+    finally:
+        b.close()
+        try:
+            a.close()
+        except OSError:
+            pass
+
+
+def test_register_closed_socket_raises_oserror():
+    a, b = socket.socketpair()
+    a.close()
+    b.close()
+    with pytest.raises(OSError) as excinfo:
+        SocketWaiter(a, write=False, what="read")
+    assert not isinstance(excinfo.value, TimeoutError)
