@@ -88,12 +88,41 @@ def _download_once(args: argparse.Namespace) -> int:
     return 0 if not result.failed else 1
 
 
+def _dht_bootstrap_from_env() -> tuple[tuple[str, int], ...] | None:
+    """DHT_BOOTSTRAP env: unset/empty = BEP 5 default routers;
+    "off" disables DHT; otherwise "host:port,host:port"."""
+    from .fetch.magnet import parse_hostport
+
+    raw = os.environ.get("DHT_BOOTSTRAP", "").strip()
+    if not raw:
+        return None
+    if raw.lower() in ("off", "none", "disabled", "0"):
+        return ()
+    nodes = []
+    for part in raw.split(","):
+        node = parse_hostport(part)
+        if node is not None:
+            nodes.append(node)
+        else:
+            log.with_fields(entry=part.strip()).warning(
+                "ignoring malformed DHT_BOOTSTRAP entry (want host:port)"
+            )
+    if not nodes:
+        # a fully-malformed value must not silently become the
+        # disable-DHT sentinel (); fall back to the defaults loudly
+        log.warning(
+            "DHT_BOOTSTRAP had no usable host:port entries; using defaults"
+        )
+        return None
+    return tuple(nodes)
+
+
 def _default_backends():
     from .fetch.torrent import TorrentBackend
 
     # torrent first, then http, matching the reference's registration order
     # (cmd/downloader/downloader.go:87-90)
-    return [TorrentBackend(), HTTPBackend()]
+    return [TorrentBackend(dht_bootstrap=_dht_bootstrap_from_env()), HTTPBackend()]
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -1,0 +1,248 @@
+"""Mainline DHT client (BEP 5): trackerless peer discovery.
+
+The reference's anacrolix/torrent ships a full DHT node (server +
+routing table); a download job only needs the *client* half — an
+iterative ``get_peers`` lookup over KRPC/UDP — so that is what this
+implements, mirroring the reference's fresh-state-per-job design
+(torrent.go:43-44): one lookup, no long-lived routing table.
+
+Lookup algorithm (Kademlia): keep a shortlist of nodes sorted by XOR
+distance to the info-hash, query the closest unqueried ones in rounds of
+α concurrent queries (all datagrams go out first, replies are collected
+until the round deadline), fold in the closer nodes each reply returns,
+and stop when a round yields nothing new or enough peers are in hand.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import secrets
+import selectors
+import socket
+import struct
+import time
+
+from ..utils import get_logger
+from ..utils.cancel import CancelToken
+from . import bencode
+from .http import TransferError
+
+log = get_logger("fetch.dht")
+
+# well-known bootstrap routers (overridable; tests inject loopback nodes)
+DEFAULT_BOOTSTRAP = (
+    ("router.bittorrent.com", 6881),
+    ("dht.transmissionbt.com", 6881),
+    ("router.utorrent.com", 6881),
+)
+
+ALPHA = 3  # concurrent queries per lookup round (Kademlia's α)
+K = 8  # shortlist width per round
+
+
+class DHTError(TransferError):
+    pass
+
+
+def _decode_compact_nodes(blob: bytes) -> list[tuple[bytes, str, int]]:
+    """BEP 5 compact node info: 26 bytes per node (id + IPv4 + port)."""
+    nodes = []
+    for i in range(0, len(blob) - 25, 26):
+        node_id = blob[i : i + 20]
+        host = str(ipaddress.IPv4Address(blob[i + 20 : i + 24]))
+        port = struct.unpack(">H", blob[i + 24 : i + 26])[0]
+        nodes.append((node_id, host, port))
+    return nodes
+
+
+def _decode_compact_values(values) -> list[tuple[str, int]]:
+    """BEP 5 ``values``: list of 6-byte compact peer addresses."""
+    peers = []
+    if isinstance(values, list):
+        for value in values:
+            if isinstance(value, bytes) and len(value) == 6:
+                host = str(ipaddress.IPv4Address(value[:4]))
+                peers.append((host, struct.unpack(">H", value[4:6])[0]))
+    return peers
+
+
+class _SockPool:
+    """One UDP socket per address family (bootstrap nodes may be IPv6
+    even though BEP 5 compact replies are IPv4-only), non-blocking, with
+    a selector spanning both so a round can await replies on either."""
+
+    def __init__(self) -> None:
+        self._socks: dict[int, socket.socket] = {}
+        self.selector = selectors.DefaultSelector()
+
+    def for_addr(self, addr: tuple[str, int]) -> socket.socket:
+        family = socket.AF_INET6 if ":" in addr[0] else socket.AF_INET
+        sock = self._socks.get(family)
+        if sock is None:
+            sock = socket.socket(family, socket.SOCK_DGRAM)
+            sock.setblocking(False)
+            self._socks[family] = sock
+            self.selector.register(sock, selectors.EVENT_READ)
+        return sock
+
+    def close(self) -> None:
+        self.selector.close()
+        for sock in self._socks.values():
+            sock.close()
+
+    def __enter__(self) -> "_SockPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class DHTClient:
+    """One-lookup KRPC client; create per job, like the reference's
+    per-job torrent client."""
+
+    def __init__(
+        self,
+        bootstrap: tuple[tuple[str, int], ...] = DEFAULT_BOOTSTRAP,
+        node_id: bytes | None = None,
+        query_timeout: float = 2.0,
+    ):
+        self._bootstrap = bootstrap
+        self._node_id = node_id or secrets.token_bytes(20)
+        self._query_timeout = query_timeout
+
+    # -- KRPC ------------------------------------------------------------
+
+    def _query_round(
+        self,
+        pool: _SockPool,
+        addrs: list[tuple[str, int]],
+        method: bytes,
+        args: dict,
+    ) -> dict[tuple[str, int], dict]:
+        """Send one KRPC query to every address concurrently and collect
+        replies until all have answered or the round times out. Returns
+        {addr: reply_args} for the nodes that answered well-formed."""
+        pending: dict[bytes, tuple[str, int]] = {}
+        for addr in addrs:
+            tid = secrets.token_bytes(2)
+            while tid in pending:
+                tid = secrets.token_bytes(2)
+            payload = bencode.encode(
+                {
+                    b"t": tid,
+                    b"y": b"q",
+                    b"q": method,
+                    b"a": {b"id": self._node_id, **args},
+                }
+            )
+            try:
+                pool.for_addr(addr).sendto(payload, addr)
+            except OSError as exc:
+                log.with_fields(node=f"{addr[0]}:{addr[1]}").debug(
+                    f"dht send failed: {exc}"
+                )
+                continue
+            pending[tid] = addr
+
+        replies: dict[tuple[str, int], dict] = {}
+        deadline = time.monotonic() + self._query_timeout
+        while pending:
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                break
+            ready = pool.selector.select(remain)
+            for key, _ in ready:
+                sock = key.fileobj
+                while True:
+                    try:
+                        datagram, _ = sock.recvfrom(65536)
+                    except (BlockingIOError, OSError):
+                        break
+                    try:
+                        reply = bencode.decode(datagram)
+                    except bencode.BencodeError:
+                        continue  # junk datagram
+                    if not isinstance(reply, dict):
+                        continue
+                    tid = reply.get(b"t")
+                    addr = pending.get(tid)
+                    if addr is None:
+                        continue  # stale or foreign transaction
+                    del pending[tid]
+                    kind = reply.get(b"y")
+                    if kind == b"r" and isinstance(reply.get(b"r"), dict):
+                        replies[addr] = reply[b"r"]
+                    else:  # KRPC error or malformed: drop the node
+                        log.with_fields(node=f"{addr[0]}:{addr[1]}").debug(
+                            f"dht error reply: {reply.get(b'e')!r}"
+                        )
+        return replies
+
+    # -- iterative lookup ------------------------------------------------
+
+    def get_peers(
+        self,
+        info_hash: bytes,
+        token: CancelToken | None = None,
+        max_peers: int = 50,
+        max_rounds: int = 12,
+    ) -> list[tuple[str, int]]:
+        """Iterative get_peers lookup; returns discovered peer addresses
+        (possibly empty — the caller decides whether that is fatal)."""
+        if len(info_hash) != 20:
+            raise DHTError("info-hash must be 20 bytes")
+
+        def distance(node_id: bytes) -> int:
+            return int.from_bytes(node_id, "big") ^ int.from_bytes(
+                info_hash, "big"
+            )
+
+        peers: list[tuple[str, int]] = []
+        queried: set[tuple[str, int]] = set()
+        # shortlist entries: (distance, node_id, host, port); bootstrap
+        # routers get the maximum distance so real nodes displace them
+        shortlist: list[tuple[int, bytes, str, int]] = [
+            (1 << 161, b"", host, port) for host, port in self._bootstrap
+        ]
+
+        with _SockPool() as pool:
+            for _ in range(max_rounds):
+                if token is not None:
+                    token.raise_if_cancelled()
+                candidates = [
+                    (entry[2], entry[3])
+                    for entry in sorted(shortlist)[:K]
+                    if (entry[2], entry[3]) not in queried
+                ][:ALPHA]
+                if not candidates:
+                    break  # converged: everything near the target queried
+                queried.update(candidates)
+                replies = self._query_round(
+                    pool, candidates, b"get_peers", {b"info_hash": info_hash}
+                )
+                progressed = False
+                for reply in replies.values():
+                    for peer in _decode_compact_values(reply.get(b"values")):
+                        if peer not in peers:
+                            peers.append(peer)
+                            progressed = True
+                    nodes = reply.get(b"nodes")
+                    if isinstance(nodes, bytes):
+                        for node_id, host, port in _decode_compact_nodes(nodes):
+                            entry = (distance(node_id), node_id, host, port)
+                            if (
+                                entry not in shortlist
+                                and (host, port) not in queried
+                            ):
+                                shortlist.append(entry)
+                                progressed = True
+                if len(peers) >= max_peers:
+                    break
+                if not progressed:
+                    break  # round learned nothing new: lookup is done
+        if peers:
+            log.with_fields(peers=len(peers), queried=len(queried)).info(
+                "dht lookup found peers"
+            )
+        return peers

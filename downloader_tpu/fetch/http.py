@@ -255,8 +255,21 @@ class HTTPBackend:
             try:
                 response, offset = self._open(url, offset)
             except urllib.error.HTTPError as exc:
-                # a deterministic server answer: retrying won't change it
-                raise TransferError(f"http status {exc.code}") from exc
+                if exc.code < 500 and exc.code != 429:
+                    # a deterministic 4xx answer: retrying won't change it
+                    raise TransferError(f"http status {exc.code}") from exc
+                # 5xx/429 are transient server states (flaky proxy,
+                # overload, rate limit): treat like a network failure and
+                # burn a resume attempt below
+                exc.close()
+                attempts += 1
+                if attempts > self._max_resume_attempts:
+                    raise TransferError(f"http status {exc.code}") from exc
+                log.with_fields(
+                    url=url, status=exc.code, attempt=attempts
+                ).warning("transient http status; retrying")
+                time.sleep(min(0.2 * attempts, 1.0))
+                continue
             except (urllib.error.URLError, OSError) as exc:
                 # transient network failure (conn refused/reset mid-job,
                 # DNS blip): burns a resume attempt instead of killing

@@ -19,11 +19,31 @@ class MagnetError(ValueError):
     pass
 
 
+def parse_hostport(text: str) -> tuple[str, int] | None:
+    """``host:port`` / ``[v6]:port`` → (host, port); None if malformed
+    or the port is outside 1-65535 (sendto would raise OverflowError,
+    which is not an OSError and so would escape the callers' nets).
+    A bare IPv6 address without brackets is rejected rather than
+    misparsed into (address-prefix, last-group) garbage."""
+    host, sep, port = text.strip().rpartition(":")
+    if not sep or not host or not port.isdigit():
+        return None
+    if not 0 < int(port) < 65536:
+        return None
+    if ":" in host:  # IPv6 must be bracketed to be distinguishable
+        if not (host.startswith("[") and host.endswith("]")) or len(host) < 3:
+            return None
+        host = host[1:-1]
+    return (host, int(port))
+
+
 @dataclass
 class TorrentJob:
     info_hash: bytes  # 20-byte SHA-1 of the bencoded info dict
     display_name: str = ""
     trackers: tuple[str, ...] = ()
+    # explicit peer addresses from the magnet's x.pe params (BEP 9)
+    peer_hints: tuple[tuple[str, int], ...] = ()
     # populated when parsed from a .torrent file (magnet jobs fetch it
     # from peers via BEP 9 metadata exchange)
     info: dict | None = field(default=None, repr=False)
@@ -57,10 +77,17 @@ def parse_magnet(uri: str) -> TorrentJob:
     if not info_hash:
         raise MagnetError("magnet URI has no urn:btih exact topic")
 
+    peer_hints = [
+        parsed_hint
+        for parsed_hint in map(parse_hostport, params.get("x.pe", []))
+        if parsed_hint is not None
+    ]
+
     return TorrentJob(
         info_hash=info_hash,
         display_name=params.get("dn", [""])[0],
         trackers=tuple(params.get("tr", [])),
+        peer_hints=tuple(peer_hints),
     )
 
 

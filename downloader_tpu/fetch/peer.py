@@ -4,7 +4,8 @@ metadata exchange, piece verification, and file assembly.
 The reference gets all of this from anacrolix/torrent (torrent.go:10); this
 module implements the protocol stack directly on stdlib sockets:
 
-- HTTP(S) tracker announce with compact peer lists (BEP 3 / BEP 23),
+- HTTP(S) tracker announce with compact peer lists (BEP 3 / BEP 23) and
+  UDP tracker announce (BEP 15), plus explicit x.pe peer hints (BEP 9),
 - the peer wire protocol — handshake, choke/interest, request/piece
   (BEP 3), with the extension protocol handshake (BEP 10),
 - magnet metadata exchange via ut_metadata (BEP 9), SHA-1-verified against
@@ -17,8 +18,9 @@ module implements the protocol stack directly on stdlib sockets:
   builds a fresh client per job, torrent.go:43-44, SURVEY.md §5
   "Checkpoint / resume: absent").
 
-Scope note: peers come from trackers; DHT peer discovery is not yet
-implemented (trackerless magnets will fail with a clear error).
+Peers come from x.pe hints, trackers, and — when the trackers yield
+nothing — a mainline DHT get_peers lookup (BEP 5, fetch/dht.py), so
+trackerless magnets work like the reference's anacrolix client.
 """
 
 from __future__ import annotations
@@ -114,10 +116,7 @@ def announce(
     peers = reply.get(b"peers", b"")
     result: list[tuple[str, int]] = []
     if isinstance(peers, bytes):
-        for i in range(0, len(peers) - 5, 6):
-            host = str(ipaddress.IPv4Address(peers[i : i + 4]))
-            peer_port = struct.unpack(">H", peers[i + 4 : i + 6])[0]
-            result.append((host, peer_port))
+        result.extend(decode_compact_peers(peers))
     elif isinstance(peers, list):
         for entry in peers:
             if isinstance(entry, dict) and b"ip" in entry and b"port" in entry:
@@ -125,6 +124,129 @@ def announce(
                     (entry[b"ip"].decode("utf-8", "replace"), int(entry[b"port"]))
                 )
     return result
+
+
+def decode_compact_peers(blob: bytes) -> list[tuple[str, int]]:
+    """BEP 23 compact peer list: 6 bytes per peer (IPv4 + big-endian port)."""
+    return [
+        (
+            str(ipaddress.IPv4Address(blob[i : i + 4])),
+            struct.unpack(">H", blob[i + 4 : i + 6])[0],
+        )
+        for i in range(0, len(blob) - 5, 6)
+    ]
+
+
+# UDP tracker protocol (BEP 15)
+
+_UDP_PROTOCOL_ID = 0x41727101980  # magic constant from the spec
+_UDP_ACTION_CONNECT = 0
+_UDP_ACTION_ANNOUNCE = 1
+_UDP_ACTION_ERROR = 3
+
+
+def _udp_roundtrip(
+    sock: socket.socket,
+    addr: tuple[str, int],
+    request: bytes,
+    transaction_id: int,
+    timeout: float,
+    retries: int,
+) -> bytes:
+    """Send and await the reply with matching transaction id; BEP 15
+    prescribes resend-on-timeout (spec: 15*2^n — scaled down here by the
+    caller's timeout since a media job shouldn't stall a minute per
+    tracker). Each attempt runs against a monotonic deadline, so a
+    chatty host spraying non-matching datagrams cannot reset the clock
+    and stall the announce past its documented bound."""
+    for attempt in range(retries + 1):
+        sock.sendto(request, addr)
+        deadline = time.monotonic() + timeout * (2**attempt)
+        try:
+            while True:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    raise socket.timeout()
+                sock.settimeout(remain)
+                reply, _ = sock.recvfrom(65536)
+                if len(reply) < 8:
+                    continue
+                action, tid = struct.unpack(">II", reply[:8])
+                if tid != transaction_id:
+                    continue  # stale datagram from an earlier attempt
+                if action == _UDP_ACTION_ERROR:
+                    message = reply[8:].decode("utf-8", "replace")
+                    raise TransferError(f"tracker error: {message}")
+                return reply
+        except socket.timeout:
+            continue
+    raise TransferError(f"tracker timed out after {retries + 1} attempts")
+
+
+def announce_udp(
+    tracker_url: str,
+    info_hash: bytes,
+    peer_id: bytes,
+    left: int,
+    port: int = 6881,
+    timeout: float = 3.0,
+    retries: int = 1,
+) -> list[tuple[str, int]]:
+    """UDP announce (BEP 15): connect handshake to obtain a connection
+    id, then announce; returns peer (host, port) pairs. Defaults bound a
+    dead tracker to ~9 s (3+6), not the spec's minute-plus schedule — a
+    media job with several dead trackers shouldn't stall the pipeline."""
+    parsed = urllib.parse.urlparse(tracker_url)
+    if parsed.scheme != "udp" or not parsed.hostname:
+        raise TransferError(f"not a udp tracker url: {tracker_url}")
+    try:
+        tracker_port = parsed.port  # raises ValueError when out of range
+    except ValueError as exc:
+        raise TransferError(f"udp tracker port invalid: {tracker_url}") from exc
+    if tracker_port is None:
+        # there is no meaningful default port for UDP trackers; guessing
+        # one buys a silent full-timeout stall instead of a clear error
+        raise TransferError(f"udp tracker url has no port: {tracker_url}")
+    addr = (parsed.hostname, tracker_port)
+
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as sock:
+        try:
+            tid = struct.unpack(">I", secrets.token_bytes(4))[0]
+            reply = _udp_roundtrip(
+                sock,
+                addr,
+                struct.pack(">QII", _UDP_PROTOCOL_ID, _UDP_ACTION_CONNECT, tid),
+                tid,
+                timeout,
+                retries,
+            )
+            if len(reply) < 16 or struct.unpack(">I", reply[:4])[0] != 0:
+                raise TransferError("malformed connect reply from tracker")
+            connection_id = struct.unpack(">Q", reply[8:16])[0]
+
+            tid = struct.unpack(">I", secrets.token_bytes(4))[0]
+            request = struct.pack(
+                ">QII20s20sQQQIIIiH",
+                connection_id,
+                _UDP_ACTION_ANNOUNCE,
+                tid,
+                info_hash,
+                peer_id,
+                0,  # downloaded
+                left,
+                0,  # uploaded
+                2,  # event: started
+                0,  # IP (default: sender address)
+                struct.unpack(">I", secrets.token_bytes(4))[0],  # key
+                -1,  # num_want: default
+                port,
+            )
+            reply = _udp_roundtrip(sock, addr, request, tid, timeout, retries)
+            if len(reply) < 20 or struct.unpack(">I", reply[:4])[0] != 1:
+                raise TransferError("malformed announce reply from tracker")
+            return decode_compact_peers(reply[20:])
+        except OSError as exc:
+            raise TransferError(f"tracker announce failed: {exc}") from exc
 
 
 # ---------------------------------------------------------------------------
@@ -499,36 +621,73 @@ class SwarmDownloader:
         metadata_timeout: float = 600.0,
         progress_interval: float = 1.0,
         peer_id: bytes | None = None,
+        dht_bootstrap: tuple[tuple[str, int], ...] | None = None,
     ):
         self._job = job
         self._base_dir = base_dir
         self._metadata_timeout = metadata_timeout
         self._progress_interval = progress_interval
         self._peer_id = peer_id or generate_peer_id()
+        # None = BEP 5 default routers; () disables DHT entirely
+        self._dht_bootstrap = dht_bootstrap
 
-    def _discover_peers(self, left: int) -> list[tuple[str, int]]:
-        if not self._job.trackers:
-            raise TransferError(
-                "no trackers in torrent job and DHT is not implemented; "
-                "cannot discover peers"
-            )
-        peers: list[tuple[str, int]] = []
+    def _discover_peers(
+        self, left: int, token: CancelToken | None = None
+    ) -> list[tuple[str, int]]:
+        """Explicit x.pe hints first (they cost nothing), then every
+        tracker — http(s) per BEP 3/23, udp per BEP 15 — and a DHT
+        get_peers lookup (BEP 5) when the trackers yield nothing: x.pe
+        hints are unverified, so they must not suppress the lookup."""
+        peers: list[tuple[str, int]] = list(self._job.peer_hints)
+        tracker_answered = False
         errors: list[str] = []
         for tracker in self._job.trackers:
-            if not tracker.startswith(("http://", "https://")):
-                errors.append(f"{tracker}: unsupported tracker scheme")
-                continue
+            if token is not None:
+                token.raise_if_cancelled()
             try:
-                for peer in announce(
-                    tracker, self._job.info_hash, self._peer_id, left
-                ):
+                if tracker.startswith(("http://", "https://")):
+                    found = announce(
+                        tracker, self._job.info_hash, self._peer_id, left
+                    )
+                elif tracker.startswith("udp://"):
+                    found = announce_udp(
+                        tracker, self._job.info_hash, self._peer_id, left
+                    )
+                else:
+                    errors.append(f"{tracker}: unsupported tracker scheme")
+                    continue
+                # any non-empty announce counts, even if it only repeats
+                # the x.pe hints — a tracker-confirmed peer is no reason
+                # to fall through to a DHT lookup
+                tracker_answered = tracker_answered or bool(found)
+                for peer in found:
                     if peer not in peers:
                         peers.append(peer)
             except TransferError as exc:
+                errors.append(f"{tracker}: {exc}")
+
+        if not tracker_answered and self._dht_bootstrap != ():
+            from .dht import DHTClient, DHTError
+
+            log.with_fields(
+                info_hash=self._job.info_hash.hex()
+            ).info("no peers from trackers; trying dht")
+            try:
+                client = (
+                    DHTClient(bootstrap=self._dht_bootstrap)
+                    if self._dht_bootstrap is not None
+                    else DHTClient()
+                )
+                for peer in client.get_peers(self._job.info_hash, token):
+                    if peer not in peers:
+                        peers.append(peer)
+            except DHTError as exc:
                 errors.append(str(exc))
+
         if not peers:
             raise TransferError(
-                f"no peers from {len(self._job.trackers)} tracker(s): "
+                f"no peers from {len(self._job.trackers)} tracker(s), "
+                f"{len(self._job.peer_hints)} hint(s), or dht: "
                 + "; ".join(errors[:3])
             )
         return peers
@@ -540,7 +699,7 @@ class SwarmDownloader:
         peers: list[tuple[str, int]] | None = None
         last_error: Exception | None = None
         if info is None:
-            peers = self._discover_peers(left=1)
+            peers = self._discover_peers(left=1, token=token)
             log.info("fetching torrent metadata")
             for host, port in peers:
                 token.raise_if_cancelled()
@@ -571,7 +730,7 @@ class SwarmDownloader:
 
         if peers is None:
             peers = self._discover_peers(
-                left=store.total_length - store.bytes_completed()
+                left=store.total_length - store.bytes_completed(), token=token
             )
 
         log.with_fields(

@@ -40,9 +40,12 @@ class TorrentBackend:
         self,
         progress_interval: float = 1.0,
         metadata_timeout: float = METADATA_TIMEOUT,
+        dht_bootstrap: tuple[tuple[str, int], ...] | None = None,
     ):
         self._progress_interval = progress_interval
         self._metadata_timeout = metadata_timeout
+        # None = BEP 5 defaults; () disables DHT (hermetic tests)
+        self._dht_bootstrap = dht_bootstrap
 
     def register(self) -> BackendRegistration:
         return BackendRegistration(
@@ -97,6 +100,7 @@ class TorrentBackend:
             base_dir,
             metadata_timeout=self._metadata_timeout,
             progress_interval=self._progress_interval,
+            dht_bootstrap=self._dht_bootstrap,
         )
         downloader.run(token, lambda percent: progress(url, percent))
         progress(url, 100.0)

@@ -121,3 +121,30 @@ def test_cpuprofile_written(file_server, tmp_path):
 
     stats = pstats.Stats(str(profile))  # parses → valid profile dump
     assert stats.total_calls > 0
+
+
+def test_dht_bootstrap_from_env(monkeypatch):
+    from downloader_tpu.cli import _dht_bootstrap_from_env
+
+    monkeypatch.delenv("DHT_BOOTSTRAP", raising=False)
+    assert _dht_bootstrap_from_env() is None  # BEP 5 default routers
+    monkeypatch.setenv("DHT_BOOTSTRAP", "off")
+    assert _dht_bootstrap_from_env() == ()
+    monkeypatch.setenv("DHT_BOOTSTRAP", "10.0.0.1:6881, [::1]:999, junk")
+    assert _dht_bootstrap_from_env() == (("10.0.0.1", 6881), ("::1", 999))
+
+
+def test_dht_bootstrap_malformed_falls_back_to_defaults(monkeypatch):
+    # a typo'd value must not silently become the disable-DHT sentinel ()
+    from downloader_tpu.cli import _dht_bootstrap_from_env
+
+    monkeypatch.setenv("DHT_BOOTSTRAP", "router.bittorrent.com")  # no port
+    assert _dht_bootstrap_from_env() is None
+
+
+def test_dht_bootstrap_out_of_range_port_dropped(monkeypatch):
+    # 99999 would raise OverflowError (not OSError) from UDP sendto
+    from downloader_tpu.cli import _dht_bootstrap_from_env
+
+    monkeypatch.setenv("DHT_BOOTSTRAP", "10.0.0.1:99999,10.0.0.2:6881")
+    assert _dht_bootstrap_from_env() == (("10.0.0.2", 6881),)

@@ -37,6 +37,13 @@ class Handler(http.server.BaseHTTPRequestHandler):
         if self.path == "/404":
             self.send_error(404)
             return
+        if self.path == "/err503":
+            remaining = Handler.flaky_failures.get(self.path, 0)
+            if remaining > 0:
+                Handler.flaky_failures[self.path] = remaining - 1
+                self.send_error(503)
+                return
+            # recovered: fall through and serve the payload
         if self.path == "/slow":
             self.send_response(200)
             self.send_header("Content-Length", str(10**9))
@@ -190,6 +197,23 @@ def test_transient_open_failure_burns_attempt_not_job(server, tmp_path):
     with pytest.raises(TransferError):
         backend.download(
             CancelToken(), str(tmp_path), lambda u, p: None, f"{server}/file.mkv"
+        )
+
+
+def test_transient_503_retries_then_succeeds(server, tmp_path):
+    """5xx/429 are transient server states: burn a resume attempt and
+    retry rather than falling back to the costlier broker redelivery."""
+    Handler.flaky_failures["/err503"] = 2
+    backend = HTTPBackend(progress_interval=0.01, timeout=5)
+    backend.download(
+        CancelToken(), str(tmp_path), lambda u, p: None, f"{server}/err503"
+    )
+    assert (tmp_path / "err503").read_bytes() == PAYLOAD
+
+    Handler.flaky_failures["/err503"] = 99  # never recovers
+    with pytest.raises(TransferError, match="503"):
+        backend.download(
+            CancelToken(), str(tmp_path), lambda u, p: None, f"{server}/err503"
         )
 
 

@@ -1,10 +1,14 @@
 """BitTorrent stack tests: bencode vectors/fuzz, magnet and metainfo
 parsing, and full hermetic swarm downloads (magnet via BEP 9 metadata
-exchange, .torrent via HTTP, single- and multi-file layouts)."""
+exchange, .torrent via HTTP, UDP trackers per BEP 15, x.pe peer hints,
+single- and multi-file layouts)."""
 
 import hashlib
 import http.server
+import ipaddress
 import os
+import socket
+import struct
 import threading
 
 import pytest
@@ -16,10 +20,85 @@ from downloader_tpu.fetch.magnet import (
     parse_magnet,
     parse_metainfo,
 )
-from downloader_tpu.fetch.peer import PieceStore, SwarmDownloader
+from downloader_tpu.fetch.peer import (
+    PieceStore,
+    SwarmDownloader,
+    announce_udp,
+    generate_peer_id,
+)
 from downloader_tpu.fetch.seeder import Seeder, make_torrent
 from downloader_tpu.fetch.torrent import TorrentBackend
 from downloader_tpu.utils.cancel import CancelToken
+
+
+class FakeUDPTracker:
+    """Minimal BEP 15 tracker: connect handshake then announce with a
+    fixed peer list. ``drop`` swallows the first N datagrams to exercise
+    the client's retransmit; ``error`` replies action=3 with a message."""
+
+    CONNECTION_ID = 0x1122334455667788
+
+    def __init__(self, peers, drop: int = 0, error: str | None = None):
+        self.peers = peers
+        self.drop = drop
+        self.error = error
+        self.announces = []
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.settimeout(0.2)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"udp://127.0.0.1:{self._sock.getsockname()[1]}"
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                datagram, addr = self._sock.recvfrom(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if self.drop > 0:
+                self.drop -= 1
+                continue
+            if len(datagram) < 16:
+                continue
+            action, tid = struct.unpack(">II", datagram[8:16])
+            if self.error is not None:
+                self._sock.sendto(
+                    struct.pack(">II", 3, tid) + self.error.encode(), addr
+                )
+            elif action == 0:
+                self._sock.sendto(
+                    struct.pack(">IIQ", 0, tid, self.CONNECTION_ID), addr
+                )
+            elif action == 1:
+                connection_id = struct.unpack(">Q", datagram[:8])[0]
+                if connection_id != self.CONNECTION_ID:
+                    continue  # client skipped the handshake
+                self.announces.append(datagram)
+                compact = b"".join(
+                    ipaddress.IPv4Address(host).packed + struct.pack(">H", port)
+                    for host, port in self.peers
+                )
+                self._sock.sendto(
+                    struct.pack(">IIIII", 1, tid, 60, 1, 1) + compact, addr
+                )
+
+    def close(self):
+        self._stop.set()
+        self._sock.close()
+        self._thread.join(timeout=2)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 class TestBencode:
@@ -79,6 +158,24 @@ class TestMagnet:
         digest = hashlib.sha1(b"y").digest()
         b32 = base64.b32encode(digest).decode()
         assert parse_magnet(f"magnet:?xt=urn:btih:{b32}").info_hash == digest
+
+    def test_parse_x_pe_peer_hints(self):
+        job = parse_magnet(
+            f"magnet:?xt=urn:btih:{'a' * 40}"
+            "&x.pe=1.2.3.4:6881&x.pe=%5B%3A%3A1%5D:51413&x.pe=garbage"
+        )
+        assert job.peer_hints == (("1.2.3.4", 6881), ("::1", 51413))
+
+    def test_parse_hostport_edge_cases(self):
+        from downloader_tpu.fetch.magnet import parse_hostport
+
+        assert parse_hostport("[2001:db8::1]:6881") == ("2001:db8::1", 6881)
+        # a bare IPv6 address must be rejected, not misparsed into
+        # (address-prefix, last-group)
+        assert parse_hostport("2001:db8::1") is None
+        assert parse_hostport("host:0") is None
+        assert parse_hostport("host:70000") is None
+        assert parse_hostport(":6881") is None
 
     @pytest.mark.parametrize(
         "bad",
@@ -193,18 +290,76 @@ class TestSwarmDownload:
         assert (tmp_path / "pack/season 1/e1.mkv").read_bytes() == files["season 1/e1.mkv"]
         assert (tmp_path / "pack/notes.txt").read_bytes() == files["notes.txt"]
 
-    def test_trackerless_magnet_fails_clearly(self, tmp_path):
-        magnet = f"magnet:?xt=urn:btih:{'0' * 40}"
-        with pytest.raises(TransferError) as excinfo:
-            TorrentBackend().download(
+    def test_magnet_with_udp_tracker(self, seeder, tmp_path):
+        """Full magnet flow where peer discovery rides BEP 15."""
+        with FakeUDPTracker([seeder.peer_address]) as tracker:
+            magnet = (
+                f"magnet:?xt=urn:btih:{seeder.info_hash.hex()}"
+                f"&tr={tracker.url}"
+            )
+            TorrentBackend(progress_interval=0.01).download(
                 CancelToken(), str(tmp_path), lambda u, p: None, magnet
             )
-        assert "DHT" in str(excinfo.value) or "tracker" in str(excinfo.value)
+        assert (tmp_path / "movie.mkv").read_bytes() == PAYLOAD
+        assert tracker.announces, "client never announced over UDP"
+
+    def test_magnet_with_x_pe_hint_needs_no_tracker(self, seeder, tmp_path):
+        """BEP 9 x.pe peer hints alone must suffice for the download
+        (dht_bootstrap=() keeps the test hermetic — with no trackers the
+        unverified hints would otherwise also trigger a DHT lookup)."""
+        host, port = seeder.peer_address
+        magnet = (
+            f"magnet:?xt=urn:btih:{seeder.info_hash.hex()}&x.pe={host}:{port}"
+        )
+        TorrentBackend(progress_interval=0.01, dht_bootstrap=()).download(
+            CancelToken(), str(tmp_path), lambda u, p: None, magnet
+        )
+        assert (tmp_path / "movie.mkv").read_bytes() == PAYLOAD
+
+    def test_tracker_confirming_hint_suppresses_dht(self, seeder, tmp_path):
+        """A live tracker whose peers merely duplicate the x.pe hints is
+        still a tracker answer — no DHT lookup should fire."""
+        host, port = seeder.peer_address
+        with FakeUDPTracker([(host, port)]) as tracker:
+            with FakeDHTNode() as router:
+                magnet = (
+                    f"magnet:?xt=urn:btih:{seeder.info_hash.hex()}"
+                    f"&x.pe={host}:{port}&tr={tracker.url}"
+                )
+                TorrentBackend(
+                    progress_interval=0.01, dht_bootstrap=(router.address,)
+                ).download(
+                    CancelToken(), str(tmp_path), lambda u, p: None, magnet
+                )
+                assert not router.queries, "DHT queried despite tracker answer"
+        assert (tmp_path / "movie.mkv").read_bytes() == PAYLOAD
+
+    def test_dead_x_pe_hint_falls_back_to_dht(self, seeder, tmp_path):
+        """A stale hint must not suppress DHT discovery (the reference's
+        anacrolix client would find live peers via DHT on such magnets)."""
+        with FakeDHTNode(values=[seeder.peer_address]) as router:
+            magnet = (
+                f"magnet:?xt=urn:btih:{seeder.info_hash.hex()}"
+                "&x.pe=127.0.0.1:9"  # discard port: nobody listens
+            )
+            TorrentBackend(
+                progress_interval=0.01, dht_bootstrap=(router.address,)
+            ).download(CancelToken(), str(tmp_path), lambda u, p: None, magnet)
+        assert (tmp_path / "movie.mkv").read_bytes() == PAYLOAD
+
+    def test_trackerless_magnet_fails_clearly(self, tmp_path):
+        # dht_bootstrap=() disables DHT so the test stays hermetic
+        magnet = f"magnet:?xt=urn:btih:{'0' * 40}"
+        with pytest.raises(TransferError) as excinfo:
+            TorrentBackend(dht_bootstrap=()).download(
+                CancelToken(), str(tmp_path), lambda u, p: None, magnet
+            )
+        assert "dht" in str(excinfo.value) or "tracker" in str(excinfo.value)
 
     def test_dead_tracker_fails_clearly(self, tmp_path):
         magnet = f"magnet:?xt=urn:btih:{'1' * 40}&tr=http://127.0.0.1:9/ann"
         with pytest.raises(TransferError):
-            TorrentBackend().download(
+            TorrentBackend(dht_bootstrap=()).download(
                 CancelToken(), str(tmp_path), lambda u, p: None, magnet
             )
 
@@ -218,6 +373,182 @@ class TestSwarmDownload:
 
         with pytest.raises((Cancelled, TransferError)):
             downloader.run(token, lambda p: None)
+
+
+class TestUDPTracker:
+    INFO_HASH = bytes(range(20))
+
+    def test_announce_returns_peers(self):
+        peers = [("10.1.2.3", 6881), ("10.4.5.6", 51413)]
+        with FakeUDPTracker(peers) as tracker:
+            got = announce_udp(
+                tracker.url, self.INFO_HASH, generate_peer_id(), left=123
+            )
+        assert got == peers
+        # announce carried our info-hash and the bytes left
+        request = tracker.announces[0]
+        assert request[16:36] == self.INFO_HASH
+        assert struct.unpack(">Q", request[64:72])[0] == 123
+
+    def test_announce_retransmits_after_drop(self):
+        with FakeUDPTracker([("10.0.0.1", 1)], drop=1) as tracker:
+            got = announce_udp(
+                tracker.url,
+                self.INFO_HASH,
+                generate_peer_id(),
+                left=0,
+                timeout=0.3,
+            )
+        assert got == [("10.0.0.1", 1)]
+
+    def test_tracker_error_propagates(self):
+        with FakeUDPTracker([], error="torrent not registered") as tracker:
+            with pytest.raises(TransferError, match="torrent not registered"):
+                announce_udp(
+                    tracker.url, self.INFO_HASH, generate_peer_id(), left=0
+                )
+
+    def test_portless_udp_tracker_rejected_fast(self):
+        with pytest.raises(TransferError, match="no port"):
+            announce_udp(
+                "udp://tracker.example.com/announce",
+                self.INFO_HASH,
+                generate_peer_id(),
+                left=0,
+            )
+
+    def test_out_of_range_udp_tracker_port_is_transfer_error(self):
+        # ValueError from urlparse.port must not escape as a job crash
+        with pytest.raises(TransferError, match="port invalid"):
+            announce_udp(
+                "udp://tracker.example.com:99999/announce",
+                self.INFO_HASH,
+                generate_peer_id(),
+                left=0,
+            )
+
+    def test_dead_udp_tracker_times_out(self):
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind(("127.0.0.1", 0))  # bound but nobody answering
+        port = sock.getsockname()[1]
+        try:
+            with pytest.raises(TransferError, match="timed out"):
+                announce_udp(
+                    f"udp://127.0.0.1:{port}",
+                    self.INFO_HASH,
+                    generate_peer_id(),
+                    left=0,
+                    timeout=0.1,
+                    retries=1,
+                )
+        finally:
+            sock.close()
+
+
+class FakeDHTNode:
+    """Minimal BEP 5 node: answers get_peers with a fixed ``values``
+    peer list and/or compact ``nodes`` pointers to other fake nodes."""
+
+    def __init__(self, values=(), nodes=()):
+        self.node_id = os.urandom(20)
+        self.values = list(values)  # [(host, port)]
+        self.nodes = list(nodes)  # [FakeDHTNode]
+        self.queries = []
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.settimeout(0.2)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self):
+        return ("127.0.0.1", self._sock.getsockname()[1])
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                datagram, addr = self._sock.recvfrom(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                message = decode(datagram)
+            except BencodeError:
+                continue
+            self.queries.append(message)
+            response = {b"id": self.node_id}
+            if self.values:
+                response[b"values"] = [
+                    ipaddress.IPv4Address(host).packed + struct.pack(">H", port)
+                    for host, port in self.values
+                ]
+            if self.nodes:
+                response[b"nodes"] = b"".join(
+                    node.node_id
+                    + ipaddress.IPv4Address(node.address[0]).packed
+                    + struct.pack(">H", node.address[1])
+                    for node in self.nodes
+                )
+            self._sock.sendto(
+                encode({b"t": message[b"t"], b"y": b"r", b"r": response}), addr
+            )
+
+    def close(self):
+        self._stop.set()
+        self._sock.close()
+        self._thread.join(timeout=2)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class TestDHT:
+    INFO_HASH = bytes(range(20))
+
+    def test_lookup_follows_nodes_to_peers(self):
+        from downloader_tpu.fetch.dht import DHTClient
+
+        with FakeDHTNode(values=[("10.9.8.7", 1234)]) as leaf:
+            with FakeDHTNode(nodes=[leaf]) as router:
+                client = DHTClient(
+                    bootstrap=(router.address,), query_timeout=1.0
+                )
+                peers = client.get_peers(self.INFO_HASH)
+        assert peers == [("10.9.8.7", 1234)]
+        # both hops saw a well-formed get_peers query for our info-hash
+        for node in (router, leaf):
+            query = node.queries[0]
+            assert query[b"q"] == b"get_peers"
+            assert query[b"a"][b"info_hash"] == self.INFO_HASH
+
+    def test_lookup_converges_empty_on_silent_network(self):
+        from downloader_tpu.fetch.dht import DHTClient
+
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind(("127.0.0.1", 0))  # bound, never answers
+        try:
+            client = DHTClient(
+                bootstrap=(("127.0.0.1", sock.getsockname()[1]),),
+                query_timeout=0.2,
+            )
+            assert client.get_peers(self.INFO_HASH) == []
+        finally:
+            sock.close()
+
+    def test_trackerless_magnet_downloads_via_dht(self, seeder, tmp_path):
+        """The flow the reference gets from anacrolix's DHT node: a bare
+        info-hash magnet, peers discovered through the DHT."""
+        with FakeDHTNode(values=[seeder.peer_address]) as router:
+            magnet = f"magnet:?xt=urn:btih:{seeder.info_hash.hex()}"
+            TorrentBackend(
+                progress_interval=0.01, dht_bootstrap=(router.address,)
+            ).download(CancelToken(), str(tmp_path), lambda u, p: None, magnet)
+        assert (tmp_path / "movie.mkv").read_bytes() == PAYLOAD
 
 
 class TestBencodeEdge:
