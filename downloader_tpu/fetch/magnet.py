@@ -26,7 +26,9 @@ def parse_hostport(text: str) -> tuple[str, int] | None:
     A bare IPv6 address without brackets is rejected rather than
     misparsed into (address-prefix, last-group) garbage."""
     host, sep, port = text.strip().rpartition(":")
-    if not sep or not host or not port.isdigit():
+    # isascii() too: Unicode digits (e.g. '²') pass isdigit() but make
+    # int() raise, which would escape as ValueError instead of None
+    if not sep or not host or not port.isdigit() or not port.isascii():
         return None
     if not 0 < int(port) < 65536:
         return None

@@ -25,19 +25,22 @@ trackerless magnets work like the reference's anacrolix client.
 
 from __future__ import annotations
 
+import concurrent.futures
 import hashlib
 import ipaddress
 import os
 import secrets
+import select
 import socket
 import struct
+import threading
 import time
 import urllib.parse
 import urllib.request
 
 from ..parallel import DigestEngine, default_engine
 from ..utils import get_logger
-from ..utils.cancel import CancelToken
+from ..utils.cancel import Cancelled, CancelToken
 from . import bencode
 from .http import TransferError
 from .magnet import TorrentJob
@@ -364,6 +367,24 @@ class PeerConnection:
             return False
         return bool(self.bitfield[byte_index] & (0x80 >> bit))
 
+    def poll_messages(self, duration: float) -> None:
+        """Drain incoming messages for up to ``duration`` seconds,
+        updating choke/bitfield state. Used while holding a connection
+        idle (swarm WAIT) so a remote CHOKE is processed now instead of
+        surfacing as a stale frame mid-piece later. Readability is
+        checked first so an idle wait never consumes a partial frame."""
+        deadline = time.monotonic() + duration
+        while True:
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                return
+            readable, _, _ = select.select([self._sock], [], [], remain)
+            if not readable:
+                return
+            # a frame has started arriving; read_message blocks under the
+            # normal socket timeout until it completes, keeping framing
+            self.read_message()
+
     def close(self) -> None:
         try:
             self._sock.close()
@@ -472,6 +493,10 @@ class PieceStore:
                 f"{expected_pieces} pieces"
             )
         self.have = [False] * len(self.piece_hashes)
+        # serializes write_piece file IO: concurrent peer workers would
+        # otherwise race the exists()/"wb" decision and truncate each
+        # other's bytes in shared files
+        self._write_lock = threading.Lock()
 
     @property
     def num_pieces(self) -> int:
@@ -593,20 +618,21 @@ class PieceStore:
         offset = index * self.piece_length
         cursor = 0
         file_start = 0
-        for path, length in self.files:
-            file_end = file_start + length
-            if offset + cursor < file_end and offset + len(data) > file_start:
-                begin_in_file = max(offset + cursor - file_start, 0)
-                take = min(file_end - (offset + cursor), len(data) - cursor)
-                os.makedirs(os.path.dirname(path), exist_ok=True)
-                with open(path, "r+b" if os.path.exists(path) else "wb") as sink:
-                    sink.seek(begin_in_file)
-                    sink.write(data[cursor : cursor + take])
-                cursor += take
-                if cursor == len(data):
-                    break
-            file_start = file_end
-        self.have[index] = True
+        with self._write_lock:
+            for path, length in self.files:
+                file_end = file_start + length
+                if offset + cursor < file_end and offset + len(data) > file_start:
+                    begin_in_file = max(offset + cursor - file_start, 0)
+                    take = min(file_end - (offset + cursor), len(data) - cursor)
+                    os.makedirs(os.path.dirname(path), exist_ok=True)
+                    with open(path, "r+b" if os.path.exists(path) else "wb") as sink:
+                        sink.seek(begin_in_file)
+                        sink.write(data[cursor : cursor + take])
+                    cursor += take
+                    if cursor == len(data):
+                        break
+                file_start = file_end
+            self.have[index] = True
 
 
 # ---------------------------------------------------------------------------
@@ -622,6 +648,7 @@ class SwarmDownloader:
         progress_interval: float = 1.0,
         peer_id: bytes | None = None,
         dht_bootstrap: tuple[tuple[str, int], ...] | None = None,
+        max_peer_connections: int = 4,
     ):
         self._job = job
         self._base_dir = base_dir
@@ -630,6 +657,7 @@ class SwarmDownloader:
         self._peer_id = peer_id or generate_peer_id()
         # None = BEP 5 default routers; () disables DHT entirely
         self._dht_bootstrap = dht_bootstrap
+        self._max_peer_connections = max(1, max_peer_connections)
 
     def _discover_peers(
         self, left: int, token: CancelToken | None = None
@@ -641,30 +669,47 @@ class SwarmDownloader:
         peers: list[tuple[str, int]] = list(self._job.peer_hints)
         tracker_answered = False
         errors: list[str] = []
-        for tracker in self._job.trackers:
+
+        def one_announce(tracker: str) -> list[tuple[str, int]]:
+            if tracker.startswith(("http://", "https://")):
+                return announce(
+                    tracker, self._job.info_hash, self._peer_id, left
+                )
+            if tracker.startswith("udp://"):
+                return announce_udp(
+                    tracker, self._job.info_hash, self._peer_id, left
+                )
+            raise TransferError("unsupported tracker scheme")
+
+        if self._job.trackers:
             if token is not None:
                 token.raise_if_cancelled()
-            try:
-                if tracker.startswith(("http://", "https://")):
-                    found = announce(
-                        tracker, self._job.info_hash, self._peer_id, left
-                    )
-                elif tracker.startswith("udp://"):
-                    found = announce_udp(
-                        tracker, self._job.info_hash, self._peer_id, left
-                    )
-                else:
-                    errors.append(f"{tracker}: unsupported tracker scheme")
-                    continue
-                # any non-empty announce counts, even if it only repeats
-                # the x.pe hints — a tracker-confirmed peer is no reason
-                # to fall through to a DHT lookup
-                tracker_answered = tracker_answered or bool(found)
-                for peer in found:
-                    if peer not in peers:
-                        peers.append(peer)
-            except TransferError as exc:
-                errors.append(f"{tracker}: {exc}")
+            # announce to every tracker concurrently: real magnets carry
+            # many tr= entries, mostly dead, and each dead one costs its
+            # full timeout — serially that is minutes before DHT fires
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(8, len(self._job.trackers)),
+                thread_name_prefix="announce",
+            ) as pool:
+                futures = {
+                    pool.submit(one_announce, tracker): tracker
+                    for tracker in self._job.trackers
+                }
+                for future in concurrent.futures.as_completed(futures):
+                    try:
+                        found = future.result()
+                    except TransferError as exc:
+                        errors.append(f"{futures[future]}: {exc}")
+                        continue
+                    # any non-empty announce counts, even if it only
+                    # repeats the x.pe hints — a tracker-confirmed peer
+                    # is no reason to fall through to a DHT lookup
+                    tracker_answered = tracker_answered or bool(found)
+                    for peer in found:
+                        if peer not in peers:
+                            peers.append(peer)
+            if token is not None:
+                token.raise_if_cancelled()
 
         if not tracker_answered and self._dht_bootstrap != ():
             from .dht import DHTClient, DHTError
@@ -734,69 +779,180 @@ class SwarmDownloader:
             )
 
         log.with_fields(
-            pieces=store.num_pieces, total=store.total_length
+            pieces=store.num_pieces,
+            total=store.total_length,
+            peers=len(peers),
         ).info("waiting for torrent download")
 
-        last_tick = time.monotonic()
-        for host, port in peers:
-            if all(store.have):
-                break
-            token.raise_if_cancelled()
-            try:
-                with PeerConnection(
-                    host, port, self._job.info_hash, self._peer_id, token
-                ) as conn:
-                    last_tick = self._download_from_peer(
-                        conn, store, token, progress, last_tick
-                    )
-            except (TransferError, OSError) as exc:
-                last_error = exc
-                log.with_fields(peer=f"{host}:{port}").warning(
-                    f"peer failed: {exc}; trying next"
-                )
+        swarm = _SwarmState(store, progress, self._progress_interval)
+        workers = [
+            threading.Thread(
+                target=self._peer_worker,
+                args=(swarm, token),
+                daemon=True,
+                name=f"peer-worker-{i}",
+            )
+            for i in range(min(self._max_peer_connections, len(peers)))
+        ]
+        for peer in peers:
+            swarm.peer_queue.append(peer)
+        for worker in workers:
+            worker.start()
+        for worker in workers:
+            # plain join is safe: each PeerConnection registers a cancel
+            # hook that closes its socket, so a cancel unblocks every
+            # worker promptly and they observe the token and exit
+            worker.join()
+        token.raise_if_cancelled()
 
         if not all(store.have):
             missing = store.have.count(False)
             raise TransferError(
                 f"failed to download torrents: {missing}/{store.num_pieces} "
-                f"pieces missing (last error: {last_error})"
+                f"pieces missing (last error: {swarm.last_error})"
             )
 
-    def _download_from_peer(
-        self, conn: PeerConnection, store: PieceStore, token, progress, last_tick
-    ) -> float:
+    def _peer_worker(self, swarm: "_SwarmState", token: CancelToken) -> None:
+        """One swarm worker: pull peers off the shared queue and serve
+        claimable pieces from each until the swarm is done."""
+        while not token.cancelled() and not swarm.done():
+            peer = swarm.next_peer()
+            if peer is None:
+                return  # no peers left to try
+            host, port = peer
+            try:
+                with PeerConnection(
+                    host, port, self._job.info_hash, self._peer_id, token
+                ) as conn:
+                    self._serve_pieces(conn, swarm, token)
+            except Cancelled:
+                return  # quiet exit; run() re-raises in the main thread
+            except Exception as exc:
+                # broad on purpose: an unexpected error (progress callback
+                # bug, select on a closed fd) must surface in the job's
+                # final error message, not die silently in the thread's
+                # excepthook and leave 'last error: None'
+                swarm.last_error = exc
+                log.with_fields(peer=f"{host}:{port}").warning(
+                    f"peer failed: {exc}; trying next"
+                )
+
+    def _serve_pieces(
+        self, conn: PeerConnection, swarm: "_SwarmState", token: CancelToken
+    ) -> None:
+        store = swarm.store
         conn.send_message(MSG_INTERESTED)
         while conn.choked:
             msg_id, _ = conn.read_message()
 
-        for index in range(store.num_pieces):
-            if store.have[index]:
-                continue
+        while True:
             token.raise_if_cancelled()
-            if conn.bitfield and not conn.has_piece(index):
+            index = swarm.claim(conn)
+            if index is swarm.WAIT:
+                # every missing piece is claimed by another worker; one
+                # may come back via release() if that worker's peer dies,
+                # so hold this healthy connection instead of dropping it
+                conn.poll_messages(0.05)
                 continue
-            size = store.piece_size(index)
-            blocks: dict[int, bytes] = {}
-            offsets = list(range(0, size, BLOCK_SIZE))
-            # pipeline all block requests for the piece
-            for begin in offsets:
-                conn.send_message(
-                    MSG_REQUEST,
-                    struct.pack(">III", index, begin, min(BLOCK_SIZE, size - begin)),
+            if index is None:
+                return  # done, or nothing left this peer can provide
+            try:
+                if conn.choked:  # choked while we idled in WAIT
+                    while conn.choked:
+                        conn.read_message()
+                size = store.piece_size(index)
+                blocks: dict[int, bytes] = {}
+                offsets = list(range(0, size, BLOCK_SIZE))
+                # pipeline all block requests for the piece
+                for begin in offsets:
+                    conn.send_message(
+                        MSG_REQUEST,
+                        struct.pack(
+                            ">III", index, begin, min(BLOCK_SIZE, size - begin)
+                        ),
+                    )
+                while len(blocks) < len(offsets):
+                    msg_id, payload = conn.read_message()
+                    if msg_id == MSG_CHOKE:
+                        raise PeerProtocolError("peer choked mid-piece")
+                    if msg_id != MSG_PIECE or len(payload) < 8:
+                        continue
+                    got_index, begin = struct.unpack(">II", payload[:8])
+                    if got_index == index:
+                        blocks[begin] = payload[8:]
+                store.write_piece(
+                    index, b"".join(blocks[b] for b in sorted(blocks))
                 )
-            while len(blocks) < len(offsets):
-                msg_id, payload = conn.read_message()
-                if msg_id == MSG_CHOKE:
-                    raise PeerProtocolError("peer choked mid-piece")
-                if msg_id != MSG_PIECE or len(payload) < 8:
-                    continue
-                got_index, begin = struct.unpack(">II", payload[:8])
-                if got_index == index:
-                    blocks[begin] = payload[8:]
-            store.write_piece(index, b"".join(blocks[b] for b in sorted(blocks)))
+            except BaseException:
+                swarm.release(index)  # let another worker/peer retry it
+                raise
+            swarm.tick_progress()
 
+
+class _SwarmState:
+    """Shared state for the concurrent peer workers: the peer queue, the
+    claimed-piece set, and throttled progress reporting."""
+
+    WAIT = object()  # claim(): all missing pieces are claimed elsewhere
+
+    def __init__(self, store: PieceStore, progress, progress_interval: float):
+        self.store = store
+        self.peer_queue: list[tuple[str, int]] = []
+        self.last_error: Exception | None = None
+        self._claimed: set[int] = set()
+        self._lock = threading.Lock()
+        self._progress = progress
+        self._progress_interval = progress_interval
+        self._last_tick = time.monotonic()
+        # scan cursor: everything below it is permanently complete, so
+        # claims stay O(total) over the torrent instead of O(n^2)
+        self._scan_start = 0
+
+    def done(self) -> bool:
+        return all(self.store.have)
+
+    def next_peer(self) -> tuple[str, int] | None:
+        with self._lock:
+            return self.peer_queue.pop(0) if self.peer_queue else None
+
+    def claim(self, conn: PeerConnection):
+        """The lowest unclaimed missing piece this peer advertises.
+        Returns WAIT when missing pieces exist but every one is claimed
+        by another worker (the caller should hold the connection and
+        retry — a claim can come back via release()); None when the
+        torrent is done or this peer cannot provide anything missing."""
+        store = self.store
+        with self._lock:
+            while self._scan_start < store.num_pieces and store.have[
+                self._scan_start
+            ]:
+                self._scan_start += 1
+            if self._scan_start >= store.num_pieces:
+                return None  # torrent complete
+            worth_waiting = False
+            for index in range(self._scan_start, store.num_pieces):
+                if store.have[index]:
+                    continue
+                peer_has = not conn.bitfield or conn.has_piece(index)
+                if index in self._claimed:
+                    # were this claim released, could this peer serve it?
+                    worth_waiting = worth_waiting or peer_has
+                    continue
+                if not peer_has:
+                    continue  # peer lacks it; maybe the next one
+                self._claimed.add(index)
+                return index
+            return self.WAIT if worth_waiting else None
+
+    def release(self, index: int) -> None:
+        with self._lock:
+            self._claimed.discard(index)
+
+    def tick_progress(self) -> None:
+        store = self.store
+        with self._lock:
             now = time.monotonic()
-            if now - last_tick >= self._progress_interval:
-                last_tick = now
-                progress(store.bytes_completed() / store.total_length * 100)
-        return last_tick
+            if now - self._last_tick < self._progress_interval:
+                return
+            self._last_tick = now
+        self._progress(store.bytes_completed() / store.total_length * 100)

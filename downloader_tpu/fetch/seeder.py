@@ -83,6 +83,7 @@ class Seeder:
         self.info_bytes = bencode.encode(self.info)
         self.info_hash = hashlib.sha1(self.info_bytes).digest()
         self.piece_length = piece_length
+        self.served_requests: list[int] = []  # piece indexes peers requested
 
         seeder = self
 
@@ -214,6 +215,7 @@ class Seeder:
                 self._send(sock, MSG_UNCHOKE)
             elif msg_id == MSG_REQUEST:
                 index, begin, want = struct.unpack(">III", payload)
+                self.served_requests.append(index)  # list.append: GIL-atomic
                 start = index * self.piece_length + begin
                 chunk = self.blob[start : start + want]
                 self._send(

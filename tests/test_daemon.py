@@ -43,7 +43,11 @@ def file_server():
             remaining = Handler.fail_next.get(self.path, 0)
             if remaining > 0:
                 Handler.fail_next[self.path] = remaining - 1
-                self.send_error(503)
+                # 404: the HTTP backend treats this as permanent (unlike
+                # 5xx/429, which it absorbs with in-backend resume
+                # attempts), so the failure surfaces to the DAEMON's
+                # job-level retry machinery — what these tests exercise
+                self.send_error(404)
                 return
             self.send_response(200)
             self.send_header("Content-Length", str(len(MOVIE)))

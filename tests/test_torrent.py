@@ -176,6 +176,8 @@ class TestMagnet:
         assert parse_hostport("host:0") is None
         assert parse_hostport("host:70000") is None
         assert parse_hostport(":6881") is None
+        # Unicode digits pass isdigit() but crash int()
+        assert parse_hostport("1.2.3.4:²") is None
 
     @pytest.mark.parametrize(
         "bad",
@@ -347,6 +349,46 @@ class TestSwarmDownload:
             ).download(CancelToken(), str(tmp_path), lambda u, p: None, magnet)
         assert (tmp_path / "movie.mkv").read_bytes() == PAYLOAD
 
+    def test_concurrent_multi_peer_download(self, tmp_path):
+        """Two seeders for the same torrent: the swarm downloader must
+        split pieces across concurrent peer connections (the reference's
+        anacrolix client downloads from many peers at once)."""
+        data = bytes(range(256)) * 2400  # ~600 KiB => ~19 pieces
+        with Seeder("movie.mkv", data) as first:
+            with Seeder("movie.mkv", data) as second:
+                assert first.info_hash == second.info_hash
+                with FakeUDPTracker(
+                    [first.peer_address, second.peer_address]
+                ) as tracker:
+                    magnet = (
+                        f"magnet:?xt=urn:btih:{first.info_hash.hex()}"
+                        f"&tr={tracker.url}"
+                    )
+                    TorrentBackend(
+                        progress_interval=0.01, dht_bootstrap=()
+                    ).download(
+                        CancelToken(), str(tmp_path), lambda u, p: None, magnet
+                    )
+                # pieces actually split across BOTH connections — a
+                # regression to single-peer serving would leave one empty
+                assert first.served_requests and second.served_requests
+        assert (tmp_path / "movie.mkv").read_bytes() == data
+
+    def test_one_dead_peer_does_not_fail_swarm(self, seeder, tmp_path):
+        """A dead peer in the tracker's list must be skipped; the live
+        one completes the download."""
+        with FakeUDPTracker(
+            [("127.0.0.1", 9), seeder.peer_address]  # port 9: discard
+        ) as tracker:
+            magnet = (
+                f"magnet:?xt=urn:btih:{seeder.info_hash.hex()}"
+                f"&tr={tracker.url}"
+            )
+            TorrentBackend(progress_interval=0.01, dht_bootstrap=()).download(
+                CancelToken(), str(tmp_path), lambda u, p: None, magnet
+            )
+        assert (tmp_path / "movie.mkv").read_bytes() == PAYLOAD
+
     def test_trackerless_magnet_fails_clearly(self, tmp_path):
         # dht_bootstrap=() disables DHT so the test stays hermetic
         magnet = f"magnet:?xt=urn:btih:{'0' * 40}"
@@ -427,6 +469,38 @@ class TestUDPTracker:
                 left=0,
             )
 
+    def test_dead_trackers_announce_concurrently(self, seeder, tmp_path):
+        """Several dead trackers must cost max(timeout), not the sum:
+        discovery announces to all trackers concurrently."""
+        import time as time_mod
+
+        dead = [socket.socket(socket.AF_INET, socket.SOCK_DGRAM) for _ in range(3)]
+        for sock in dead:
+            sock.bind(("127.0.0.1", 0))  # bound, never answers (~9 s each)
+        try:
+            with FakeUDPTracker([seeder.peer_address]) as live:
+                trackers = "".join(
+                    f"&tr=udp://127.0.0.1:{sock.getsockname()[1]}"
+                    for sock in dead
+                )
+                magnet = (
+                    f"magnet:?xt=urn:btih:{seeder.info_hash.hex()}"
+                    f"{trackers}&tr={live.url}"
+                )
+                start = time_mod.monotonic()
+                TorrentBackend(
+                    progress_interval=0.01, dht_bootstrap=()
+                ).download(
+                    CancelToken(), str(tmp_path), lambda u, p: None, magnet
+                )
+                elapsed = time_mod.monotonic() - start
+        finally:
+            for sock in dead:
+                sock.close()
+        assert (tmp_path / "movie.mkv").read_bytes() == PAYLOAD
+        # serial would be ~27 s (3 dead x ~9 s) before the live tracker
+        assert elapsed < 18, f"announces appear serial: {elapsed:.1f}s"
+
     def test_dead_udp_tracker_times_out(self):
         sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         sock.bind(("127.0.0.1", 0))  # bound but nobody answering
@@ -443,6 +517,52 @@ class TestUDPTracker:
                 )
         finally:
             sock.close()
+
+
+class TestSwarmClaim:
+    """_SwarmState.claim: WAIT (hold the connection, a claim may come
+    back via release) vs None (peer is useless or torrent done)."""
+
+    class Conn:
+        def __init__(self, bitfield=None):
+            self.bitfield = bitfield
+
+        def has_piece(self, index):
+            byte = self.bitfield[index // 8]
+            return bool(byte & (0x80 >> (index % 8)))
+
+    def _swarm(self, tmp_path, pieces=3):
+        from downloader_tpu.fetch.peer import _SwarmState
+
+        piece_length = 32 * 1024
+        info, _, data = make_torrent(
+            "claim.bin", b"Q" * (pieces * piece_length), piece_length
+        )
+        store = PieceStore(info, str(tmp_path))
+        return _SwarmState(store, lambda p: None, 1.0), store
+
+    def test_wait_when_all_missing_pieces_claimed_elsewhere(self, tmp_path):
+        swarm, store = self._swarm(tmp_path)
+        full_peer = self.Conn()  # no bitfield => assume has everything
+        assert swarm.claim(full_peer) == 0
+        assert swarm.claim(full_peer) == 1
+        assert swarm.claim(full_peer) == 2
+        late_peer = self.Conn()
+        assert swarm.claim(late_peer) is swarm.WAIT  # hold, don't drop
+        swarm.release(1)
+        assert swarm.claim(late_peer) == 1  # released claim picked up
+
+    def test_none_when_peer_lacks_everything_unclaimed(self, tmp_path):
+        swarm, store = self._swarm(tmp_path)
+        empty_peer = self.Conn(bitfield=bytearray(b"\x00"))
+        assert swarm.claim(empty_peer) is None  # useless peer: move on
+
+    def test_none_when_torrent_complete(self, tmp_path):
+        swarm, store = self._swarm(tmp_path)
+        for i in range(store.num_pieces):
+            store.have[i] = True
+        assert swarm.claim(self.Conn()) is None
+        assert swarm.done()
 
 
 class FakeDHTNode:
