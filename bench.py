@@ -4,8 +4,11 @@ The reference publishes no numbers (BASELINE.md; its README has no
 performance claims), so the baseline measured here is the reference's
 own CONFIGURATION run on this machine: effective job concurrency 1
 (prefetch 1 + a single job goroutine, reference cmd/downloader/
-downloader.go:62,100-103). The headline value is the same pipeline at
-this framework's defaults (N concurrent workers); ``vs_baseline`` is the
+downloader.go:62,100-103). The baseline also runs with
+the zero-copy data paths disabled, because the reference's data path is
+userspace copies (Go grab and minio-go stream through io.Copy). The
+headline value is the same pipeline at this framework's defaults (N
+concurrent workers, splice/sendfile zero-copy); ``vs_baseline`` is the
 speedup over the reference-shaped run.
 
 Everything is hermetic and local: a threaded HTTP file server as the
@@ -130,7 +133,12 @@ def _spawn_server(code: str, arg: str) -> tuple[subprocess.Popen, int]:
 
 
 def run_config(
-    jobs: int, mb_per_job: int, concurrency: int, prefetch: int, site: str
+    jobs: int,
+    mb_per_job: int,
+    concurrency: int,
+    prefetch: int,
+    site: str,
+    zero_copy: bool = True,
 ) -> float:
     """Drain ``jobs`` download jobs through the full daemon pipeline;
     returns MB/s end-to-end (first enqueue → last Convert consumed)."""
@@ -158,11 +166,17 @@ def run_config(
         dispatcher = DispatchClient(
             token,
             workdir,
-            [HTTPBackend(progress_interval=5.0, timeout=120.0)],
+            [
+                HTTPBackend(
+                    progress_interval=5.0, timeout=120.0, zero_copy=zero_copy
+                )
+            ],
         )
         uploader = Uploader(
             config.bucket,
-            S3Client(stub_endpoint, Credentials("bench", "bench")),
+            S3Client(
+                stub_endpoint, Credentials("bench", "bench"), zero_copy=zero_copy
+            ),
         )
         daemon = Daemon(token, client, dispatcher, uploader, config)
         runner = threading.Thread(target=daemon.run, daemon=True)
@@ -239,14 +253,19 @@ def main() -> None:
 
         repeats = max(1, int(os.environ.get("BENCH_REPEATS", 2)))
         _log(f"bench: {jobs} jobs x {mb_per_job} MB, best of {repeats}")
-        _log("bench: reference-shaped baseline (concurrency 1, prefetch 1)")
+        # the baseline emulates the REFERENCE's shape on this machine:
+        # concurrency 1 + prefetch 1 (cmd/downloader/downloader.go:62,
+        # 100-103) AND userspace copy loops (Go grab/minio stream through
+        # io.Copy; they have no splice/sendfile path)
+        _log("bench: reference-shaped baseline (concurrency 1, userspace copies)")
         # best-of-N per configuration: on a small shared-CPU box the
         # scheduler noise across runs dwarfs the framework's own spread
         baseline = max(
-            run_config(jobs, mb_per_job, 1, 1, site) for _ in range(repeats)
+            run_config(jobs, mb_per_job, 1, 1, site, zero_copy=False)
+            for _ in range(repeats)
         )
         _log(f"bench: baseline {baseline:.1f} MB/s")
-        _log(f"bench: framework defaults (concurrency {concurrency})")
+        _log(f"bench: framework defaults (concurrency {concurrency}, zero-copy)")
         value = max(
             run_config(jobs, mb_per_job, concurrency, concurrency, site)
             for _ in range(repeats)

@@ -119,10 +119,14 @@ def _dht_bootstrap_from_env() -> tuple[tuple[str, int], ...] | None:
 
 def _default_backends():
     from .fetch.torrent import TorrentBackend
+    from .utils import zero_copy_from_env
 
     # torrent first, then http, matching the reference's registration order
     # (cmd/downloader/downloader.go:87-90)
-    return [TorrentBackend(dht_bootstrap=_dht_bootstrap_from_env()), HTTPBackend()]
+    return [
+        TorrentBackend(dht_bootstrap=_dht_bootstrap_from_env()),
+        HTTPBackend(zero_copy=zero_copy_from_env()),
+    ]
 
 
 def main(argv: list[str] | None = None) -> int:

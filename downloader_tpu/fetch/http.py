@@ -218,11 +218,16 @@ class HTTPBackend:
         timeout: float = 30.0,
         max_resume_attempts: int = 3,
         opener: urllib.request.OpenerDirector | None = None,
+        zero_copy: bool = True,
     ):
         self._progress_interval = progress_interval
         self._timeout = timeout
         self._max_resume_attempts = max_resume_attempts
         self._opener = opener or urllib.request.build_opener()
+        # operator escape hatch (ZEROCOPY=off) for filesystems where
+        # splice misbehaves; also how the bench emulates the reference's
+        # userspace data path (Go grab = io.Copy) for its baseline
+        self._zero_copy = zero_copy
 
     def register(self) -> BackendRegistration:
         # reference registers protocols only, no extensions (http.go:25-34)
@@ -336,6 +341,7 @@ class HTTPBackend:
                                 and hasattr(response, "read1")
                                 and hasattr(os, "splice")
                                 and _splice_works
+                                and self._zero_copy
                             ):
                                 # zero-copy path: drain the bytes the
                                 # header parse buffered, then splice the

@@ -22,6 +22,7 @@ import time
 import urllib.parse
 from typing import BinaryIO
 
+from ..utils import zero_copy_from_env
 from ..utils.cancel import CancelToken
 from ..utils.netio import SocketWaiter
 from . import sigv4
@@ -64,16 +65,24 @@ class S3Client:
         secure: bool = False,
         region: str = "us-east-1",
         timeout: float = 60.0,
+        zero_copy: bool = True,
     ):
         self._host = endpoint
         self._credentials = credentials
         self._secure = secure
         self._region = region
         self._timeout = timeout
+        # operator escape hatch (ZEROCOPY=off); the bench's baseline
+        # uses it to emulate the reference's userspace upload path
+        self._zero_copy = zero_copy
 
     @classmethod
     def from_endpoint_url(
-        cls, url: str, credentials: Credentials, region: str = "us-east-1"
+        cls,
+        url: str,
+        credentials: Credentials,
+        region: str = "us-east-1",
+        zero_copy: bool | None = None,
     ) -> "S3Client":
         """Build from an S3_ENDPOINT-style URL; https selects TLS, and the
         host:port is extracted, as in the reference (uploader.go:26-41)."""
@@ -83,7 +92,15 @@ class S3Client:
             host = f"{host}:{parsed.port}"
         if not host:
             raise ValueError(f"invalid S3 endpoint URL: {url!r}")
-        return cls(host, credentials, secure=parsed.scheme == "https", region=region)
+        if zero_copy is None:
+            zero_copy = zero_copy_from_env()
+        return cls(
+            host,
+            credentials,
+            secure=parsed.scheme == "https",
+            region=region,
+            zero_copy=zero_copy,
+        )
 
     # -- request plumbing ------------------------------------------------
 
@@ -162,7 +179,11 @@ class S3Client:
         still gets a look-in), never past the declared Content-Length;
         TLS and non-file bodies fall back to a chunked userspace loop."""
         sock = getattr(conn, "sock", None)
-        in_fd = _fileno_of(body) if not self._secure and sock is not None else None
+        in_fd = (
+            _fileno_of(body)
+            if self._zero_copy and not self._secure and sock is not None
+            else None
+        )
         if in_fd is not None:
             offset = body.tell()
             remaining = content_length

@@ -1,1 +1,17 @@
+import os as _os
+
 from .logging import get_logger, configure_from_env  # noqa: F401
+
+
+def zero_copy_from_env(environ=None) -> bool:
+    """ZEROCOPY env knob: 'off' (or 0/false/no/disabled) disables the
+    splice/sendfile data paths — an operator escape hatch for
+    filesystems where they misbehave. Anything else means on."""
+    env = _os.environ if environ is None else environ
+    return env.get("ZEROCOPY", "").strip().lower() not in (
+        "off",
+        "0",
+        "false",
+        "no",
+        "disabled",
+    )

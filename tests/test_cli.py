@@ -148,3 +148,14 @@ def test_dht_bootstrap_out_of_range_port_dropped(monkeypatch):
 
     monkeypatch.setenv("DHT_BOOTSTRAP", "10.0.0.1:99999,10.0.0.2:6881")
     assert _dht_bootstrap_from_env() == (("10.0.0.2", 6881),)
+
+
+def test_zero_copy_env_knob(monkeypatch):
+    from downloader_tpu.utils import zero_copy_from_env
+
+    monkeypatch.delenv("ZEROCOPY", raising=False)
+    assert zero_copy_from_env() is True
+    monkeypatch.setenv("ZEROCOPY", "off")
+    assert zero_copy_from_env() is False
+    monkeypatch.setenv("ZEROCOPY", "on")
+    assert zero_copy_from_env() is True

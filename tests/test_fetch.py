@@ -533,3 +533,20 @@ def test_chunked_response_takes_fallback_path(tmp_path):
         assert (tmp_path / "chunky.mkv").read_bytes() == PAYLOAD
     finally:
         httpd.shutdown()
+
+
+def test_zero_copy_disabled_takes_userspace_path(server, tmp_path, monkeypatch):
+    """ZEROCOPY=off must route around splice entirely."""
+    import downloader_tpu.fetch.http as http_mod
+
+    calls = []
+    real = http_mod._splice_body
+    monkeypatch.setattr(
+        http_mod, "_splice_body", lambda *a, **k: calls.append(1) or real(*a, **k)
+    )
+    backend = HTTPBackend(progress_interval=0.01, timeout=5, zero_copy=False)
+    backend.download(
+        CancelToken(), str(tmp_path), lambda u, p: None, f"{server}/file.mkv"
+    )
+    assert (tmp_path / "file.mkv").read_bytes() == PAYLOAD
+    assert not calls, "splice engaged despite zero_copy=False"
