@@ -111,7 +111,12 @@ import sys
 sys.path.insert(0, sys.argv[1])
 from downloader_tpu.store import Credentials
 from downloader_tpu.store.stub import S3Stub
-stub = S3Stub(credentials=Credentials("bench", "bench")).start()
+# retain_objects=False: a stub that keeps every uploaded body slows down
+# progressively as RSS grows (measured ~1 GB/s -> ~100 MB/s over 8 big
+# PUTs), so a retaining stub would benchmark its own allocator — and it
+# punishes the concurrent-upload configuration hardest. Auth is still
+# verified; bodies are drained through a reusable scratch window.
+stub = S3Stub(credentials=Credentials("bench", "bench"), retain_objects=False).start()
 print(stub.endpoint.split(":")[1], flush=True)
 import threading
 threading.Event().wait()
@@ -132,6 +137,124 @@ def _spawn_server(code: str, arg: str) -> tuple[subprocess.Popen, int]:
     return proc, int(port_line)
 
 
+class _Pipeline:
+    """The full hermetic pipeline (payload server, daemon, S3 stub,
+    convert sink) wired up and ready to take jobs. Shared by the
+    throughput and latency measurements."""
+
+    def __init__(
+        self,
+        concurrency: int,
+        prefetch: int,
+        site: str,
+        zero_copy: bool = True,
+        payload: str = "payload.mkv",
+    ):
+        self.token = CancelToken()
+        self.payload = payload
+        self.workdir = tempfile.mkdtemp(prefix="bench-dl-", dir=_bench_root())
+        self.httpd = self.stub_proc = None
+        try:
+            self.httpd, http_port = _spawn_server(_PAYLOAD_SERVER, site)
+            self.base_url = f"http://127.0.0.1:{http_port}"
+            self.stub_proc, stub_port = _spawn_server(
+                _STUB_SERVER, os.path.dirname(os.path.abspath(__file__))
+            )
+            stub_endpoint = f"127.0.0.1:{stub_port}"
+            self.config = Config(
+                broker="memory",
+                base_dir=self.workdir,
+                concurrency=concurrency,
+                prefetch=prefetch,
+                publish_confirm_timeout=60.0,
+            )
+            connect = build_connection_factory(self.config)
+            self.client = QueueClient(self.token, connect, drain_timeout=10.0)
+            self.client.set_prefetch(self.config.prefetch)
+            dispatcher = DispatchClient(
+                self.token,
+                self.workdir,
+                [
+                    HTTPBackend(
+                        progress_interval=5.0, timeout=120.0, zero_copy=zero_copy
+                    )
+                ],
+            )
+            uploader = Uploader(
+                self.config.bucket,
+                S3Client(
+                    stub_endpoint,
+                    Credentials("bench", "bench"),
+                    zero_copy=zero_copy,
+                ),
+            )
+            daemon = Daemon(self.token, self.client, dispatcher, uploader, self.config)
+            self.runner = threading.Thread(target=daemon.run, daemon=True)
+            self.runner.start()
+
+            self.producer = connect().channel()
+            self.producer.declare_exchange(self.config.consume_topic)
+            for i in range(self.client._num_queues):
+                name = QueueClient.shard_name(self.config.consume_topic, i)
+                self.producer.declare_queue(name)
+                self.producer.bind_queue(name, self.config.consume_topic, name)
+
+            self.converts: list[Convert] = []
+            convert_channel = connect().channel()
+            convert_channel.declare_exchange(self.config.publish_topic)
+            convert_channel.declare_queue("bench-sink")
+            for i in range(self.client._num_queues):
+                convert_channel.bind_queue(
+                    "bench-sink",
+                    self.config.publish_topic,
+                    QueueClient.shard_name(self.config.publish_topic, i),
+                )
+
+            def on_convert(message):
+                self.converts.append(Convert.unmarshal(message.body))
+                convert_channel.ack(message.delivery_tag)
+
+            convert_channel.consume("bench-sink", on_convert)
+        except BaseException:
+            self.close()
+            raise
+
+    def publish_job(self, index: int) -> None:
+        body = Download(
+            media=Media(
+                id=f"bench-{index}",
+                source_uri=f"{self.base_url}/{self.payload}",
+            )
+        ).marshal()
+        self.producer.publish(
+            self.config.consume_topic,
+            QueueClient.shard_name(
+                self.config.consume_topic, index % self.client._num_queues
+            ),
+            body,
+        )
+
+    def wait_converts(self, n: int, timeout: float = 600.0) -> None:
+        deadline = time.monotonic() + timeout
+        while len(self.converts) < n:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"bench timed out: {len(self.converts)}/{n} converts"
+                )
+            time.sleep(0.002)
+
+    def close(self) -> None:
+        self.token.cancel()
+        runner = getattr(self, "runner", None)
+        if runner is not None:
+            runner.join(timeout=30)
+        for proc in (self.httpd, self.stub_proc):
+            if proc is not None:
+                proc.kill()
+                proc.wait()  # reap; zombies skew the next measured run
+        shutil.rmtree(self.workdir, ignore_errors=True)
+
+
 def run_config(
     jobs: int,
     mb_per_job: int,
@@ -142,100 +265,36 @@ def run_config(
 ) -> float:
     """Drain ``jobs`` download jobs through the full daemon pipeline;
     returns MB/s end-to-end (first enqueue → last Convert consumed)."""
-    token = CancelToken()
-    workdir = None
-    httpd = stub_proc = None
+    pipeline = _Pipeline(concurrency, prefetch, site, zero_copy=zero_copy)
     try:
-        workdir = tempfile.mkdtemp(prefix="bench-dl-", dir=_bench_root())
-        httpd, http_port = _spawn_server(_PAYLOAD_SERVER, site)
-        base_url = f"http://127.0.0.1:{http_port}"
-        stub_proc, stub_port = _spawn_server(
-            _STUB_SERVER, os.path.dirname(os.path.abspath(__file__))
-        )
-        stub_endpoint = f"127.0.0.1:{stub_port}"
-        config = Config(
-            broker="memory",
-            base_dir=workdir,
-            concurrency=concurrency,
-            prefetch=prefetch,
-            publish_confirm_timeout=60.0,
-        )
-        connect = build_connection_factory(config)
-        client = QueueClient(token, connect, drain_timeout=10.0)
-        client.set_prefetch(config.prefetch)
-        dispatcher = DispatchClient(
-            token,
-            workdir,
-            [
-                HTTPBackend(
-                    progress_interval=5.0, timeout=120.0, zero_copy=zero_copy
-                )
-            ],
-        )
-        uploader = Uploader(
-            config.bucket,
-            S3Client(
-                stub_endpoint, Credentials("bench", "bench"), zero_copy=zero_copy
-            ),
-        )
-        daemon = Daemon(token, client, dispatcher, uploader, config)
-        runner = threading.Thread(target=daemon.run, daemon=True)
-        runner.start()
-
-        producer = connect().channel()
-        producer.declare_exchange(config.consume_topic)
-        for i in range(client._num_queues):
-            name = QueueClient.shard_name(config.consume_topic, i)
-            producer.declare_queue(name)
-            producer.bind_queue(name, config.consume_topic, name)
-
-        converts: list[Convert] = []
-        convert_channel = connect().channel()
-        convert_channel.declare_exchange(config.publish_topic)
-        convert_channel.declare_queue("bench-sink")
-        for i in range(client._num_queues):
-            convert_channel.bind_queue(
-                "bench-sink",
-                config.publish_topic,
-                QueueClient.shard_name(config.publish_topic, i),
-            )
-
-        def on_convert(message):
-            converts.append(Convert.unmarshal(message.body))
-            convert_channel.ack(message.delivery_tag)
-
-        convert_channel.consume("bench-sink", on_convert)
-
         start = time.monotonic()
         for i in range(jobs):
-            body = Download(
-                media=Media(id=f"bench-{i}", source_uri=f"{base_url}/payload.mkv")
-            ).marshal()
-            producer.publish(
-                config.consume_topic,
-                QueueClient.shard_name(config.consume_topic, i % client._num_queues),
-                body,
-            )
-        deadline = time.monotonic() + 600
-        while len(converts) < jobs:
-            if time.monotonic() > deadline:
-                raise RuntimeError(
-                    f"bench timed out: {len(converts)}/{jobs} converts"
-                )
-            time.sleep(0.02)
+            pipeline.publish_job(i)
+        pipeline.wait_converts(jobs)
         elapsed = time.monotonic() - start
-
-        token.cancel()
-        runner.join(timeout=30)
         return jobs * mb_per_job / elapsed
     finally:
-        token.cancel()
-        for proc in (httpd, stub_proc):
-            if proc is not None:
-                proc.kill()
-                proc.wait()  # reap; zombies skew the next measured run
-        if workdir is not None:
-            shutil.rmtree(workdir, ignore_errors=True)
+        pipeline.close()
+
+
+def run_latency(site: str, samples: int, concurrency: int) -> float:
+    """Per-job overhead: enqueue → Convert hand-off consumed, for a tiny
+    payload, one job in flight at a time. Returns the median in ms
+    (BASELINE.md's "job-overhead latency (enqueue→ack for a tiny file)";
+    the Convert arrives right after the ack-gating publish confirm, so it
+    bounds the same path and is observable without daemon hooks)."""
+    pipeline = _Pipeline(concurrency, concurrency, site, payload="tiny.bin")
+    try:
+        laps: list[float] = []
+        for i in range(samples):
+            start = time.monotonic()
+            pipeline.publish_job(i)
+            pipeline.wait_converts(i + 1, timeout=60.0)
+            laps.append((time.monotonic() - start) * 1000.0)
+        laps.sort()
+        return laps[len(laps) // 2]
+    finally:
+        pipeline.close()
 
 
 def main() -> None:
@@ -251,27 +310,46 @@ def main() -> None:
             for _ in range(mb_per_job):
                 sink.write(chunk)
 
-        repeats = max(1, int(os.environ.get("BENCH_REPEATS", 2)))
+        repeats = max(1, int(os.environ.get("BENCH_REPEATS", 3)))
         _log(f"bench: {jobs} jobs x {mb_per_job} MB, best of {repeats}")
         # the baseline emulates the REFERENCE's shape on this machine:
         # concurrency 1 + prefetch 1 (cmd/downloader/downloader.go:62,
         # 100-103) AND userspace copy loops (Go grab/minio stream through
         # io.Copy; they have no splice/sendfile path)
-        _log("bench: reference-shaped baseline (concurrency 1, userspace copies)")
-        # best-of-N per configuration: on a small shared-CPU box the
-        # scheduler noise across runs dwarfs the framework's own spread
-        baseline = max(
-            run_config(jobs, mb_per_job, 1, 1, site, zero_copy=False)
-            for _ in range(repeats)
+        #
+        # INTERLEAVED baseline/framework runs, best-of-N each: this box is
+        # a 1-vCPU VM with noisy-neighbor swings (same config measured 2x
+        # apart minutes apart); interleaving puts both configurations in
+        # the same noise regime so the ratio converges even when the
+        # absolute numbers wander
+        baseline_runs: list[float] = []
+        framework_runs: list[float] = []
+        for i in range(repeats):
+            baseline_runs.append(
+                run_config(jobs, mb_per_job, 1, 1, site, zero_copy=False)
+            )
+            _log(f"bench: baseline run {i + 1}: {baseline_runs[-1]:.1f} MB/s")
+            framework_runs.append(
+                run_config(jobs, mb_per_job, concurrency, concurrency, site)
+            )
+            _log(f"bench: framework run {i + 1}: {framework_runs[-1]:.1f} MB/s")
+        baseline = max(baseline_runs)
+        value = max(framework_runs)
+        _log(
+            f"bench: baseline {baseline:.1f} MB/s (concurrency 1, userspace), "
+            f"framework {value:.1f} MB/s (concurrency {concurrency}, zero-copy)"
         )
-        _log(f"bench: baseline {baseline:.1f} MB/s")
-        _log(f"bench: framework defaults (concurrency {concurrency}, zero-copy)")
-        value = max(
-            run_config(jobs, mb_per_job, concurrency, concurrency, site)
-            for _ in range(repeats)
-        )
-        _log(f"bench: framework {value:.1f} MB/s")
 
+        latency_samples = max(3, int(os.environ.get("BENCH_LATENCY_SAMPLES", 15)))
+        _log(f"bench: per-job overhead latency, {latency_samples} tiny jobs")
+        tiny = os.path.join(site, "tiny.bin")
+        with open(tiny, "wb") as sink:
+            sink.write(os.urandom(64 * 1024))
+        latency_ms = run_latency(site, latency_samples, concurrency)
+        _log(f"bench: job overhead latency {latency_ms:.1f} ms (median)")
+
+        # one JSON line, as the driver contract requires; the secondary
+        # metrics ride along as extra keys
         print(
             json.dumps(
                 {
@@ -279,6 +357,13 @@ def main() -> None:
                     "value": round(value, 1),
                     "unit": "MB/s",
                     "vs_baseline": round(value / baseline, 2),
+                    "extra_metrics": [
+                        {
+                            "metric": "job_overhead_latency_ms",
+                            "value": round(latency_ms, 1),
+                            "unit": "ms",
+                        }
+                    ],
                 }
             )
         )

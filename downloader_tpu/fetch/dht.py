@@ -123,11 +123,40 @@ class DHTClient:
         """Send one KRPC query to every address concurrently and collect
         replies until all have answered or the round times out. Returns
         {addr: reply_args} for the nodes that answered well-formed."""
-        pending: dict[bytes, tuple[str, int]] = {}
+        # pending is keyed on (transaction id, resolved source address):
+        # matching on the 2-byte tid alone would let any host that
+        # guesses a tid answer for another node and inject bogus
+        # peers/nodes, so the datagram's recvfrom address must also match
+        # the node the query went to. Hostnames (bootstrap routers) are
+        # resolved up front so the comparison is IP-vs-IP.
+        pending: dict[tuple[bytes, tuple[str, int]], tuple[str, int]] = {}
+        used_tids: set[bytes] = set()
         for addr in addrs:
+            try:
+                ipaddress.ip_address(addr[0])
+                resolved = (addr[0], addr[1])  # already a literal (the
+                # common case: every non-bootstrap node comes from compact
+                # node info); no resolver call
+            except ValueError:
+                try:
+                    info = socket.getaddrinfo(
+                        addr[0], addr[1], type=socket.SOCK_DGRAM
+                    )
+                except OSError as exc:
+                    log.with_fields(node=f"{addr[0]}:{addr[1]}").debug(
+                        f"dht resolve failed: {exc}"
+                    )
+                    continue
+                # prefer IPv4 (the pre-resolution code always sent
+                # hostname queries over an AF_INET socket): on dual-stack
+                # hosts with a black-holed v6 path, an AAAA-first answer
+                # would silently lose every bootstrap router
+                info.sort(key=lambda entry: entry[0] != socket.AF_INET)
+                resolved = info[0][4][:2]
             tid = secrets.token_bytes(2)
-            while tid in pending:
+            while tid in used_tids:
                 tid = secrets.token_bytes(2)
+            used_tids.add(tid)
             payload = bencode.encode(
                 {
                     b"t": tid,
@@ -137,13 +166,13 @@ class DHTClient:
                 }
             )
             try:
-                pool.for_addr(addr).sendto(payload, addr)
+                pool.for_addr(resolved).sendto(payload, resolved)
             except OSError as exc:
                 log.with_fields(node=f"{addr[0]}:{addr[1]}").debug(
                     f"dht send failed: {exc}"
                 )
                 continue
-            pending[tid] = addr
+            pending[(tid, resolved)] = addr
 
         replies: dict[tuple[str, int], dict] = {}
         deadline = time.monotonic() + self._query_timeout
@@ -156,7 +185,7 @@ class DHTClient:
                 sock = key.fileobj
                 while True:
                     try:
-                        datagram, _ = sock.recvfrom(65536)
+                        datagram, src = sock.recvfrom(65536)
                     except (BlockingIOError, OSError):
                         break
                     try:
@@ -166,10 +195,14 @@ class DHTClient:
                     if not isinstance(reply, dict):
                         continue
                     tid = reply.get(b"t")
-                    addr = pending.get(tid)
+                    if not isinstance(tid, bytes):
+                        # attacker-controlled bencode may decode b"t" to
+                        # an unhashable list/dict; treat as junk rather
+                        # than letting a TypeError abort the whole job
+                        continue
+                    addr = pending.pop((tid, tuple(src[:2])), None)
                     if addr is None:
-                        continue  # stale or foreign transaction
-                    del pending[tid]
+                        continue  # stale, foreign, or spoofed transaction
                     kind = reply.get(b"y")
                     if kind == b"r" and isinstance(reply.get(b"r"), dict):
                         replies[addr] = reply[b"r"]

@@ -109,6 +109,11 @@ def _splice_body(
     with the headers — the caller MUST have drained that buffer first
     (see download(): read1 loop) or those bytes would be skipped.
     """
+    if remaining <= 0:
+        # the header-parse buffer already held the whole body (tiny
+        # files): nothing to splice, and the socket may already be
+        # closed — constructing a waiter on it would raise
+        return 0
     sink.flush()
     timeout = sock.gettimeout()
     pipe_r, pipe_w = os.pipe()

@@ -30,7 +30,6 @@ import hashlib
 import ipaddress
 import os
 import secrets
-import select
 import socket
 import struct
 import threading
@@ -41,6 +40,7 @@ import urllib.request
 from ..parallel import DigestEngine, default_engine
 from ..utils import get_logger
 from ..utils.cancel import Cancelled, CancelToken
+from ..utils.netio import SocketWaiter
 from . import bencode
 from .http import TransferError
 from .magnet import TorrentJob
@@ -280,6 +280,7 @@ class PeerConnection:
         self.metadata_size = 0
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.settimeout(timeout)
+        self._poll_waiter: SocketWaiter | None = None
         self._remove_cancel_hook = token.add_callback(self.close)
         try:
             self._handshake(peer_id)
@@ -374,18 +375,32 @@ class PeerConnection:
         surfacing as a stale frame mid-piece later. Readability is
         checked first so an idle wait never consumes a partial frame."""
         deadline = time.monotonic() + duration
+        # SocketWaiter, not bare select.select: select raises ValueError
+        # for fds >= FD_SETSIZE (possible in the long-lived daemon) and
+        # for the socket being closed mid-wait by the cancel hook; the
+        # waiter turns both into OSError, which the worker's error
+        # handling treats as an ordinary peer failure/cancel. Created
+        # once per connection — the swarm WAIT state polls every 50 ms
+        # and must not pay epoll setup/teardown per poll.
+        if self._poll_waiter is None:
+            self._poll_waiter = SocketWaiter(self._sock, write=False, what="read")
         while True:
             remain = deadline - time.monotonic()
             if remain <= 0:
                 return
-            readable, _, _ = select.select([self._sock], [], [], remain)
-            if not readable:
+            try:
+                self._poll_waiter.wait(remain)
+            except TimeoutError:
                 return
-            # a frame has started arriving; read_message blocks under the
-            # normal socket timeout until it completes, keeping framing
+            # a frame has started arriving; read_message blocks under
+            # the normal socket timeout until it completes, keeping
+            # framing
             self.read_message()
 
     def close(self) -> None:
+        waiter, self._poll_waiter = self._poll_waiter, None
+        if waiter is not None:
+            waiter.close()
         try:
             self._sock.close()
         except OSError:

@@ -129,6 +129,30 @@ class TestS3Client:
         with pytest.raises(S3Error):
             client.put_bytes("nobucket", "k", b"x")
 
+    def test_non_retaining_stub_drains_and_verifies(self):
+        """retain_objects=False (the bench mode) must still verify auth
+        — both the header signature and a signed payload hash — while
+        storing nothing."""
+        with S3Stub(credentials=CREDS, retain_objects=False) as drain_stub:
+            client = S3Client(drain_stub.endpoint, CREDS)
+            client.make_bucket("b")
+            client.put_bytes("b", "k", b"payload" * 1000)
+            assert drain_stub.buckets["b"]["k"] == b""  # drained, not kept
+            # signed payload hash still verified against the drained body
+            import io
+
+            data = b"signed-data" * 500
+            client.put_object(
+                "b", "k2", io.BytesIO(data), len(data), sign_payload=True
+            )
+            bad = S3Client(
+                drain_stub.endpoint,
+                Credentials(access_key="testkey", secret_key="wrong"),
+            )
+            with pytest.raises(S3Error) as excinfo:
+                bad.put_bytes("b", "k3", b"x")
+            assert excinfo.value.status == 403
+
     def test_unicode_key_roundtrip(self, stub):
         client = client_for(stub)
         client.make_bucket("b")

@@ -660,6 +660,95 @@ class TestDHT:
         finally:
             sock.close()
 
+    def test_unhashable_tid_reply_ignored(self):
+        """A malicious reply whose b't' decodes to a list/dict must be
+        dropped like any junk datagram, not abort the lookup with a
+        TypeError (advisor finding, round 1)."""
+        from downloader_tpu.fetch.dht import DHTClient
+
+        class EvilTidNode(FakeDHTNode):
+            def _serve(self):
+                while not self._stop.is_set():
+                    try:
+                        datagram, addr = self._sock.recvfrom(65536)
+                    except socket.timeout:
+                        continue
+                    except OSError:
+                        return
+                    # first a poisoned reply (tid is a LIST), then the
+                    # honest one — the lookup must survive the former and
+                    # accept the latter
+                    self._sock.sendto(
+                        encode({b"t": [b"x", b"y"], b"y": b"r", b"r": {}}), addr
+                    )
+                    message = decode(datagram)
+                    self._sock.sendto(
+                        encode(
+                            {
+                                b"t": message[b"t"],
+                                b"y": b"r",
+                                b"r": {
+                                    b"id": self.node_id,
+                                    b"values": [
+                                        ipaddress.IPv4Address("10.1.2.3").packed
+                                        + struct.pack(">H", 999)
+                                    ],
+                                },
+                            }
+                        ),
+                        addr,
+                    )
+
+        with EvilTidNode() as node:
+            client = DHTClient(bootstrap=(node.address,), query_timeout=1.0)
+            assert client.get_peers(self.INFO_HASH) == [("10.1.2.3", 999)]
+
+    def test_reply_from_wrong_source_address_ignored(self):
+        """Replies are matched on (tid, source address): a host that
+        guesses the tid but answers from a different socket must not be
+        able to inject peers (advisor finding, round 1)."""
+        from downloader_tpu.fetch.dht import DHTClient
+
+        class SpoofingNode(FakeDHTNode):
+            def _serve(self):
+                spoof_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                spoof_sock.bind(("127.0.0.1", 0))
+                try:
+                    while not self._stop.is_set():
+                        try:
+                            datagram, addr = self._sock.recvfrom(65536)
+                        except socket.timeout:
+                            continue
+                        except OSError:
+                            return
+                        message = decode(datagram)
+                        # correct tid, wrong source socket: an attacker
+                        # who sniffed/guessed the transaction id
+                        spoof_sock.sendto(
+                            encode(
+                                {
+                                    b"t": message[b"t"],
+                                    b"y": b"r",
+                                    b"r": {
+                                        b"id": self.node_id,
+                                        b"values": [
+                                            ipaddress.IPv4Address(
+                                                "6.6.6.6"
+                                            ).packed
+                                            + struct.pack(">H", 666)
+                                        ],
+                                    },
+                                }
+                            ),
+                            addr,
+                        )
+                finally:
+                    spoof_sock.close()
+
+        with SpoofingNode() as node:
+            client = DHTClient(bootstrap=(node.address,), query_timeout=0.5)
+            assert client.get_peers(self.INFO_HASH) == []
+
     def test_trackerless_magnet_downloads_via_dht(self, seeder, tmp_path):
         """The flow the reference gets from anacrolix's DHT node: a bare
         info-hash magnet, peers discovered through the DHT."""
