@@ -348,6 +348,28 @@ def main() -> None:
         latency_ms = run_latency(site, latency_samples, concurrency)
         _log(f"bench: job overhead latency {latency_ms:.1f} ms (median)")
 
+        extra_metrics = [
+            {
+                "metric": "job_overhead_latency_ms",
+                "value": round(latency_ms, 1),
+                "unit": "ms",
+            }
+        ]
+        if os.environ.get("BENCH_DIGEST", "1") != "0":
+            _log("bench: digest kernel micro-benchmark (pallas vs hashlib)")
+            try:
+                from bench_digest import measure as measure_digest
+
+                digest = measure_digest(piece_kb=256, batch=1024)
+            except Exception as exc:
+                _log(f"bench: digest micro-benchmark failed ({exc})")
+                digest = None
+            if digest is not None:
+                _log(f"bench: digest kernel {json.dumps(digest)}")
+                extra_metrics.append(
+                    {"metric": "digest_kernel", "unit": "GB/s", **digest}
+                )
+
         # one JSON line, as the driver contract requires; the secondary
         # metrics ride along as extra keys
         print(
@@ -357,13 +379,7 @@ def main() -> None:
                     "value": round(value, 1),
                     "unit": "MB/s",
                     "vs_baseline": round(value / baseline, 2),
-                    "extra_metrics": [
-                        {
-                            "metric": "job_overhead_latency_ms",
-                            "value": round(latency_ms, 1),
-                            "unit": "ms",
-                        }
-                    ],
+                    "extra_metrics": extra_metrics,
                 }
             )
         )

@@ -628,8 +628,16 @@ class PieceStore:
         return resumed
 
     def write_piece(self, index: int, data: bytes) -> None:
+        """Verify one piece against its torrent hash and write it.
+        Per-piece hashlib verification: right for trickle arrivals and
+        direct callers; the swarm's batch path verifies through the
+        digest engine first and calls :meth:`write_verified`."""
         if hashlib.sha1(data).digest() != self.piece_hashes[index]:
             raise PeerProtocolError(f"piece {index} failed SHA-1 verification")
+        self.write_verified(index, data)
+
+    def write_verified(self, index: int, data: bytes) -> None:
+        """Write a piece that has ALREADY been verified (batch path)."""
         offset = index * self.piece_length
         cursor = 0
         file_start = 0
@@ -856,52 +864,134 @@ class SwarmDownloader:
         self, conn: PeerConnection, swarm: "_SwarmState", token: CancelToken
     ) -> None:
         store = swarm.store
+        batch = _PieceBatch(swarm)
         conn.send_message(MSG_INTERESTED)
         while conn.choked:
             msg_id, _ = conn.read_message()
 
-        while True:
-            token.raise_if_cancelled()
-            index = swarm.claim(conn)
-            if index is swarm.WAIT:
-                # every missing piece is claimed by another worker; one
-                # may come back via release() if that worker's peer dies,
-                # so hold this healthy connection instead of dropping it
-                conn.poll_messages(0.05)
-                continue
-            if index is None:
-                return  # done, or nothing left this peer can provide
-            try:
-                if conn.choked:  # choked while we idled in WAIT
-                    while conn.choked:
-                        conn.read_message()
-                size = store.piece_size(index)
-                blocks: dict[int, bytes] = {}
-                offsets = list(range(0, size, BLOCK_SIZE))
-                # pipeline all block requests for the piece
-                for begin in offsets:
-                    conn.send_message(
-                        MSG_REQUEST,
-                        struct.pack(
-                            ">III", index, begin, min(BLOCK_SIZE, size - begin)
-                        ),
+        try:
+            while True:
+                token.raise_if_cancelled()
+                index = swarm.claim(conn)
+                if index is swarm.WAIT:
+                    # every missing piece is claimed by another worker;
+                    # one may come back via release() if that worker's
+                    # peer dies, so hold this healthy connection instead
+                    # of dropping it — and settle our pending pieces
+                    # while idle so claims don't sit unverified
+                    batch.flush()
+                    conn.poll_messages(0.05)
+                    continue
+                if index is None:
+                    break  # done, or nothing left this peer can provide
+                try:
+                    if conn.choked:  # choked while we idled in WAIT
+                        while conn.choked:
+                            conn.read_message()
+                    size = store.piece_size(index)
+                    blocks: dict[int, bytes] = {}
+                    offsets = list(range(0, size, BLOCK_SIZE))
+                    # pipeline all block requests for the piece
+                    for begin in offsets:
+                        conn.send_message(
+                            MSG_REQUEST,
+                            struct.pack(
+                                ">III",
+                                index,
+                                begin,
+                                min(BLOCK_SIZE, size - begin),
+                            ),
+                        )
+                    while len(blocks) < len(offsets):
+                        msg_id, payload = conn.read_message()
+                        if msg_id == MSG_CHOKE:
+                            raise PeerProtocolError("peer choked mid-piece")
+                        if msg_id != MSG_PIECE or len(payload) < 8:
+                            continue
+                        got_index, begin = struct.unpack(">II", payload[:8])
+                        if got_index == index:
+                            blocks[begin] = payload[8:]
+                    batch.add(
+                        index, b"".join(blocks[b] for b in sorted(blocks))
                     )
-                while len(blocks) < len(offsets):
-                    msg_id, payload = conn.read_message()
-                    if msg_id == MSG_CHOKE:
-                        raise PeerProtocolError("peer choked mid-piece")
-                    if msg_id != MSG_PIECE or len(payload) < 8:
-                        continue
-                    got_index, begin = struct.unpack(">II", payload[:8])
-                    if got_index == index:
-                        blocks[begin] = payload[8:]
-                store.write_piece(
-                    index, b"".join(blocks[b] for b in sorted(blocks))
-                )
-            except BaseException:
-                swarm.release(index)  # let another worker/peer retry it
-                raise
+                except BaseException:
+                    swarm.release(index)  # let another worker/peer retry
+                    raise
+                swarm.tick_progress()
+            # normal exit: settle the tail batch here, where a failed
+            # verdict propagates and the worker moves to the next peer
+            batch.flush()
+        finally:
+            # exception paths only (flush() is a no-op when empty): a
+            # second failure while unwinding must not mask the original
+            # error — record the released claims and move on
+            try:
+                batch.flush()
+            except PeerProtocolError as exc:
+                swarm.last_error = exc
             swarm.tick_progress()
+
+
+class _PieceBatch:
+    """Downloaded-but-unverified pieces from ONE peer, verified through
+    the digest engine in batches.
+
+    The round-1 hot path hashed every arriving piece with per-piece
+    hashlib, so the batched engine only ever ran for resume; routing the
+    live path through :meth:`DigestEngine.verify_pieces` lets the
+    engine's measured offload policy apply to swarm traffic too, and
+    still collapses to per-piece hashlib for trickle flushes (engine
+    min_batch). Batching per worker keeps bad-peer attribution: every
+    piece in a batch came from this worker's current peer, so a failed
+    verdict indicts that peer exactly as per-piece hashing did.
+
+    Flush points: ``max_bytes`` reached, the worker idling (WAIT), or
+    worker exit. A crash loses at most ``max_bytes`` of unwritten
+    download per worker — the resume scan re-fetches those pieces.
+    """
+
+    def __init__(
+        self,
+        swarm: "_SwarmState",
+        engine: DigestEngine | None = None,
+        max_bytes: int = 8 * 1024 * 1024,
+    ):
+        self._swarm = swarm
+        self._engine = engine or default_engine()
+        self._max_bytes = max_bytes
+        self._items: list[tuple[int, bytes]] = []
+        self._bytes = 0
+
+    def add(self, index: int, data: bytes) -> None:
+        self._items.append((index, data))
+        self._bytes += len(data)
+        if self._bytes >= self._max_bytes:
+            self.flush()
+
+    def flush(self) -> None:
+        """Verify and write everything pending. Raises
+        PeerProtocolError naming the failed pieces (claims released so
+        other workers re-fetch them); verified pieces are always written
+        first, so one bad piece cannot discard its good batch-mates."""
+        if not self._items:
+            return
+        items, self._items, self._bytes = self._items, [], 0
+        store = self._swarm.store
+        verdicts = self._engine.verify_pieces(
+            [data for _, data in items],
+            [store.piece_hashes[index] for index, _ in items],
+        )
+        bad: list[int] = []
+        for (index, data), good in zip(items, verdicts):
+            if good:
+                store.write_verified(index, data)
+            else:
+                self._swarm.release(index)
+                bad.append(index)
+        if bad:
+            raise PeerProtocolError(
+                f"pieces {bad} failed SHA-1 verification"
+            )
 
 
 class _SwarmState:

@@ -78,12 +78,21 @@ class Seeder:
     """One-torrent seeder; ``endpoint`` properties expose the tracker URL
     and a magnet URI for the served torrent."""
 
-    def __init__(self, name: str, data: bytes | dict[str, bytes], piece_length: int = 32 * 1024):
+    def __init__(
+        self,
+        name: str,
+        data: bytes | dict[str, bytes],
+        piece_length: int = 32 * 1024,
+        corrupt_pieces: tuple[int, ...] = (),
+    ):
         self.info, self.metainfo, self.blob = make_torrent(name, data, piece_length)
         self.info_bytes = bencode.encode(self.info)
         self.info_hash = hashlib.sha1(self.info_bytes).digest()
         self.piece_length = piece_length
         self.served_requests: list[int] = []  # piece indexes peers requested
+        # pieces served with flipped bytes: a hostile/broken peer for
+        # verification tests (the announced hashes stay the honest ones)
+        self.corrupt_pieces = frozenset(corrupt_pieces)
 
         seeder = self
 

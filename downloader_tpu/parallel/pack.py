@@ -69,3 +69,51 @@ def digests_to_bytes(digests: np.ndarray, count: int) -> list[bytes]:
     """(P, 5) uint32 state words → ``count`` 20-byte digests."""
     words = np.asarray(digests, dtype=np.uint32)[:count].astype(">u4")
     return [row.tobytes() for row in words]
+
+
+# -- VPU-tiled layout (pallas kernel, parallel/sha1_pallas.py) -------------
+
+SUBLANES = 8  # int32 native tile is (8, 128)
+LANES = 128
+TILE = SUBLANES * LANES  # 1024 pieces per VPU tile
+
+
+def pack_pieces_tiled(
+    pieces: Sequence[bytes],
+) -> tuple[np.ndarray, np.ndarray]:
+    """Pack pieces for the pallas kernel's register-resident layout.
+
+    Where :func:`pack_pieces` emits (P, B, 16) — natural for an XLA scan
+    over the piece axis — the pallas kernel wants the *lane* axis shaped
+    like a VPU register tile so every round's uint32 ops run on full
+    (8, 128) vregs:
+
+    - ``blocks``: (T, B, 16, 8, 128) uint32, T = ceil(P / 1024) lane
+      tiles, B = max block count. ``blocks[t, b, w, s, l]`` is message
+      word ``w`` of block ``b`` of piece ``t*1024 + s*128 + l``.
+    - ``nblocks``: (T, 8, 128) int32 valid-block counts (0 = padding).
+    """
+    count = len(pieces)
+    tiles = max(1, -(-count // TILE))
+    padded = [pad_piece(piece) for piece in pieces]
+    max_blocks = max((p.shape[0] for p in padded), default=1)
+    flat = np.zeros((tiles * TILE, max_blocks, 16), dtype=np.uint32)
+    nflat = np.zeros(tiles * TILE, dtype=np.int32)
+    for lane, words in enumerate(padded):
+        flat[lane, : words.shape[0]] = words
+        nflat[lane] = words.shape[0]
+    blocks = (
+        flat.reshape(tiles, SUBLANES, LANES, max_blocks, 16)
+        .transpose(0, 3, 4, 1, 2)
+        .copy()
+    )
+    nblocks = nflat.reshape(tiles, SUBLANES, LANES)
+    return blocks, nblocks
+
+
+def digests_from_tiled(states: np.ndarray, count: int) -> list[bytes]:
+    """(T, 5, 8, 128) uint32 kernel output → ``count`` 20-byte digests."""
+    arr = np.asarray(states, dtype=np.uint32)
+    tiles = arr.shape[0]
+    flat = arr.transpose(0, 2, 3, 1).reshape(tiles * TILE, 5)
+    return [row.tobytes() for row in flat[:count].astype(">u4")]

@@ -1,0 +1,131 @@
+"""Batched SHA-1 as a Pallas TPU kernel.
+
+The XLA version (parallel/sha1.py) is correct but latency-bound on real
+hardware: a ``lax.scan`` over blocks × 80 rounds lowers to thousands of
+individually dispatched element-wise ops on tiny vectors, measuring
+~20 MB/s on a v5e chip regardless of batch size. This kernel gives
+Mosaic the whole compression loop instead: pieces are packed with the
+lane axis shaped as a native (8, 128) int32 VPU tile
+(parallel/pack.py:pack_pieces_tiled), the 80 rounds are unrolled at
+trace time into straight-line register code, and the per-piece chaining
+state lives in a VMEM scratch carried across the block grid axis. One
+grid step = one 512-bit block compressed for 1024 pieces at once;
+Pallas's grid pipeline double-buffers the 64 KB message-block DMAs
+behind the compute.
+
+Ragged batches use the same per-lane valid-block mask as the XLA path:
+a lane's state freezes once its own blocks run out, so a torrent's
+short final piece batches with full-size ones.
+
+The reference gets this hashing from anacrolix/torrent's CPU hasher
+(reference internal/downloader/torrent/torrent.go:79-106); here it is
+the framework's one genuinely compute-bound op, run where the compute
+is.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pack import LANES, SUBLANES
+
+_H0 = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0)
+_K4 = (0x5A827999, 0x6ED9EBA1, 0x8F1BBCDC, 0xCA62C1D6)
+
+
+def _rotl(x, n: int):
+    return (x << np.uint32(n)) | (x >> np.uint32(32 - n))
+
+
+def _sha1_kernel(blocks_ref, nblocks_ref, out_ref, state_ref):
+    """Grid = (lane tiles, blocks); the block axis carries chaining
+    state in ``state_ref`` (VMEM scratch, shape (5, 8, 128))."""
+    b = pl.program_id(1)
+    num_blocks = pl.num_programs(1)
+
+    @pl.when(b == 0)
+    def _():
+        for i, h in enumerate(_H0):
+            state_ref[i] = jnp.full(
+                (SUBLANES, LANES), np.uint32(h), dtype=jnp.uint32
+            )
+
+    # rolling 16-word message schedule, fully unrolled: every value is an
+    # (8, 128) uint32 vreg-shaped array, so Mosaic emits straight-line
+    # vector code with no per-op dispatch
+    w = [blocks_ref[0, 0, t] for t in range(16)]
+    a = state_ref[0]
+    bb = state_ref[1]
+    c = state_ref[2]
+    d = state_ref[3]
+    e = state_ref[4]
+    for t in range(80):
+        if t >= 16:
+            w_t = _rotl(w[(t - 3) % 16] ^ w[(t - 8) % 16]
+                        ^ w[(t - 14) % 16] ^ w[t % 16], 1)
+            w[t % 16] = w_t
+        else:
+            w_t = w[t]
+        if t < 20:
+            f = (bb & c) | (~bb & d)
+        elif t < 40:
+            f = bb ^ c ^ d
+        elif t < 60:
+            f = (bb & c) | (bb & d) | (c & d)
+        else:
+            f = bb ^ c ^ d
+        temp = _rotl(a, 5) + f + e + np.uint32(_K4[t // 20]) + w_t
+        a, bb, c, d, e = temp, a, _rotl(bb, 30), c, d
+
+    live = b < nblocks_ref[0]  # (8, 128) bool
+    state_ref[0] = jnp.where(live, state_ref[0] + a, state_ref[0])
+    state_ref[1] = jnp.where(live, state_ref[1] + bb, state_ref[1])
+    state_ref[2] = jnp.where(live, state_ref[2] + c, state_ref[2])
+    state_ref[3] = jnp.where(live, state_ref[3] + d, state_ref[3])
+    state_ref[4] = jnp.where(live, state_ref[4] + e, state_ref[4])
+
+    @pl.when(b == num_blocks - 1)
+    def _():
+        for i in range(5):
+            out_ref[0, i] = state_ref[i]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def sha1_tiled(
+    blocks: jax.Array, nblocks: jax.Array, interpret: bool = False
+) -> jax.Array:
+    """Digest a tiled batch (see pack_pieces_tiled).
+
+    ``blocks``: (T, B, 16, 8, 128) uint32; ``nblocks``: (T, 8, 128)
+    int32. Returns (T, 5, 8, 128) uint32 final states (H0 for all-
+    padding lanes)."""
+    tiles, num_blocks = blocks.shape[0], blocks.shape[1]
+    grid = (tiles, num_blocks)
+    return pl.pallas_call(
+        _sha1_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, 16, SUBLANES, LANES),
+                lambda t, b: (t, b, 0, 0, 0),
+            ),
+            pl.BlockSpec((1, SUBLANES, LANES), lambda t, b: (t, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 5, SUBLANES, LANES), lambda t, b: (t, 0, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (tiles, 5, SUBLANES, LANES), jnp.uint32
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((5, SUBLANES, LANES), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(blocks, nblocks)

@@ -177,6 +177,86 @@ class TestDigestEngine:
             DigestEngine(backend="cuda")
 
 
+class TestPallasKernel:
+    """The Pallas TPU kernel, run through the Pallas interpreter on the
+    CPU mesh (no TPU in CI): bit-for-bit agreement with hashlib on the
+    same padding edge cases as the XLA kernel, via the tiled layout."""
+
+    def _tiled(self, pieces):
+        from downloader_tpu.parallel.pack import (
+            digests_from_tiled,
+            pack_pieces_tiled,
+        )
+        from downloader_tpu.parallel.sha1_pallas import sha1_tiled
+
+        blocks, nblocks = pack_pieces_tiled(pieces)
+        out = sha1_tiled(blocks, nblocks, interpret=True)
+        return digests_from_tiled(np.asarray(out), len(pieces))
+
+    def test_edge_sizes_match_hashlib(self):
+        pieces = [os.urandom(n) for n in EDGE_SIZES]
+        assert self._tiled(pieces) == _want(pieces)
+
+    def test_ragged_multiblock_batch(self):
+        rng = np.random.default_rng(7)
+        pieces = [rng.bytes(int(n)) for n in rng.integers(0, 500, size=24)]
+        assert self._tiled(pieces) == _want(pieces)
+
+    def test_tiled_pack_layout(self):
+        from downloader_tpu.parallel.pack import TILE, pack_pieces_tiled
+
+        pieces = [b"a" * 100, b"b" * 70]
+        blocks, nblocks = pack_pieces_tiled(pieces)
+        assert blocks.shape == (1, 2, 16, 8, 128)  # 100 bytes → 2 blocks
+        assert nblocks.shape == (1, 8, 128)
+        assert nblocks[0, 0, 0] == 2 and nblocks[0, 0, 1] == 2
+        assert nblocks.sum() == 4  # all other lanes are padding
+        assert TILE == 1024
+
+
+class TestOffloadPolicy:
+    """auto offload is decided by measured rates, not guesses: the
+    device must win bytes/hashlib > bytes/transfer + sync."""
+
+    def _engine(self, hashlib_bps, transfer_bps, sync_s):
+        engine = DigestEngine(backend="auto", min_batch=1)
+        engine._calibration = (hashlib_bps, transfer_bps, sync_s)
+        return engine
+
+    def test_slow_tunnel_never_offloads(self):
+        # measured shape of the tunneled dev chip: 25 MB/s H2D vs
+        # 1.4 GB/s hashlib — offload can never win
+        engine = self._engine(1.4e9, 25e6, 0.067)
+        assert not engine._worth_offloading(1 << 40)
+
+    def test_fast_link_offloads_past_breakeven(self):
+        # TPU-VM shape: 10 GB/s DMA, 5 ms sync → break-even ≈ 8 MB
+        engine = self._engine(1.4e9, 10e9, 0.005)
+        assert not engine._worth_offloading(1 * 1024 * 1024)
+        assert engine._worth_offloading(32 * 1024 * 1024)
+
+    def test_env_override_wins(self, monkeypatch):
+        engine = self._engine(1.4e9, 25e6, 0.067)
+        monkeypatch.setenv("DIGEST_OFFLOAD", "always")
+        assert engine._worth_offloading(1)
+        monkeypatch.setenv("DIGEST_OFFLOAD", "never")
+        assert not engine._worth_offloading(1 << 40)
+
+    def test_auto_falls_back_to_hashlib_below_breakeven(self):
+        engine = self._engine(1.4e9, 25e6, 0.067)
+        pieces = [os.urandom(64) for _ in range(16)]
+        assert engine.sha1_many(pieces) == _want(pieces)
+        # no device path was ever built
+        assert engine._jax_state is None and engine._pallas_fn is None
+
+    def test_calibration_runs_once_and_logs_rates(self):
+        engine = DigestEngine(backend="auto", min_batch=1)
+        first = engine._calibrate()
+        assert engine._calibrate() is first
+        hashlib_bps, _, _ = first
+        assert hashlib_bps > 0
+
+
 class TestReviewRegressions:
     def test_bucket_is_multiple_of_mesh_size(self):
         # a 6-device mesh must get batches padded to multiples of 6,
