@@ -54,6 +54,11 @@ class _PendingPublish:
     topic: str
     body: bytes
     headers: dict
+    # verbatim routing key, bypassing shard round-robin — used when
+    # republishing a message consumed off the default exchange (""),
+    # where the routing key IS the queue name and re-sharding would
+    # route to a queue that does not exist
+    routing_key: str | None = None
     attempts: int = 0
     not_before: float = 0.0
     # set once the message is actually on the broker; publish(wait=...)
@@ -193,6 +198,7 @@ class QueueClient:
         body: bytes,
         headers: dict | None = None,
         wait: float | None = None,
+        routing_key: str | None = None,
     ) -> bool:
         """Enqueue for the publisher thread; survives broker outages by
         retrying with exponential backoff, and is drained (not dropped) at
@@ -203,8 +209,19 @@ class QueueClient:
         callers that must not lose the message (the daemon's Convert
         hand-off, Delivery.error retries) pass a timeout and only ack
         their upstream delivery on True. Fire-and-forget (`wait=None`)
-        returns True immediately."""
-        pending = _PendingPublish(topic=topic, body=body, headers=headers or {})
+        returns True immediately.
+
+        ``routing_key`` publishes to exchange ``topic`` with that exact
+        key instead of the shard round-robin — required for the default
+        exchange (``topic=""``), which routes directly to the queue named
+        by the key and has no shards to round-robin over."""
+        if topic == "" and routing_key is None:
+            raise ValueError(
+                "publishing to the default exchange requires routing_key"
+            )
+        pending = _PendingPublish(
+            topic=topic, body=body, headers=headers or {}, routing_key=routing_key
+        )
         with self._lock:
             self._publishes_pending += 1
         self._publish_buffer.put(pending)
@@ -459,9 +476,13 @@ class QueueClient:
                 if time.monotonic() < pending.not_before:
                     self._publish_buffer.put(pending)
                     continue
-            routing_key = self._next_rk(pending.topic)
+            if pending.routing_key is not None:
+                routing_key = pending.routing_key
+            else:
+                routing_key = self._next_rk(pending.topic)
             try:
-                self._ensure_topology(my_channel, pending.topic)
+                if pending.topic:  # the default exchange ("") is not declarable
+                    self._ensure_topology(my_channel, pending.topic)
                 my_channel.publish(
                     pending.topic,
                     routing_key,

@@ -97,11 +97,18 @@ class Delivery:
         headers[RETRY_HEADER] = self.retries + 1
         try:
             if self._publisher is not None:
+                # Messages consumed off the default exchange ("") carry the
+                # target queue in routing_key; re-sharding "" as a topic
+                # would publish to a queue that does not exist, so pin the
+                # original key instead (reference delivery.go:73-84 always
+                # republishes with both msg.Exchange and msg.RoutingKey).
+                rk = self.message.routing_key if not self.message.exchange else None
                 confirmed = self._publisher(
                     self.message.exchange,
                     self.body,
                     headers,
                     wait=self._publish_confirm_timeout,
+                    routing_key=rk,
                 )
             else:
                 self._channel.publish(

@@ -79,9 +79,15 @@ class MemoryBroker:
         self, exchange: str, routing_key: str, body: bytes, headers: dict
     ) -> None:
         with self._lock:
-            if exchange not in self._exchanges:
+            if exchange == "":
+                # AMQP 0-9-1 default exchange: every queue is implicitly
+                # bound by its own name; unroutable messages are dropped
+                # (no `mandatory` support here), matching RabbitMQ
+                targets = {routing_key} if routing_key in self._queues else set()
+            elif exchange not in self._exchanges:
                 raise BrokerError(f"no such exchange '{exchange}'")
-            targets = self._exchanges[exchange].get(routing_key, set())
+            else:
+                targets = self._exchanges[exchange].get(routing_key, set())
             for queue in targets:
                 self._queues[queue].append(
                     (body, dict(headers), False, exchange, routing_key)

