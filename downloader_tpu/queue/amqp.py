@@ -11,17 +11,26 @@ Design: one reader thread per connection dispatches incoming frames;
 synchronous RPCs (declare, bind, qos, consume, close) block on per-channel
 reply queues; deliveries are reassembled (method + content header + body
 frames) and handed to a dispatch thread so consumer callbacks never block
-the reader. Heartbeat 0 is negotiated (liveness is detected via socket
-errors; the supervisor reconnects).
+the reader.
+
+Heartbeats: a nonzero interval is negotiated during tune (the reference's
+streadway dial does the same at client.go:303-322, 10s). A monitor thread
+emits heartbeat frames every interval/2 and tears the connection down when
+no inbound traffic (any frame counts) arrives for two full intervals —
+so a half-open TCP connection or a wedged-but-open broker is detected in
+~2×interval instead of waiting 60s+ on kernel keepalives. Either side
+sending 0 during tune disables the mechanism (AMQP 0-9-1 §"tune";
+RabbitMQ treats 0 as deactivation).
 """
 
 from __future__ import annotations
 
 import itertools
+import math
 import queue as queue_mod
 import socket
-import struct
 import threading
+import time
 from typing import Callable
 
 from ..utils import get_logger
@@ -268,6 +277,9 @@ class AmqpChannel:
         self._replies.put((("error",), exc))
 
 
+DEFAULT_HEARTBEAT = 10.0  # seconds; reference client.go:303-322
+
+
 class AmqpConnection:
     def __init__(self, sock: socket.socket, rpc_timeout: float = 30.0):
         self._sock = sock
@@ -279,6 +291,9 @@ class AmqpConnection:
         self._channel0_replies: "queue_mod.Queue[tuple]" = queue_mod.Queue()
         self._dispatch_queue: "queue_mod.Queue" = queue_mod.Queue()
         self._frame_max = FRAME_MAX
+        self._heartbeat = 0.0  # outbound send pacing; 0 = disabled
+        self._heartbeat_deadline = 0.0  # inbound idle limit (2x wire value)
+        self._last_recv = time.monotonic()
 
     # -- dial ------------------------------------------------------------
 
@@ -291,19 +306,23 @@ class AmqpConnection:
         vhost: str = "/",
         timeout: float = 10.0,
         rpc_timeout: float = 30.0,
+        heartbeat: float = DEFAULT_HEARTBEAT,
     ) -> "AmqpConnection":
         """Connect and perform the AMQP handshake. ``endpoint`` is
-        ``host[:port]`` as in RABBITMQ_ENDPOINT (reference cmd:54-58)."""
+        ``host[:port]`` as in RABBITMQ_ENDPOINT (reference cmd:54-58).
+
+        ``heartbeat`` is the requested interval in seconds (0 disables);
+        the wire value is negotiated against the server's tune suggestion,
+        and sub-second requests keep their precision locally (the wire
+        field is integral seconds) so tests can run fast timers."""
         host, _, port_raw = endpoint.partition(":")
         port = int(port_raw) if port_raw else DEFAULT_PORT
         try:
             sock = socket.create_connection((host or "127.0.0.1", port), timeout)
         except OSError as exc:
             raise AmqpError(f"failed to dial {endpoint}: {exc}") from exc
-        # heartbeat is negotiated off, so half-open TCP (NAT idle-drop,
-        # broker host power loss) must be caught by kernel keepalives or
-        # the blocked reader would wait forever and the supervisor would
-        # never reconnect
+        # kernel keepalives back up the protocol heartbeat: they catch a
+        # dead peer even when heartbeats were negotiated off (server sent 0)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
         if hasattr(socket, "TCP_KEEPIDLE"):
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPIDLE, 30)
@@ -312,11 +331,18 @@ class AmqpConnection:
         sock.settimeout(timeout)
         conn = cls(sock, rpc_timeout=rpc_timeout)
         try:
-            conn._handshake(username, password, vhost)
+            conn._handshake(username, password, vhost, heartbeat)
         except Exception:
             sock.close()
             raise
         sock.settimeout(None)
+        # No send timeout on purpose: RabbitMQ flow control (memory/disk
+        # alarm) deliberately stops reading from publishers while still
+        # sending heartbeats — a blocked sendall there is a healthy
+        # connection and must wait, like streadway does. A peer that is
+        # truly dead also goes silent inbound, so the heartbeat monitor
+        # (which never blocks on the write lock) tears down and closes
+        # the socket, waking any sendall stuck behind a full buffer.
         conn._reader_thread = threading.Thread(
             target=conn._read_loop, name="amqp-reader", daemon=True
         )
@@ -325,9 +351,20 @@ class AmqpConnection:
         )
         conn._reader_thread.start()
         conn._dispatcher_thread.start()
+        if conn._heartbeat > 0:
+            # the handshake reads bypass _read_loop, so the idle clock
+            # still holds its construction-time value; a slow handshake
+            # must not count against the first deadline window
+            conn._last_recv = time.monotonic()
+            conn._heartbeat_thread = threading.Thread(
+                target=conn._heartbeat_loop, name="amqp-heartbeat", daemon=True
+            )
+            conn._heartbeat_thread.start()
         return conn
 
-    def _handshake(self, username: str, password: str, vhost: str) -> None:
+    def _handshake(
+        self, username: str, password: str, vhost: str, heartbeat: float
+    ) -> None:
         self._sock.sendall(wire.PROTOCOL_HEADER)
         method, reader = self._read_method_sync()
         if method != wire.CONNECTION_START:
@@ -359,13 +396,30 @@ class AmqpConnection:
             raise AmqpError(f"expected connection.tune, got {method}")
         channel_max = reader.short()
         frame_max = reader.long()
-        reader.short()  # server heartbeat suggestion; we negotiate 0
+        server_heartbeat = reader.short()
         self._frame_max = min(frame_max or FRAME_MAX, FRAME_MAX)
+        # 0 from either side deactivates heartbeats (RabbitMQ semantics);
+        # otherwise take the smaller of the two intervals. The tune-ok
+        # value is the authoritative whole-second wire interval; the local
+        # monitor keeps sub-second precision from the requested value.
+        if heartbeat <= 0 or server_heartbeat == 0:
+            wire_heartbeat = 0
+            self._heartbeat = 0.0
+            self._heartbeat_deadline = 0.0
+        else:
+            wire_heartbeat = min(math.ceil(heartbeat), server_heartbeat)
+            # outbound pacing may run faster than the wire value (sending
+            # early is always safe, and lets tests use sub-second timers);
+            # the inbound deadline MUST honor the wire value — the peer is
+            # only obligated to send every wire/2, so expecting frames
+            # faster would flap against a healthy spec-compliant broker
+            self._heartbeat = min(heartbeat, float(wire_heartbeat))
+            self._heartbeat_deadline = 2.0 * wire_heartbeat
         tune_ok = (
             wire.Writer()
             .short(channel_max)
             .long(self._frame_max)
-            .short(0)  # heartbeat disabled
+            .short(wire_heartbeat)
             .done()
         )
         wire.write_method(self._sock, 0, wire.CONNECTION_TUNE_OK, tune_ok)
@@ -420,6 +474,7 @@ class AmqpConnection:
         try:
             while not self._closed.is_set():
                 frame_type, channel_num, payload = wire.read_frame(self._sock)
+                self._last_recv = time.monotonic()
                 if frame_type == wire.FRAME_HEARTBEAT:
                     continue
                 if channel_num == 0:
@@ -453,6 +508,39 @@ class AmqpConnection:
             self._teardown(AmqpError(f"connection closed by server: {code} {text}"))
         else:
             self._channel0_replies.put((method, wire.Reader(b"")))
+
+    def _heartbeat_loop(self) -> None:
+        """Send a heartbeat every interval/2; declare the connection dead
+        after two intervals with no inbound frames of any kind (the same
+        rule streadway applies on the reference's dial path). Teardown
+        wakes the blocked reader, fails in-flight RPCs, and lets the
+        queue supervisor reconnect."""
+        interval = self._heartbeat
+        deadline = self._heartbeat_deadline
+        while not self._closed.wait(interval / 2):
+            # the idle check runs before (and independently of) the write
+            # lock: a publisher blocked in sendall against a broker that
+            # stopped reading holds the lock indefinitely, and the
+            # teardown below is what un-wedges it
+            idle = time.monotonic() - self._last_recv
+            if idle > deadline:
+                log.warning(
+                    f"heartbeat timeout: no frames for {idle:.2f}s "
+                    f"(limit {deadline:g}s); dropping connection"
+                )
+                self._teardown(
+                    AmqpError(f"heartbeat timeout after {idle:.2f}s")
+                )
+                return
+            if not self._write_lock.acquire(timeout=interval / 2):
+                continue  # lock busy (possibly wedged); skip this beat
+            try:
+                wire.write_frame(self._sock, wire.FRAME_HEARTBEAT, 0, b"")
+            except OSError as exc:
+                self._teardown(AmqpError(f"heartbeat send failed: {exc}"))
+                return
+            finally:
+                self._write_lock.release()
 
     def _dispatch_loop(self) -> None:
         while not self._closed.is_set():

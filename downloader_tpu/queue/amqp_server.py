@@ -10,10 +10,12 @@ broker at all (SURVEY.md §4: "multi-node behavior ... is untested").
 
 from __future__ import annotations
 
+import math
 import socket
 import socketserver
 import struct
 import threading
+import time
 
 from ..utils import get_logger
 from . import amqp_wire as wire
@@ -29,10 +31,16 @@ class AmqpServerStub:
         broker: MemoryBroker | None = None,
         username: str = "",
         password: str = "",
+        heartbeat: float = 0.0,
     ):
+        """``heartbeat`` is the interval the stub proposes during tune
+        (0 = heartbeats off, the pre-round-3 behavior). Sub-second values
+        keep their precision for the stub's local timers even though the
+        wire field is whole seconds, so tests can run fast."""
         self.broker = broker or MemoryBroker()
         self.username = username
         self.password = password
+        self.heartbeat = heartbeat
         self.connections_accepted = 0
         stub = self
 
@@ -73,6 +81,15 @@ class AmqpServerStub:
         for session in sessions:
             session.kill()
 
+    def mute(self) -> None:
+        """Simulate a wedged-but-open broker: every session keeps its TCP
+        socket open but stops sending bytes (heartbeats included). A
+        heartbeat-negotiating client must detect this in ~2×interval;
+        without heartbeats it would hang on kernel keepalives (60s+)."""
+        with self._lock:
+            for session in self._sessions:
+                session._muted = True
+
     def __enter__(self) -> "AmqpServerStub":
         return self.start()
 
@@ -94,10 +111,16 @@ class _ClientSession:
         self._channels: dict[int, object] = {}  # number -> MemoryChannel
         self._consumer_tags = 0
         self._alive = True
+        self._muted = False
+        self._heartbeat = 0.0  # outbound send pacing after tune-ok
+        self._heartbeat_deadline = 0.0  # client idle limit (2x wire value)
+        self._last_recv = time.monotonic()
 
     # -- plumbing --------------------------------------------------------
 
     def _send_method(self, channel: int, method: tuple[int, int], args: bytes):
+        if self._muted:
+            return
         with self._write_lock:
             wire.write_method(self._sock, channel, method, args)
 
@@ -158,21 +181,61 @@ class _ClientSession:
                 self._send_method(0, wire.CONNECTION_CLOSE, close)
                 return
 
-        tune = wire.Writer().short(2047).long(131072).short(0).done()
+        proposed = math.ceil(self._stub.heartbeat) if self._stub.heartbeat > 0 else 0
+        tune = wire.Writer().short(2047).long(131072).short(proposed).done()
         self._send_method(0, wire.CONNECTION_TUNE, tune)
-        method, _ = self._read_method()
+        method, reader = self._read_method()
         if method != wire.CONNECTION_TUNE_OK:
             return
+        reader.short()  # channel-max
+        reader.long()  # frame-max
+        # the client's tune-ok heartbeat is authoritative (AMQP 0-9-1);
+        # keep the stub's sub-second precision when it is the smaller
+        tuned = reader.short()
+        if tuned > 0 and self._stub.heartbeat > 0:
+            # send pacing may run sub-second (faster than obligated is
+            # safe); the kill deadline honors the wire value the client
+            # agreed to — it only promises a frame every tuned/2
+            self._heartbeat = min(float(tuned), self._stub.heartbeat)
+            self._heartbeat_deadline = 2.0 * tuned
         method, _ = self._read_method()
         if method != wire.CONNECTION_OPEN:
             return
         self._send_method(0, wire.CONNECTION_OPEN_OK, wire.Writer().shortstr("").done())
 
         self._stub._register(self)
+        if self._heartbeat > 0:
+            threading.Thread(
+                target=self._heartbeat_loop, daemon=True
+            ).start()
         try:
             self._loop()
         finally:
             self._mem.close()
+
+    def _heartbeat_loop(self) -> None:
+        """Mirror of the client's monitor: emit a heartbeat every
+        interval/2, kill the session when the client goes silent for two
+        intervals (so the stub also exercises the client's outbound
+        heartbeats — a client that stopped sending would be disconnected
+        by real RabbitMQ exactly this way)."""
+        interval = self._heartbeat
+        while self._alive:
+            time.sleep(interval / 2)
+            if not self._alive:
+                return
+            if time.monotonic() - self._last_recv > self._heartbeat_deadline:
+                log.info("client heartbeat timeout; dropping session")
+                self.kill()
+                return
+            if self._muted:
+                continue
+            try:
+                with self._write_lock:
+                    wire.write_frame(self._sock, wire.FRAME_HEARTBEAT, 0, b"")
+            except OSError:
+                self.kill()
+                return
 
     def _recv_exact(self, count: int) -> bytes:
         data = bytearray()
@@ -186,6 +249,7 @@ class _ClientSession:
     def _read_method(self):
         while True:
             frame_type, channel, payload = wire.read_frame(self._sock)
+            self._last_recv = time.monotonic()
             if frame_type == wire.FRAME_HEARTBEAT:
                 continue
             if frame_type == wire.FRAME_METHOD:
@@ -195,6 +259,7 @@ class _ClientSession:
         pending_publish = None  # (channel_num, exchange, rk, body_size, props, chunks)
         while self._alive:
             frame_type, channel_num, payload = wire.read_frame(self._sock)
+            self._last_recv = time.monotonic()
             if frame_type == wire.FRAME_HEARTBEAT:
                 continue
             if frame_type == wire.FRAME_HEADER and pending_publish:
@@ -315,7 +380,7 @@ class _ClientSession:
             channel.close()
 
     def _deliver(self, channel_num: int, consumer_tag: str, message: Message) -> None:
-        if not self._alive:
+        if not self._alive or self._muted:
             return
         args = (
             wire.Writer()
