@@ -270,7 +270,11 @@ def serve(
             signal.signal(signum, handle)
 
     log.info("connecting to broker ...")
-    client = QueueClient(token, build_connection_factory(config))
+    client = QueueClient(
+        token,
+        build_connection_factory(config),
+        publish_confirm_timeout=config.publish_confirm_timeout,
+    )
     client.set_prefetch(config.prefetch)
     log.info("connected")
 

@@ -47,6 +47,18 @@ class AmqpError(BrokerError):
     pass
 
 
+class _ConfirmSlot:
+    __slots__ = ("event", "ok")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.ok: bool | None = None
+
+    def resolve(self, ok: bool) -> None:
+        self.ok = ok
+        self.event.set()
+
+
 class _PendingContent:
     __slots__ = ("method_reader", "body_size", "props", "chunks", "received")
 
@@ -66,6 +78,14 @@ class AmqpChannel:
         self._consumers: dict[str, Callable[[Message], None]] = {}
         self._pending: _PendingContent | None = None
         self.closed = False
+        # publisher-confirm state (confirm.select): publish seq numbers
+        # start at 1 after select; broker acks/nacks carry the seq as the
+        # delivery tag, optionally with the `multiple` bit
+        self._confirm_mode = False
+        self._publish_seq = 0
+        self._confirm_lock = threading.Lock()
+        self._confirms: dict[int, "_ConfirmSlot"] = {}
+        self.confirm_timeout = 30.0
 
     # -- RPC plumbing ----------------------------------------------------
 
@@ -153,6 +173,16 @@ class AmqpChannel:
         )  # prefetch-size 0, global false
         self._rpc(wire.BASIC_QOS, args, wire.BASIC_QOS_OK)
 
+    def confirm_select(self) -> None:
+        """Enter publisher-confirm mode (RabbitMQ extension, class 85):
+        after this, ``publish`` blocks until the broker acks the message
+        and raises on nack/timeout/connection loss — the durable hand-off
+        the reference's ack-after-write path lacks (delivery.go:73-84)."""
+        self._check()
+        self._rpc(wire.CONFIRM_SELECT, wire.Writer().bit(False).done(),
+                  wire.CONFIRM_SELECT_OK)
+        self._confirm_mode = True
+
     def publish(
         self,
         exchange: str,
@@ -174,7 +204,39 @@ class AmqpChannel:
         header = wire.encode_content_header(
             len(body), headers=headers, delivery_mode=2 if persistent else 1
         )
-        self._connection._send_content(self._number, args, header, body)
+        if not self._confirm_mode:
+            self._connection._send_content(self._number, args, header, body)
+            return
+        # seq assignment must match socket-write order, so it happens
+        # inside the connection write lock's critical section. The
+        # confirm lock itself is only held for the dict update — never
+        # across the (blocking) socket write — so the reader thread's
+        # _resolve_confirms can always make progress even while a
+        # publisher is wedged in sendall against a flow-controlled
+        # broker (otherwise heartbeat reads would stall behind it and
+        # the monitor would tear down a healthy connection)
+        with self._connection._write_lock:
+            with self._confirm_lock:
+                self._publish_seq += 1
+                seq = self._publish_seq
+                slot = _ConfirmSlot()
+                self._confirms[seq] = slot
+            try:
+                self._connection._send_content_locked(
+                    self._number, args, header, body
+                )
+            except Exception:
+                with self._confirm_lock:
+                    self._confirms.pop(seq, None)
+                raise
+        if not slot.event.wait(self.confirm_timeout):
+            with self._confirm_lock:
+                self._confirms.pop(seq, None)
+            raise AmqpError(
+                f"publish confirm timed out after {self.confirm_timeout:g}s"
+            )
+        if not slot.ok:
+            raise AmqpError("publish was not confirmed (nacked or connection lost)")
 
     def consume(self, queue: str, on_message: Callable[[Message], None]) -> str:
         self._check()
@@ -232,7 +294,53 @@ class AmqpChannel:
         if method == wire.BASIC_DELIVER:
             self._pending = _PendingContent(reader)
             return
+        if self._confirm_mode and method in (wire.BASIC_ACK, wire.BASIC_NACK):
+            # in confirm mode these are broker->client confirms, not
+            # consumer operations (which are client->server only)
+            tag = reader.longlong()
+            multiple = reader.bit()
+            self._resolve_confirms(tag, multiple, ok=method == wire.BASIC_ACK)
+            return
+        if method == wire.CHANNEL_CLOSE and self._confirm_mode:
+            # a publisher may be blocked waiting on a confirm that will
+            # never come: fail it now instead of letting it ride out the
+            # timeout, and mark the channel closed so the NEXT publish
+            # fails fast instead of stalling on a server-closed channel.
+            # An in-flight RPC (topology declare) learns of the close via
+            # the error-tuple path it already understands; with no waiter
+            # the entry sits in a dead channel's queue, harmless.
+            code = reader.short()
+            text = reader.shortstr()
+            self.closed = True
+            self._fail_confirms()
+            try:
+                self._connection._send_method(
+                    self._number, wire.CHANNEL_CLOSE_OK, b""
+                )
+            except AmqpError:
+                pass
+            log.warning(f"publisher channel closed by server: {code} {text}")
+            self._replies.put(
+                (("error",), AmqpError(f"channel closed by server: {code} {text}"))
+            )
+            return
         self._replies.put((method, reader))
+
+    def _resolve_confirms(self, tag: int, multiple: bool, ok: bool) -> None:
+        with self._confirm_lock:
+            if multiple:
+                seqs = [s for s in self._confirms if s <= tag]
+            else:
+                seqs = [tag] if tag in self._confirms else []
+            slots = [self._confirms.pop(s) for s in seqs]
+        for slot in slots:
+            slot.resolve(ok)
+
+    def _fail_confirms(self) -> None:
+        with self._confirm_lock:
+            slots, self._confirms = list(self._confirms.values()), {}
+        for slot in slots:
+            slot.resolve(False)
 
     def _handle_content_header(self, payload: bytes) -> None:
         if self._pending is None:
@@ -274,6 +382,7 @@ class AmqpChannel:
 
     def _fail(self, exc: Exception) -> None:
         self.closed = True
+        self._fail_confirms()
         self._replies.put((("error",), exc))
 
 
@@ -452,18 +561,26 @@ class AmqpConnection:
     def _send_content(
         self, channel: int, publish_args: bytes, header: bytes, body: bytes
     ) -> None:
+        with self._write_lock:
+            self._send_content_locked(channel, publish_args, header, body)
+
+    def _send_content_locked(
+        self, channel: int, publish_args: bytes, header: bytes, body: bytes
+    ) -> None:
+        """Write the publish frames; caller must hold ``_write_lock``
+        (confirm-mode publish holds it directly so the confirm seq number
+        is assigned in socket-write order)."""
         max_body = self._frame_max - 8
         try:
-            with self._write_lock:
-                wire.write_method(self._sock, channel, wire.BASIC_PUBLISH, publish_args)
-                wire.write_frame(self._sock, wire.FRAME_HEADER, channel, header)
-                for start in range(0, len(body), max_body):
-                    wire.write_frame(
-                        self._sock,
-                        wire.FRAME_BODY,
-                        channel,
-                        body[start : start + max_body],
-                    )
+            wire.write_method(self._sock, channel, wire.BASIC_PUBLISH, publish_args)
+            wire.write_frame(self._sock, wire.FRAME_HEADER, channel, header)
+            for start in range(0, len(body), max_body):
+                wire.write_frame(
+                    self._sock,
+                    wire.FRAME_BODY,
+                    channel,
+                    body[start : start + max_body],
+                )
         except OSError as exc:
             self._teardown(AmqpError(f"send failed: {exc}"))
             raise AmqpError(f"send failed: {exc}") from exc

@@ -42,6 +42,10 @@ class AmqpServerStub:
         self.password = password
         self.heartbeat = heartbeat
         self.connections_accepted = 0
+        # loss-window simulation: route confirm-mode publishes normally
+        # but never send the basic.ack, so wire clients waiting on a
+        # confirm see the timeout/teardown path
+        self.hold_confirm_acks = False
         stub = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -115,6 +119,7 @@ class _ClientSession:
         self._heartbeat = 0.0  # outbound send pacing after tune-ok
         self._heartbeat_deadline = 0.0  # client idle limit (2x wire value)
         self._last_recv = time.monotonic()
+        self._confirm_seq: dict[int, int] = {}  # channel -> publish seq
 
     # -- plumbing --------------------------------------------------------
 
@@ -354,6 +359,9 @@ class _ClientSession:
                 reader.bit()  # multiple
                 requeue = reader.bit()
                 channel.nack(tag, requeue=requeue)
+            elif method == wire.CONFIRM_SELECT:
+                self._confirm_seq[channel_num] = 0
+                self._send_method(channel_num, wire.CONFIRM_SELECT_OK, b"")
 
     def _finish_publish(self, pending) -> None:
         channel_num, exchange, routing_key, _, props, chunks = pending
@@ -369,6 +377,17 @@ class _ClientSession:
             )
         except BrokerError as exc:
             self._close_channel_with_error(channel_num, 404, str(exc))
+            return
+        if channel_num in self._confirm_seq:
+            self._confirm_seq[channel_num] += 1
+            if not self._stub.hold_confirm_acks:
+                ack = (
+                    wire.Writer()
+                    .longlong(self._confirm_seq[channel_num])
+                    .bit(False)  # multiple
+                    .done()
+                )
+                self._send_method(channel_num, wire.BASIC_ACK, ack)
 
     def _close_channel_with_error(self, channel_num: int, code: int, text: str):
         args = (

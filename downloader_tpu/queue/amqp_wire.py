@@ -55,6 +55,8 @@ BASIC_PUBLISH = (60, 40)
 BASIC_DELIVER = (60, 60)
 BASIC_ACK = (60, 80)
 BASIC_NACK = (60, 120)
+CONFIRM_SELECT = (85, 10)
+CONFIRM_SELECT_OK = (85, 11)
 
 
 class AmqpWireError(Exception):

@@ -48,6 +48,16 @@ class Channel(Protocol):
 
     def set_prefetch(self, count: int) -> None: ...
 
+    def confirm_select(self) -> None:
+        """Put the channel in publisher-confirm mode (RabbitMQ's
+        ``confirm.select`` extension): every subsequent ``publish`` blocks
+        until the broker acknowledges the message and raises BrokerError
+        if it is nacked, the confirm times out, or the connection dies
+        first — so a True return from the layers above genuinely means
+        "on the broker", closing the ack-after-socket-write loss window
+        the reference shares (delivery.go:73-84)."""
+        ...
+
     def publish(
         self,
         exchange: str,

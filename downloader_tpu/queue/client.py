@@ -98,6 +98,7 @@ class QueueClient:
         publish_backoff_base: float = 0.1,
         publish_backoff_cap: float = 5.0,
         drain_timeout: float = 60.0,
+        publish_confirm_timeout: float = 30.0,
     ):
         self._token = token
         self._connect = connect
@@ -107,6 +108,7 @@ class QueueClient:
         self._publish_backoff_base = publish_backoff_base
         self._publish_backoff_cap = publish_backoff_cap
         self._drain_timeout = drain_timeout
+        self._publish_confirm_timeout = publish_confirm_timeout
 
         self._lock = threading.RLock()
         self._prefetch = DEFAULT_PREFETCH
@@ -268,6 +270,7 @@ class QueueClient:
             # error() retries route through the buffered publisher so they
             # survive outages and are drained at shutdown
             publisher=self.publish,
+            publish_confirm_timeout=self._publish_confirm_timeout,
         )
         shard.sink.put(delivery)
 
@@ -307,10 +310,23 @@ class QueueClient:
         with self._lock:
             need_publisher = not self._publisher_alive
         if need_publisher:
+            channel = None
             try:
                 channel = self._channel()
+                # publisher confirms: publish() on this channel blocks
+                # until the broker acks, so _PendingPublish.flushed truly
+                # means "on the broker" — the reference acks retried
+                # messages on a bare socket write (delivery.go:73-84),
+                # losing them if the broker dies in the window
+                channel.confirm_select()
+                channel.confirm_timeout = self._publish_confirm_timeout
             except BrokerError as exc:
                 log.error(f"failed to create publisher channel: {exc}")
+                if channel is not None:
+                    try:
+                        channel.close()
+                    except BrokerError:
+                        pass
                 return
             with self._lock:
                 self._publisher_channel = channel
@@ -519,8 +535,21 @@ class QueueClient:
                     if self._publisher_channel is my_channel:
                         self._publisher_alive = False
                         self._publisher_channel = None
+                # close the abandoned channel: with confirms, a publish
+                # failure (confirm timeout) can happen on a HEALTHY
+                # connection, and leaking one open channel per retry
+                # cycle would eventually blow past the negotiated
+                # channel-max on a real broker
+                try:
+                    my_channel.close()
+                except BrokerError:
+                    pass
                 return  # thread exits; supervisor recreates with a fresh channel
         with self._lock:
             if self._publisher_channel is my_channel:
                 self._publisher_alive = False
                 self._publisher_channel = None
+        try:
+            my_channel.close()
+        except BrokerError:
+            pass

@@ -38,6 +38,16 @@ class MemoryBroker:
         # simulate a sustained outage: drop_connections() alone lets
         # clients reconnect on their next supervisor tick
         self.refuse_connections = False
+        # async-confirm mode: while True, confirm-mode publishes are
+        # STAGED (accepted off the "socket" but neither routed nor
+        # confirmed) until release_confirms() — opening the same window a
+        # real broker has between receiving a publish and acking it, so
+        # the write-then-crash loss scenario is testable. A connection
+        # that dies while its publish is staged never gets the confirm
+        # and the staged message is discarded, exactly like a broker
+        # crash before persistence.
+        self.hold_confirms = False
+        self._held: list[_HeldPublish] = []
 
     # -- wiring ----------------------------------------------------------
 
@@ -165,6 +175,48 @@ class MemoryBroker:
         with self._lock:
             return len(self._queues.get(queue, ()))
 
+    # -- async confirms ---------------------------------------------------
+
+    def release_confirms(self) -> None:
+        """Route and confirm every staged publish ("the broker caught
+        up"). Staged publishes from connections that died in the meantime
+        are discarded — their publisher already saw a failure."""
+        with self._lock:
+            held, self._held = list(self._held), []
+        for entry in held:
+            if entry.result is not None:  # already failed by _die
+                continue
+            try:
+                self._publish(
+                    entry.exchange, entry.routing_key, entry.body, entry.headers
+                )
+                entry.result = True
+            except BrokerError:
+                entry.result = False
+            entry.event.set()
+
+    def _fail_held(self, connection: "MemoryConnection") -> None:
+        with self._lock:
+            for entry in self._held:
+                if entry.channel._connection is connection:
+                    entry.result = False
+                    entry.event.set()
+            self._held = [e for e in self._held if e.result is None]
+
+
+class _HeldPublish:
+    __slots__ = ("channel", "exchange", "routing_key", "body", "headers",
+                 "event", "result")
+
+    def __init__(self, channel, exchange, routing_key, body, headers):
+        self.channel = channel
+        self.exchange = exchange
+        self.routing_key = routing_key
+        self.body = body
+        self.headers = headers
+        self.event = threading.Event()
+        self.result: bool | None = None
+
 
 class _Consumer:
     def __init__(self, channel: "MemoryChannel", callback: Callable[[Message], None]):
@@ -198,6 +250,8 @@ class MemoryChannel:
         self.unacked: dict[int, tuple[str, bytes, dict]] = {}
         self.closed = False
         self._consumer_names: list[str] = []
+        self._confirm_mode = False
+        self.confirm_timeout = 30.0  # overwritten by QueueClient's knob
 
     def _check(self) -> None:
         if self.closed or self._connection.is_closed():
@@ -219,8 +273,35 @@ class MemoryChannel:
         self._check()
         self.prefetch = count
 
+    def confirm_select(self) -> None:
+        self._check()
+        self._confirm_mode = True
+
     def publish(self, exchange, routing_key, body, headers=None, persistent=True):
         self._check()
+        if self._confirm_mode and self._broker.hold_confirms:
+            entry = _HeldPublish(self, exchange, routing_key, body, headers or {})
+            with self._broker._lock:
+                self._broker._held.append(entry)
+            if not entry.event.wait(self.confirm_timeout):
+                # withdraw the staged copy: the publisher is about to
+                # retry, and a later release_confirms() must not route a
+                # message whose hand-off already reported failure
+                with self._broker._lock:
+                    if entry in self._broker._held:
+                        self._broker._held.remove(entry)
+                        raise BrokerError("publish confirm timed out")
+                # lost the race with release_confirms: the entry was
+                # taken for routing; honor whatever result it reached
+                entry.event.wait(self.confirm_timeout)
+                if entry.result is True:
+                    return
+                raise BrokerError("publish confirm timed out")
+            if entry.result is not True:
+                raise BrokerError("connection died before publish confirm")
+            return
+        # synchronous mode: routing IS the confirm (the default, so
+        # non-confirm callers and fast tests keep their behavior)
         self._broker._publish(exchange, routing_key, body, headers or {})
 
     def consume(self, queue: str, on_message: Callable[[Message], None]) -> str:
@@ -285,6 +366,7 @@ class MemoryConnection:
         if self._closed:
             return
         self._closed = True
+        self._broker._fail_held(self)  # staged publishes are lost with us
         for channel in self._channels:
             channel.close()
         with self._broker._lock:

@@ -3,6 +3,7 @@ in-process TCP server stub — handshake/auth, topology declare, publish/
 consume/ack with headers, frame splitting for large bodies, error and
 outage paths, and the full QueueClient running over real sockets."""
 
+import threading
 import time
 
 import pytest
@@ -294,3 +295,84 @@ class TestHeartbeats:
                 delivery.ack()
             finally:
                 token.cancel()
+
+
+class TestPublisherConfirmsWire:
+    def test_confirm_select_publish_acks(self, server):
+        conn = AmqpConnection.dial(server.endpoint)
+        ch = conn.channel()
+        ch.declare_exchange("t")
+        ch.declare_queue("t-0")
+        ch.bind_queue("t-0", "t", "t-0")
+        ch.confirm_select()
+        ch.publish("t", "t-0", b"confirmed")  # blocks until broker ack
+        assert server.broker.queue_depth("t-0") == 1
+        conn.close()
+
+    def test_unacked_confirm_times_out(self, server):
+        server.hold_confirm_acks = True
+        conn = AmqpConnection.dial(server.endpoint)
+        ch = conn.channel()
+        ch.declare_exchange("t")
+        ch.declare_queue("t-0")
+        ch.bind_queue("t-0", "t", "t-0")
+        ch.confirm_select()
+        ch.confirm_timeout = 0.5
+        with pytest.raises(AmqpError, match="confirm timed out"):
+            ch.publish("t", "t-0", b"never-acked")
+        conn.close()
+
+    def test_connection_loss_fails_pending_confirm_fast(self, server):
+        server.hold_confirm_acks = True
+        conn = AmqpConnection.dial(server.endpoint)
+        ch = conn.channel()
+        ch.declare_exchange("t")
+        ch.declare_queue("t-0")
+        ch.bind_queue("t-0", "t", "t-0")
+        ch.confirm_select()
+        ch.confirm_timeout = 30.0  # must NOT ride this out
+        errors = []
+
+        def blocked_publish():
+            try:
+                ch.publish("t", "t-0", b"in-window")
+            except AmqpError as exc:
+                errors.append(exc)
+
+        th = threading.Thread(target=blocked_publish)
+        th.start()
+        time.sleep(0.3)
+        server.drop_clients()  # dies between socket write and confirm
+        th.join(timeout=5)
+        assert not th.is_alive()
+        assert errors, "publish returned despite the confirm never arriving"
+
+    def test_queue_client_retries_unconfirmed_until_confirmed(self, server):
+        """End to end over TCP: a publish whose confirm is lost with the
+        connection is retried after reconnect and publish(wait=) only
+        returns True once a confirm actually arrives."""
+        server.hold_confirm_acks = True
+        token = CancelToken()
+        try:
+            client = QueueClient(
+                token,
+                lambda: AmqpConnection.dial(server.endpoint),
+                supervisor_interval=0.05,
+                drain_timeout=2,
+                publish_confirm_timeout=1.0,
+            )
+            client.consume("t")
+            result = []
+            th = threading.Thread(
+                target=lambda: result.append(client.publish("t", b"x", wait=15))
+            )
+            th.start()
+            time.sleep(0.5)
+            assert not result  # unconfirmed: still waiting
+            server.drop_clients()  # confirm lost with the connection
+            time.sleep(0.3)
+            server.hold_confirm_acks = False  # broker healthy again
+            th.join(timeout=15)
+            assert result == [True]
+        finally:
+            token.cancel()
