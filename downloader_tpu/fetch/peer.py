@@ -30,6 +30,7 @@ import concurrent.futures
 import hashlib
 import ipaddress
 import os
+import queue
 import secrets
 import socket
 import struct
@@ -55,11 +56,18 @@ EXTENSION_BIT = 0x100000  # reserved[5] & 0x10 → BEP 10 support
 MSG_CHOKE = 0
 MSG_UNCHOKE = 1
 MSG_INTERESTED = 2
+MSG_NOT_INTERESTED = 3
 MSG_HAVE = 4
 MSG_BITFIELD = 5
 MSG_REQUEST = 6
 MSG_PIECE = 7
+MSG_CANCEL = 8
 MSG_EXTENDED = 20
+
+# largest block an inbound REQUEST may ask for; the de-facto norm is
+# 16 KiB but mainstream clients tolerate up to 128 KiB before dropping
+# the requester as hostile
+MAX_REQUEST_LENGTH = 128 * 1024
 
 UT_METADATA = 1  # our local extended-message id for ut_metadata
 
@@ -67,6 +75,23 @@ UT_METADATA = 1  # our local extended-message id for ut_metadata
 def generate_peer_id() -> bytes:
     # Azureus-style prefix; "dT" = downloader_tpu
     return b"-DT0100-" + secrets.token_bytes(12)
+
+
+def _frame(msg_id: int, payload: bytes = b"") -> bytes:
+    """One length-prefixed peer-wire frame (shared by both halves)."""
+    return struct.pack(">IB", 1 + len(payload), msg_id) + payload
+
+
+def _recv_into(sock: socket.socket, count: int) -> bytes | None:
+    """Read exactly ``count`` bytes; None on EOF (callers raise their
+    side's idiomatic exception — TransferError outbound, OSError inbound)."""
+    data = bytearray()
+    while len(data) < count:
+        chunk = sock.recv(count - len(data))
+        if not chunk:
+            return None
+        data += chunk
+    return bytes(data)
 
 
 # ---------------------------------------------------------------------------
@@ -80,20 +105,25 @@ def announce(
     left: int,
     port: int = 6881,
     timeout: float = 15.0,
+    event: str = "started",
 ) -> list[tuple[str, int]]:
     """HTTP announce; returns peer (host, port) pairs. Supports compact
-    (BEP 23) and dict-form peer lists."""
+    (BEP 23) and dict-form peer lists. ``event=""`` is a regular
+    re-announce — repeating "started" would reset the session on real
+    trackers (and some rate-limit it)."""
+    params = {
+        "info_hash": info_hash,
+        "peer_id": peer_id,
+        "port": str(port),
+        "uploaded": "0",
+        "downloaded": "0",
+        "left": str(left),
+        "compact": "1",
+    }
+    if event:
+        params["event"] = event
     query = urllib.parse.urlencode(
-        {
-            "info_hash": info_hash,
-            "peer_id": peer_id,
-            "port": str(port),
-            "uploaded": "0",
-            "downloaded": "0",
-            "left": str(left),
-            "compact": "1",
-            "event": "started",
-        },
+        params,
         quote_via=urllib.parse.quote,
         safe="",
     )
@@ -195,6 +225,7 @@ def announce_udp(
     port: int = 6881,
     timeout: float = 3.0,
     retries: int = 1,
+    event: str = "started",
 ) -> list[tuple[str, int]]:
     """UDP announce (BEP 15): connect handshake to obtain a connection
     id, then announce; returns peer (host, port) pairs. Defaults bound a
@@ -239,7 +270,8 @@ def announce_udp(
                 0,  # downloaded
                 left,
                 0,  # uploaded
-                2,  # event: started
+                # BEP 15 event codes; 0 = none (regular re-announce)
+                {"": 0, "completed": 1, "started": 2, "stopped": 3}[event],
                 0,  # IP (default: sender address)
                 struct.unpack(">I", secrets.token_bytes(4))[0],  # key
                 -1,  # num_want: default
@@ -304,6 +336,10 @@ class PeerConnection:
             raise PeerProtocolError("bad handshake protocol string")
         if reply[28:48] != self.info_hash:
             raise PeerProtocolError("peer served a different info-hash")
+        if reply[48:68] == peer_id:
+            # trackers echo our own announce back; a connection to our
+            # own listener would idle-loop (we have nothing we need)
+            raise PeerProtocolError("connected to ourselves")
         self.remote_supports_extended = bool(reply[25] & 0x10)
         if self.remote_supports_extended:
             self.send_extended_handshake()
@@ -315,17 +351,13 @@ class PeerConnection:
     # -- framing ---------------------------------------------------------
 
     def _recv_exact(self, count: int) -> bytes:
-        chunks = bytearray()
-        while len(chunks) < count:
-            chunk = self._sock.recv(count - len(chunks))
-            if not chunk:
-                raise PeerProtocolError("peer closed connection")
-            chunks += chunk
-        return bytes(chunks)
+        data = _recv_into(self._sock, count)
+        if data is None:
+            raise PeerProtocolError("peer closed connection")
+        return data
 
     def send_message(self, msg_id: int, payload: bytes = b"") -> None:
-        frame = struct.pack(">IB", 1 + len(payload), msg_id) + payload
-        self._sock.sendall(frame)
+        self._sock.sendall(_frame(msg_id, payload))
 
     def read_message(self) -> tuple[int, bytes]:
         """Return (msg_id, payload); keepalives are skipped. Updates choke /
@@ -344,9 +376,25 @@ class PeerConnection:
                 self.choked = False
             elif msg_id == MSG_BITFIELD:
                 self.bitfield = payload
+            elif msg_id == MSG_HAVE and len(payload) >= 4:
+                self._mark_have(struct.unpack(">I", payload[:4])[0])
             elif msg_id == MSG_EXTENDED and payload and payload[0] == 0:
                 self._parse_extended_handshake(payload[1:])
             return msg_id, payload
+
+    def _mark_have(self, index: int) -> None:
+        """Fold a HAVE announcement into the peer's bitfield, so piece
+        selection sees leechers gain pieces live (anacrolix tracks HAVE
+        the same way; without this, a peer's availability is frozen at
+        its initial bitfield and leecher-to-leecher swarms starve)."""
+        byte_index, bit = divmod(index, 8)
+        if byte_index >= 4 * 1024 * 1024:  # 32M pieces: hostile nonsense
+            raise PeerProtocolError(f"HAVE index out of range: {index}")
+        field = bytearray(self.bitfield)
+        if byte_index >= len(field):
+            field.extend(bytes(byte_index + 1 - len(field)))
+        field[byte_index] |= 0x80 >> bit
+        self.bitfield = bytes(field)
 
     def _parse_extended_handshake(self, payload: bytes) -> None:
         try:
@@ -513,6 +561,12 @@ class PieceStore:
         # otherwise race the exists()/"wb" decision and truncate each
         # other's bytes in shared files
         self._write_lock = threading.Lock()
+        # piece-complete callbacks (index) — the inbound listener hangs
+        # its HAVE broadcast here so remote leechers learn of new pieces
+        self._observers: list = []
+
+    def add_observer(self, callback) -> None:
+        self._observers.append(callback)
 
     @property
     def num_pieces(self) -> int:
@@ -537,8 +591,23 @@ class PieceStore:
         optional path→open-file cache so a whole-torrent scan
         (resume_existing) opens each file once instead of once per piece.
         """
-        offset = index * self.piece_length
-        size = self.piece_size(index)
+        return self._read_range(
+            index * self.piece_length, self.piece_size(index), handles
+        )
+
+    def read_block(self, index: int, begin: int, length: int) -> bytes | None:
+        """One block of a COMPLETED piece, for serving inbound REQUESTs.
+        Returns None for pieces we don't have or out-of-bounds ranges —
+        the serving side drops such requests rather than erroring."""
+        if not (0 <= index < self.num_pieces) or not self.have[index]:
+            return None
+        if begin < 0 or length <= 0 or begin + length > self.piece_size(index):
+            return None
+        return self._read_range(index * self.piece_length + begin, length)
+
+    def _read_range(
+        self, offset: int, size: int, handles: dict | None = None
+    ) -> bytes | None:
         out = bytearray()
         file_start = 0
         for path, length in self.files:
@@ -657,6 +726,403 @@ class PieceStore:
                         break
                 file_start = file_end
             self.have[index] = True
+        # notify outside the write lock: observers hit the network (HAVE
+        # broadcasts) and must not serialize piece writes behind a slow
+        # remote's socket
+        for callback in list(self._observers):
+            callback(index)
+
+
+# ---------------------------------------------------------------------------
+# inbound peer half (the listener behind the announced port)
+
+
+class _InboundPeer:
+    """One accepted connection: handshake, then serve the remote leecher.
+
+    Policy is serve-everyone: INTERESTED is answered with UNCHOKE as
+    soon as a PieceStore is attached (no tit-for-tat slots — a
+    job-scoped swarm has nothing to ration), REQUESTs for completed
+    pieces are answered from the store, and ut_metadata requests are
+    served from the raw info dict so magnet-only peers can bootstrap
+    metadata from us (BEP 9) — all behavior the reference gets from
+    anacrolix's full client (torrent.go:44).
+    """
+
+    def __init__(self, listener: "PeerListener", sock: socket.socket, addr):
+        self._listener = listener
+        self._sock = sock
+        self.addr = addr
+        # the serve loop and the sender thread interleave writes on one
+        # socket; frames must not shear
+        self._send_lock = threading.Lock()
+        self.interested = False
+        # sticky: drain accounting must still count a leecher that sent
+        # NOT_INTERESTED when finished (spec-compliant behavior)
+        self.ever_interested = False
+        self._unchoked = False
+        self._remote_ext: dict[bytes, int] = {}
+        # nothing may be written before our handshake reply is on the
+        # wire: attach()/HAVE broadcasts land mid-handshake otherwise
+        # and the remote reads them as garbled handshake bytes
+        self._ready = threading.Event()
+        # async outbound frames (HAVE broadcasts, deferred UNCHOKE) go
+        # through a sender thread so a stalled remote's full TCP buffer
+        # can never block the piece-writer thread that completed a piece
+        self._outq: "queue.Queue[bytes | None]" = queue.Queue(maxsize=65536)
+        # generous: a remote in its WAIT state (all missing pieces
+        # claimed elsewhere) legitimately idles without keepalives
+        sock.settimeout(120.0)
+
+    # -- outgoing --------------------------------------------------------
+
+    def _send(self, msg_id: int, payload: bytes = b"") -> None:
+        with self._send_lock:
+            self._sock.sendall(_frame(msg_id, payload))
+
+    def _enqueue(self, frame: bytes) -> None:
+        if not self._ready.is_set():
+            return  # pre-handshake; the post-handshake catch-up covers it
+        try:
+            self._outq.put_nowait(frame)
+        except queue.Full:
+            self.close()  # pathologically slow consumer: reap
+
+    def _sender_loop(self) -> None:
+        while True:
+            frame = self._outq.get()
+            if frame is None:
+                return
+            try:
+                with self._send_lock:
+                    self._sock.sendall(frame)
+            except OSError:
+                return  # dying connection; the serve loop reaps it
+
+    def notify_have(self, index: int) -> None:
+        self._enqueue(_frame(MSG_HAVE, struct.pack(">I", index)))
+
+    def arm(self, have_indices: list[int]) -> None:
+        """Attach-time catch-up for an already-handshaken connection:
+        pieces that existed before attach (resume) go out as HAVE
+        frames — a late BITFIELD is not spec-legal — and a remote that
+        declared INTERESTED while we had nothing to serve gets its
+        deferred UNCHOKE. Connections still mid-handshake are skipped
+        (_enqueue no-ops pre-ready); their post-handshake catch-up
+        re-snapshots the store and covers the same ground."""
+        for index in have_indices:
+            self.notify_have(index)
+        self._maybe_unchoke()
+
+    def _maybe_unchoke(self) -> None:
+        store, _ = self._listener.snapshot()
+        if store is None or not self.interested:
+            return  # defer: nothing to serve until attach
+        # benign race: two callers can both pass this check and enqueue
+        # a duplicate UNCHOKE, which the protocol tolerates
+        if self._unchoked:
+            return
+        self._unchoked = True
+        self._enqueue(_frame(MSG_UNCHOKE))
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        try:
+            self._outq.put_nowait(None)  # wake the sender so it exits
+        except queue.Full:
+            pass  # sender will die on the closed socket instead
+
+    # -- serve loop ------------------------------------------------------
+
+    def run(self) -> None:
+        sender = threading.Thread(
+            target=self._sender_loop,
+            daemon=True,
+            name=f"peer-send-{self.addr[0]}:{self.addr[1]}",
+        )
+        sender.start()
+        try:
+            self._serve()
+        except (OSError, PeerProtocolError, struct.error):
+            pass  # remote gone or misbehaving: reap quietly
+        finally:
+            self.close()
+            self._listener.discard(self)
+
+    def _recv_exact(self, count: int) -> bytes:
+        data = _recv_into(self._sock, count)
+        if data is None:
+            raise OSError("remote closed")
+        return data
+
+    def _serve(self) -> None:
+        hs = self._recv_exact(68)
+        if hs[1:20] != HANDSHAKE_PSTR or hs[28:48] != self._listener.info_hash:
+            return
+        remote_supports_ext = bool(hs[25] & 0x10)
+        reserved = bytearray(8)
+        reserved[5] |= 0x10  # BEP 10
+        with self._send_lock:
+            self._sock.sendall(
+                bytes([len(HANDSHAKE_PSTR)])
+                + HANDSHAKE_PSTR
+                + bytes(reserved)
+                + self._listener.info_hash
+                + self._listener.peer_id
+            )
+        store, info_bytes = self._listener.snapshot()
+        sent_have: list[bool] = []
+        if store is not None:
+            # always a bitfield post-attach, even all-zero: an absent
+            # bitfield reads as "seeder" to permissive clients
+            # (including our own claim heuristic)
+            sent_have = list(store.have)
+            field = bytearray((len(sent_have) + 7) // 8)
+            for i, done in enumerate(sent_have):
+                if done:
+                    field[i // 8] |= 0x80 >> (i % 8)
+            self._send(MSG_BITFIELD, bytes(field))
+        if remote_supports_ext:
+            # only to peers that advertised BEP 10 — a vanilla client
+            # would drop us over an unknown message id
+            ext = {b"m": {b"ut_metadata": UT_METADATA}}
+            if info_bytes is not None:
+                ext[b"metadata_size"] = len(info_bytes)
+            self._send(MSG_EXTENDED, bytes([0]) + bencode.encode(ext))
+        # open the async channel, then catch up on anything that
+        # completed (or an attach that landed) while the handshake was
+        # in flight — those broadcasts were suppressed by _ready
+        self._ready.set()
+        store, _ = self._listener.snapshot()
+        if store is not None:
+            for index, done in enumerate(store.have):
+                if done and (index >= len(sent_have) or not sent_have[index]):
+                    self.notify_have(index)
+
+        while True:
+            length = struct.unpack(">I", self._recv_exact(4))[0]
+            if length == 0:
+                continue  # keepalive
+            if length > (1 << 20) + 9:
+                raise PeerProtocolError(f"oversized frame: {length}")
+            body = self._recv_exact(length)
+            msg_id, payload = body[0], body[1:]
+            if msg_id == MSG_INTERESTED:
+                self.interested = True
+                self.ever_interested = True
+                self._maybe_unchoke()
+            elif msg_id == MSG_NOT_INTERESTED:
+                self.interested = False
+            elif msg_id == MSG_REQUEST and len(payload) == 12:
+                self._serve_request(payload)
+            elif msg_id == MSG_EXTENDED and payload:
+                self._serve_extended(payload)
+            # HAVE/BITFIELD from the remote and CANCEL need no action:
+            # leeching happens on outbound connections only, and serving
+            # is synchronous so a CANCEL always arrives too late.
+
+    def _serve_request(self, payload: bytes) -> None:
+        if not self._unchoked:
+            return  # spec: requests while choked are dropped
+        index, begin, length = struct.unpack(">III", payload)
+        if length > MAX_REQUEST_LENGTH:
+            raise PeerProtocolError(f"oversized block request: {length}")
+        store, _ = self._listener.snapshot()
+        block = store.read_block(index, begin, length) if store else None
+        if block is None:
+            return  # piece we don't have (yet): drop, remote retries elsewhere
+        # count before the send: a reader that saw the PIECE frame must
+        # also see it counted (the reverse order races observers)
+        self._listener.count_block(len(block))
+        self._send(MSG_PIECE, struct.pack(">II", index, begin) + block)
+
+    def _serve_extended(self, payload: bytes) -> None:
+        ext_id, body = payload[0], payload[1:]
+        if ext_id == 0:  # remote's extended handshake: learn their ids
+            try:
+                info = bencode.decode(body)
+            except bencode.BencodeError:
+                return
+            if isinstance(info, dict) and isinstance(info.get(b"m"), dict):
+                self._remote_ext = {
+                    k: v for k, v in info[b"m"].items() if isinstance(v, int)
+                }
+            return
+        if ext_id != UT_METADATA:
+            return
+        _, info_bytes = self._listener.snapshot()
+        remote_id = self._remote_ext.get(b"ut_metadata")
+        if info_bytes is None or not remote_id:
+            return
+        try:
+            request, _ = bencode._decode(body, 0)
+        except bencode.BencodeError:
+            return
+        if not isinstance(request, dict) or request.get(b"msg_type") != 0:
+            return
+        piece = request.get(b"piece")
+        if not isinstance(piece, int) or piece < 0:
+            return
+        start = piece * BLOCK_SIZE
+        chunk = info_bytes[start : start + BLOCK_SIZE]
+        header = bencode.encode(
+            {b"msg_type": 1, b"piece": piece, b"total_size": len(info_bytes)}
+        )
+        self._send(MSG_EXTENDED, bytes([remote_id]) + header + chunk)
+
+
+class PeerListener:
+    """The inbound half of the peer: a live TCP listener on the port the
+    trackers are told about.
+
+    The reference's anacrolix client is a full peer — it listens on its
+    announced port, serves REQUESTs, and reciprocates while leeching
+    (torrent.go:44). This class puts a real socket behind the announce:
+    constructed (bound) before the first announce so the advertised port
+    is live from the start, ``attach``-ed once metadata and the
+    PieceStore exist, closed when the job ends — optionally draining so
+    remote leechers mid-transfer can finish (two downloaders completing
+    a torrent from each other must not cut the slower one off when the
+    faster finishes).
+    """
+
+    def __init__(
+        self,
+        info_hash: bytes,
+        peer_id: bytes,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        max_inbound: int = 32,
+    ):
+        self.info_hash = info_hash
+        self.peer_id = peer_id
+        self._max_inbound = max_inbound
+        self._store: PieceStore | None = None
+        self._info_bytes: bytes | None = None
+        self._lock = threading.Lock()
+        self._conns: set[_InboundPeer] = set()
+        self._finished_leecher_ips: set[str] = set()
+        self._closed = False
+        self.blocks_served = 0
+        self.bytes_served = 0
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._sock.bind((host, port))
+            self._sock.listen(16)
+        except OSError:
+            self._sock.close()
+            raise
+        self.port = self._sock.getsockname()[1]
+        threading.Thread(
+            target=self._accept_loop,
+            daemon=True,
+            name=f"peer-listen-{self.port}",
+        ).start()
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                sock, addr = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            with self._lock:
+                if self._closed or len(self._conns) >= self._max_inbound:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    continue
+                conn = _InboundPeer(self, sock, addr)
+                self._conns.add(conn)
+            threading.Thread(
+                target=conn.run,
+                daemon=True,
+                name=f"peer-inbound-{addr[0]}:{addr[1]}",
+            ).start()
+
+    # -- serving state ---------------------------------------------------
+
+    def snapshot(self) -> tuple["PieceStore | None", bytes | None]:
+        with self._lock:
+            return self._store, self._info_bytes
+
+    def attach(self, store: PieceStore, info_bytes: bytes | None) -> None:
+        """Arm serving once metadata + store exist. Connections accepted
+        during the metadata/resume phase are caught up (HAVE frames +
+        deferred UNCHOKE); the store observer keeps every connection
+        fed with HAVE as new pieces complete."""
+        store.add_observer(self.notify_have)
+        with self._lock:
+            self._store = store
+            self._info_bytes = info_bytes
+            conns = list(self._conns)
+        have = [i for i, done in enumerate(store.have) if done]
+        for conn in conns:
+            conn.arm(have)
+
+    def notify_have(self, index: int) -> None:
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
+            conn.notify_have(index)
+
+    def count_block(self, size: int) -> None:
+        with self._lock:
+            self.blocks_served += 1
+            self.bytes_served += size
+
+    def discard(self, conn: _InboundPeer) -> None:
+        with self._lock:
+            self._conns.discard(conn)
+            if conn.ever_interested:
+                # a leecher that connected, leeched, and went away has
+                # had its chance — the drain in close() keys off this
+                # (sticky flag: a compliant client sends NOT_INTERESTED
+                # once complete, which must still count as served)
+                self._finished_leecher_ips.add(conn.addr[0])
+
+    def active_leechers(self) -> int:
+        with self._lock:
+            return sum(1 for conn in self._conns if conn.interested)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def close(
+        self,
+        drain_timeout: float = 0.0,
+        expected_leechers: "set[str] | frozenset[str]" = frozenset(),
+    ) -> None:
+        """Tear down; with ``drain_timeout`` > 0, keep accepting and
+        serving that long until every currently-interested remote AND
+        every ``expected_leechers`` ip (peers this job observed with
+        incomplete bitfields — they will want our pieces) has connected,
+        leeched, and disconnected. This is what lets two downloaders
+        complete a torrent from each other: the faster one must not
+        slam its listener shut before the slower one has caught up."""
+        if drain_timeout > 0:
+            deadline = time.monotonic() + drain_timeout
+            while time.monotonic() < deadline:
+                with self._lock:
+                    unserved = set(expected_leechers) - self._finished_leecher_ips
+                if not unserved and not self.active_leechers():
+                    break
+                time.sleep(0.05)
+        with self._lock:
+            if self._closed and self._sock.fileno() < 0:
+                return  # idempotent
+            self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
+            conn.close()
 
 
 # ---------------------------------------------------------------------------
@@ -673,6 +1139,10 @@ class SwarmDownloader:
         peer_id: bytes | None = None,
         dht_bootstrap: tuple[tuple[str, int], ...] | None = None,
         max_peer_connections: int = 4,
+        listen: bool = True,
+        listen_port: int = 0,
+        seed_drain_timeout: float = 10.0,
+        discovery_rounds: int = 4,
     ):
         self._job = job
         self._base_dir = base_dir
@@ -682,26 +1152,56 @@ class SwarmDownloader:
         # None = BEP 5 default routers; () disables DHT entirely
         self._dht_bootstrap = dht_bootstrap
         self._max_peer_connections = max(1, max_peer_connections)
+        self._listen = listen
+        self._listen_port = listen_port
+        self._seed_drain_timeout = seed_drain_timeout
+        self._discovery_rounds = max(1, discovery_rounds)
+        # populated by run(): the live announced port and upload stats
+        self.listen_port: int | None = None
+        self.blocks_served = 0
+        self.bytes_served = 0
 
     def _discover_peers(
-        self, left: int, token: CancelToken | None = None
+        self,
+        left: int,
+        token: CancelToken | None = None,
+        port: int = 6881,
+        allow_empty: bool = False,
+        event: str = "started",
     ) -> list[tuple[str, int]]:
         """Explicit x.pe hints first (they cost nothing), then every
         tracker — http(s) per BEP 3/23, udp per BEP 15 — and a DHT
         get_peers lookup (BEP 5) when the trackers yield nothing: x.pe
-        hints are unverified, so they must not suppress the lookup."""
+        hints are unverified, so they must not suppress the lookup.
+
+        ``port`` is the live listener port to advertise. With
+        ``allow_empty`` an empty swarm is returned as [] so the caller
+        can re-announce later — but only when at least one tracker
+        actually responded; a job whose every peer source is dead still
+        raises, keeping failure prompt and diagnosable."""
         peers: list[tuple[str, int]] = list(self._job.peer_hints)
-        tracker_answered = False
+        tracker_answered = False  # some tracker returned a non-empty swarm
+        tracker_responded = False  # some tracker answered at all
         errors: list[str] = []
 
         def one_announce(tracker: str) -> list[tuple[str, int]]:
             if tracker.startswith(("http://", "https://")):
                 return announce(
-                    tracker, self._job.info_hash, self._peer_id, left
+                    tracker,
+                    self._job.info_hash,
+                    self._peer_id,
+                    left,
+                    port=port,
+                    event=event,
                 )
             if tracker.startswith("udp://"):
                 return announce_udp(
-                    tracker, self._job.info_hash, self._peer_id, left
+                    tracker,
+                    self._job.info_hash,
+                    self._peer_id,
+                    left,
+                    port=port,
+                    event=event,
                 )
             raise TransferError("unsupported tracker scheme")
 
@@ -725,6 +1225,7 @@ class SwarmDownloader:
                     except TransferError as exc:
                         errors.append(f"{futures[future]}: {exc}")
                         continue
+                    tracker_responded = True
                     # any non-empty announce counts, even if it only
                     # repeats the x.pe hints — a tracker-confirmed peer
                     # is no reason to fall through to a DHT lookup
@@ -754,6 +1255,8 @@ class SwarmDownloader:
                 errors.append(str(exc))
 
         if not peers:
+            if allow_empty and tracker_responded:
+                return []  # live tracker, swarm just hasn't formed yet
             raise TransferError(
                 f"no peers from {len(self._job.trackers)} tracker(s), "
                 f"{len(self._job.peer_hints)} hint(s), or dht: "
@@ -762,19 +1265,57 @@ class SwarmDownloader:
         return peers
 
     def run(self, token: CancelToken, progress) -> None:
+        listener: PeerListener | None = None
+        if self._listen:
+            try:
+                listener = PeerListener(
+                    self._job.info_hash, self._peer_id, port=self._listen_port
+                )
+            except OSError as exc:
+                # cannot bind (port taken, exotic sandbox): leech-only
+                log.warning(f"peer listener disabled: {exc}")
+        completed = False
+        self._observed_leecher_ips: set[str] = set()
+        try:
+            self._run(token, progress, listener)
+            completed = True
+        finally:
+            if listener is not None:
+                # drain only after a successful download: a completed
+                # job lingers briefly so remote leechers (peers seen
+                # with incomplete bitfields) can finish pulling from us;
+                # failed/cancelled jobs tear down immediately
+                listener.close(
+                    drain_timeout=self._seed_drain_timeout
+                    if completed and not token.cancelled()
+                    else 0.0,
+                    expected_leechers=self._observed_leecher_ips,
+                )
+                self.blocks_served = listener.blocks_served
+                self.bytes_served = listener.bytes_served
+
+    def _run(
+        self, token: CancelToken, progress, listener: "PeerListener | None"
+    ) -> None:
         deadline = time.monotonic() + self._metadata_timeout
+        port = listener.port if listener is not None else 6881
+        self.listen_port = port
 
         info = self._job.info
         peers: list[tuple[str, int]] | None = None
         last_error: Exception | None = None
+        # "started" exactly once per job; every later announce is a
+        # regular re-announce (event="") per tracker semantics
+        announce_event = "started"
         if info is None:
-            peers = self._discover_peers(left=1, token=token)
+            peers = self._discover_peers(left=1, token=token, port=port)
+            announce_event = ""
             log.info("fetching torrent metadata")
-            for host, port in peers:
+            for host, peer_port in peers:
                 token.raise_if_cancelled()
                 try:
                     with PeerConnection(
-                        host, port, self._job.info_hash, self._peer_id, token
+                        host, peer_port, self._job.info_hash, self._peer_id, token
                     ) as conn:
                         info = fetch_metadata(conn, self._job.info_hash, deadline)
                         break
@@ -797,37 +1338,72 @@ class SwarmDownloader:
             progress(100.0)
             return
 
-        if peers is None:
-            peers = self._discover_peers(
-                left=store.total_length - store.bytes_completed(), token=token
-            )
+        if listener is not None:
+            # arm the serving side; metadata is served only if the
+            # canonical re-encoding reproduces the info-hash (a peer
+            # could have delivered non-canonical metadata bytes whose
+            # re-encoding would hash differently — serving those would
+            # poison downstream magnet bootstraps)
+            info_bytes = bencode.encode(info)
+            if hashlib.sha1(info_bytes).digest() != self._job.info_hash:
+                info_bytes = None
+            listener.attach(store, info_bytes)
 
         log.with_fields(
             pieces=store.num_pieces,
             total=store.total_length,
-            peers=len(peers),
         ).info("waiting for torrent download")
 
         swarm = _SwarmState(store, progress, self._progress_interval)
-        workers = [
-            threading.Thread(
-                target=self._peer_worker,
-                args=(swarm, token),
-                daemon=True,
-                name=f"peer-worker-{i}",
-            )
-            for i in range(min(self._max_peer_connections, len(peers)))
-        ]
-        for peer in peers:
-            swarm.peer_queue.append(peer)
-        for worker in workers:
-            worker.start()
-        for worker in workers:
-            # plain join is safe: each PeerConnection registers a cancel
-            # hook that closes its socket, so a cancel unblocks every
-            # worker promptly and they observe the token and exit
-            worker.join()
-        token.raise_if_cancelled()
+        # Re-announce loop: anacrolix keeps announcing on the tracker
+        # interval for the life of the client; this loop does the
+        # bounded-job version — when the current peers are exhausted but
+        # pieces remain, re-discover and retry. This is what lets two
+        # leechers bootstrap off each other: whichever announces first
+        # sees an empty swarm, and finds the other on the next round.
+        rounds = 0
+        while True:
+            if peers is None:
+                try:
+                    peers = self._discover_peers(
+                        left=store.total_length - store.bytes_completed(),
+                        token=token,
+                        port=port,
+                        allow_empty=True,
+                        event=announce_event,
+                    )
+                    announce_event = ""
+                except TransferError as exc:
+                    swarm.last_error = exc
+                    break  # every peer source is dead: fail now
+            for peer in peers:
+                if peer not in swarm.peer_queue:
+                    swarm.peer_queue.append(peer)
+            workers = [
+                threading.Thread(
+                    target=self._peer_worker,
+                    args=(swarm, token),
+                    daemon=True,
+                    name=f"peer-worker-{i}",
+                )
+                for i in range(min(self._max_peer_connections, len(swarm.peer_queue)))
+            ]
+            for worker in workers:
+                worker.start()
+            for worker in workers:
+                # plain join is safe: each PeerConnection registers a
+                # cancel hook that closes its socket, so a cancel
+                # unblocks every worker promptly and they exit
+                worker.join()
+            token.raise_if_cancelled()
+            if swarm.done():
+                break
+            rounds += 1
+            if rounds >= self._discovery_rounds:
+                break
+            time.sleep(min(0.2 * rounds, 1.0))
+            token.raise_if_cancelled()
+            peers = None  # re-announce next round
 
         if not all(store.have):
             missing = store.have.count(False)
@@ -848,7 +1424,18 @@ class SwarmDownloader:
                 with PeerConnection(
                     host, port, self._job.info_hash, self._peer_id, token
                 ) as conn:
-                    self._serve_pieces(conn, swarm, token)
+                    try:
+                        self._serve_pieces(conn, swarm, token)
+                    finally:
+                        # a peer whose bitfield is incomplete is a
+                        # leecher that will want our pieces; remember it
+                        # so the post-completion drain gives it time to
+                        # finish pulling from our listener
+                        num = swarm.store.num_pieces
+                        if conn.bitfield and not all(
+                            conn.has_piece(i) for i in range(num)
+                        ):
+                            self._observed_leecher_ips.add(host)
             except Cancelled:
                 return  # quiet exit; run() re-raises in the main thread
             except Exception as exc:

@@ -74,6 +74,73 @@ def make_torrent(
     return info, bencode.encode(meta), blob
 
 
+class SwarmTracker:
+    """Standalone HTTP tracker for multi-peer swarms: registers every
+    announcing peer (client IP + its announced port) and answers with
+    the rest of the swarm, compact form (BEP 23).
+
+    Unlike Seeder's built-in tracker — which always answers with the
+    seeder itself — this one knows only what peers announce, so a swarm
+    formed through it proves the announced ports are real, live
+    listeners (reference parity: anacrolix announces the port its
+    client actually serves on, torrent.go:44)."""
+
+    def __init__(self):
+        tracker = self
+        self.peers: dict[tuple[str, int], bool] = {}
+        self.announces: list[dict] = []
+        self._lock = threading.Lock()
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                query = dict(
+                    urllib.parse.parse_qsl(
+                        urllib.parse.urlparse(self.path).query,
+                        encoding="latin-1",
+                    )
+                )
+                ip = self.client_address[0]
+                try:
+                    port = int(query.get("port", "0"))
+                except ValueError:
+                    port = 0
+                with tracker._lock:
+                    if 0 < port < 65536:
+                        tracker.peers[(ip, port)] = True
+                    others = [p for p in tracker.peers if p != (ip, port)]
+                    tracker.announces.append(dict(query, _src=ip))
+                compact = b"".join(
+                    socket.inet_aton(host) + struct.pack(">H", peer_port)
+                    for host, peer_port in others
+                )
+                body = bencode.encode({b"interval": 1, b"peers": compact})
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}/announce"
+
+    def __enter__(self) -> "SwarmTracker":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
 class Seeder:
     """One-torrent seeder; ``endpoint`` properties expose the tracker URL
     and a magnet URI for the served torrent."""
@@ -85,6 +152,7 @@ class Seeder:
         piece_length: int = 32 * 1024,
         corrupt_pieces: tuple[int, ...] = (),
         serve_limit: int | None = None,
+        serve_delay: float = 0.0,
     ):
         self.info, self.metainfo, self.blob = make_torrent(name, data, piece_length)
         self.info_bytes = bencode.encode(self.info)
@@ -97,6 +165,10 @@ class Seeder:
         # die-mid-download fixture: drop the connection after this many
         # block requests, so tests can exercise unwinding paths
         self.serve_limit = serve_limit
+        # slow-seeder fixture: sleep this long before each block, so
+        # concurrency tests on a single-core box can't be won outright
+        # by whichever worker thread the GIL schedules first
+        self.serve_delay = serve_delay
 
         seeder = self
 
@@ -228,6 +300,10 @@ class Seeder:
                 self._send(sock, MSG_UNCHOKE)
             elif msg_id == MSG_REQUEST:
                 index, begin, want = struct.unpack(">III", payload)
+                if self.serve_delay:
+                    import time
+
+                    time.sleep(self.serve_delay)
                 if (
                     self.serve_limit is not None
                     and len(self.served_requests) >= self.serve_limit
