@@ -31,6 +31,7 @@ import hashlib
 import ipaddress
 import os
 import queue
+import random
 import secrets
 import socket
 import struct
@@ -336,7 +337,8 @@ class PeerConnection:
             raise PeerProtocolError("bad handshake protocol string")
         if reply[28:48] != self.info_hash:
             raise PeerProtocolError("peer served a different info-hash")
-        if reply[48:68] == peer_id:
+        self.remote_peer_id = reply[48:68]
+        if self.remote_peer_id == peer_id:
             # trackers echo our own announce back; a connection to our
             # own listener would idle-loop (we have nothing we need)
             raise PeerProtocolError("connected to ourselves")
@@ -760,6 +762,7 @@ class _InboundPeer:
         # sticky: drain accounting must still count a leecher that sent
         # NOT_INTERESTED when finished (spec-compliant behavior)
         self.ever_interested = False
+        self.remote_peer_id = b""  # set once the handshake arrives
         self._unchoked = False
         self._remote_ext: dict[bytes, int] = {}
         # nothing may be written before our handshake reply is on the
@@ -862,6 +865,7 @@ class _InboundPeer:
         hs = self._recv_exact(68)
         if hs[1:20] != HANDSHAKE_PSTR or hs[28:48] != self._listener.info_hash:
             return
+        self.remote_peer_id = hs[48:68]
         remote_supports_ext = bool(hs[25] & 0x10)
         reserved = bytearray(8)
         reserved[5] |= 0x10  # BEP 10
@@ -1004,7 +1008,7 @@ class PeerListener:
         self._info_bytes: bytes | None = None
         self._lock = threading.Lock()
         self._conns: set[_InboundPeer] = set()
-        self._finished_leecher_ips: set[str] = set()
+        self._finished_leecher_ids: set[bytes] = set()
         self._closed = False
         self.blocks_served = 0
         self.bytes_served = 0
@@ -1082,8 +1086,10 @@ class PeerListener:
                 # a leecher that connected, leeched, and went away has
                 # had its chance — the drain in close() keys off this
                 # (sticky flag: a compliant client sends NOT_INTERESTED
-                # once complete, which must still count as served)
-                self._finished_leecher_ips.add(conn.addr[0])
+                # once complete, which must still count as served).
+                # Keyed by peer_id, not ip: several leechers can sit
+                # behind one NAT/host and must be counted separately.
+                self._finished_leecher_ids.add(conn.remote_peer_id)
 
     def active_leechers(self) -> int:
         with self._lock:
@@ -1094,20 +1100,21 @@ class PeerListener:
     def close(
         self,
         drain_timeout: float = 0.0,
-        expected_leechers: "set[str] | frozenset[str]" = frozenset(),
+        expected_leechers: "set[bytes] | frozenset[bytes]" = frozenset(),
     ) -> None:
         """Tear down; with ``drain_timeout`` > 0, keep accepting and
         serving that long until every currently-interested remote AND
-        every ``expected_leechers`` ip (peers this job observed with
-        incomplete bitfields — they will want our pieces) has connected,
-        leeched, and disconnected. This is what lets two downloaders
-        complete a torrent from each other: the faster one must not
-        slam its listener shut before the slower one has caught up."""
+        every ``expected_leechers`` peer_id (peers this job observed
+        with incomplete bitfields — they will want our pieces) has
+        connected, leeched, and disconnected. This is what lets two
+        downloaders complete a torrent from each other: the faster one
+        must not slam its listener shut before the slower one has
+        caught up."""
         if drain_timeout > 0:
             deadline = time.monotonic() + drain_timeout
             while time.monotonic() < deadline:
                 with self._lock:
-                    unserved = set(expected_leechers) - self._finished_leecher_ips
+                    unserved = set(expected_leechers) - self._finished_leecher_ids
                 if not unserved and not self.active_leechers():
                     break
                 time.sleep(0.05)
@@ -1275,7 +1282,7 @@ class SwarmDownloader:
                 # cannot bind (port taken, exotic sandbox): leech-only
                 log.warning(f"peer listener disabled: {exc}")
         completed = False
-        self._observed_leecher_ips: set[str] = set()
+        self._observed_leecher_ids: set[bytes] = set()
         try:
             self._run(token, progress, listener)
             completed = True
@@ -1289,7 +1296,7 @@ class SwarmDownloader:
                     drain_timeout=self._seed_drain_timeout
                     if completed and not token.cancelled()
                     else 0.0,
-                    expected_leechers=self._observed_leecher_ips,
+                    expected_leechers=self._observed_leecher_ids,
                 )
                 self.blocks_served = listener.blocks_served
                 self.bytes_served = listener.bytes_served
@@ -1424,18 +1431,20 @@ class SwarmDownloader:
                 with PeerConnection(
                     host, port, self._job.info_hash, self._peer_id, token
                 ) as conn:
+                    swarm.register(conn)
                     try:
                         self._serve_pieces(conn, swarm, token)
                     finally:
+                        swarm.unregister(conn)
                         # a peer whose bitfield is incomplete is a
-                        # leecher that will want our pieces; remember it
-                        # so the post-completion drain gives it time to
-                        # finish pulling from our listener
+                        # leecher that will want our pieces; remember
+                        # its peer_id so the post-completion drain gives
+                        # it time to finish pulling from our listener
                         num = swarm.store.num_pieces
                         if conn.bitfield and not all(
                             conn.has_piece(i) for i in range(num)
                         ):
-                            self._observed_leecher_ips.add(host)
+                            self._observed_leecher_ids.add(conn.remote_peer_id)
             except Cancelled:
                 return  # quiet exit; run() re-raises in the main thread
             except Exception as exc:
@@ -1452,7 +1461,7 @@ class SwarmDownloader:
         self, conn: PeerConnection, swarm: "_SwarmState", token: CancelToken
     ) -> None:
         store = swarm.store
-        batch = _PieceBatch(swarm)
+        batch = _PieceBatch(swarm, owner=conn)
         conn.send_message(MSG_INTERESTED)
         while conn.choked:
             msg_id, _ = conn.read_message()
@@ -1490,7 +1499,27 @@ class SwarmDownloader:
                                 min(BLOCK_SIZE, size - begin),
                             ),
                         )
+                    abandoned = False
                     while len(blocks) < len(offsets):
+                        if store.have[index]:
+                            # endgame cancel-on-first-win: another
+                            # worker's duplicate of this piece verified
+                            # first; cancel what's still outstanding
+                            # and move on rather than finishing a
+                            # download nobody needs
+                            for begin in offsets:
+                                if begin not in blocks:
+                                    conn.send_message(
+                                        MSG_CANCEL,
+                                        struct.pack(
+                                            ">III",
+                                            index,
+                                            begin,
+                                            min(BLOCK_SIZE, size - begin),
+                                        ),
+                                    )
+                            abandoned = True
+                            break
                         msg_id, payload = conn.read_message()
                         if msg_id == MSG_CHOKE:
                             raise PeerProtocolError("peer choked mid-piece")
@@ -1499,11 +1528,19 @@ class SwarmDownloader:
                         got_index, begin = struct.unpack(">II", payload[:8])
                         if got_index == index:
                             blocks[begin] = payload[8:]
-                    batch.add(
-                        index, b"".join(blocks[b] for b in sorted(blocks))
-                    )
+                    if not abandoned:
+                        batch.add(
+                            index, b"".join(blocks[b] for b in sorted(blocks))
+                        )
+                        if swarm.endgame:
+                            # tail pieces settle immediately: batching an
+                            # endgame piece would delay the very win that
+                            # cancels the redundant downloads
+                            batch.flush()
                 except BaseException:
-                    swarm.release(index)  # let another worker/peer retry
+                    # our stake only: an endgame duplicate's failure must
+                    # not yank the original downloader's claim
+                    swarm.release(index, conn)
                     raise
                 swarm.tick_progress()
             # normal exit: settle the tail batch here, where a failed
@@ -1548,10 +1585,13 @@ class _PieceBatch:
         swarm: "_SwarmState",
         engine: DigestEngine | None = None,
         max_bytes: int = 8 * 1024 * 1024,
+        owner=None,
     ):
         self._swarm = swarm
         self._engine = engine or default_engine()
         self._max_bytes = max_bytes
+        # the conn whose claims these pieces ride on (release scoping)
+        self._owner = owner
         self._items: list[tuple[int, bytes]] = []
         self._bytes = 0
 
@@ -1577,9 +1617,10 @@ class _PieceBatch:
         bad: list[int] = []
         for (index, data), good in zip(items, verdicts):
             if good:
-                store.write_verified(index, data)
+                if not store.have[index]:  # endgame: a duplicate may have won
+                    store.write_verified(index, data)
             else:
-                self._swarm.release(index)
+                self._swarm.release(index, self._owner)
                 bad.append(index)
         if bad:
             raise PeerProtocolError(
@@ -1601,7 +1642,19 @@ class _SwarmState:
         # worker records the error that triggered the unwind, and the
         # job's failure message must keep both diagnostics
         self._errors: "collections.deque[Exception]" = collections.deque(maxlen=3)
-        self._claimed: set[int] = set()
+        # piece -> the conn that holds the original (exclusive) claim.
+        # Conn OBJECTS, not id(conn): holding the reference pins the
+        # object so a recycled id can never alias a dead connection's
+        # bookkeeping, and release() can tell an owner from a stranger.
+        self._claimed: dict[int, object] = {}
+        # endgame bookkeeping: piece -> conns already duplicating it, so
+        # one idle worker doesn't re-download the same in-flight piece
+        # in a tight loop
+        self._dup_claims: dict[int, set] = {}
+        self.endgame = False  # sticky; flips when the first dup is handed out
+        # connected peers' bitfields drive rarest-first availability
+        self._conns: set = set()
+        self._rng = random.Random()
         self._lock = threading.Lock()
         self._progress = progress
         self._progress_interval = progress_interval
@@ -1609,6 +1662,16 @@ class _SwarmState:
         # scan cursor: everything below it is permanently complete, so
         # claims stay O(total) over the torrent instead of O(n^2)
         self._scan_start = 0
+
+    def register(self, conn) -> None:
+        """Track a live connection; its (HAVE-updated) bitfield feeds
+        rarest-first availability ranking."""
+        with self._lock:
+            self._conns.add(conn)
+
+    def unregister(self, conn) -> None:
+        with self._lock:
+            self._conns.discard(conn)
 
     def done(self) -> bool:
         return all(self.store.have)
@@ -1631,11 +1694,22 @@ class _SwarmState:
             return self.peer_queue.pop(0) if self.peer_queue else None
 
     def claim(self, conn: PeerConnection):
-        """The lowest unclaimed missing piece this peer advertises.
-        Returns WAIT when missing pieces exist but every one is claimed
-        by another worker (the caller should hold the connection and
-        retry — a claim can come back via release()); None when the
-        torrent is done or this peer cannot provide anything missing."""
+        """The RAREST unclaimed missing piece this peer advertises
+        (availability ranked across registered connections' live
+        bitfields, ties broken randomly — anacrolix's selection order
+        behind DownloadAll, reference torrent.go:79; lowest-index
+        serialises real swarms on hot pieces).
+
+        Endgame: when every missing piece is already claimed, hand out
+        a DUPLICATE claim for an in-flight piece this peer has (each
+        conn at most once per piece) — first verified write wins and
+        the losers abandon via the store.have check in the download
+        loop. This is what keeps the tail from stalling behind one slow
+        peer. Returns WAIT when the peer could help later but not now;
+        None when the torrent is done or this peer has nothing useful.
+
+        O(pieces × conns) per claim; fine for the handful of
+        connections a job runs (reference effective concurrency is 1)."""
         store = self.store
         with self._lock:
             while self._scan_start < store.num_pieces and store.have[
@@ -1644,24 +1718,69 @@ class _SwarmState:
                 self._scan_start += 1
             if self._scan_start >= store.num_pieces:
                 return None  # torrent complete
-            worth_waiting = False
+            candidates: list[int] = []
+            in_flight: list[int] = []  # claimed by ANOTHER conn, missing, peer has
             for index in range(self._scan_start, store.num_pieces):
                 if store.have[index]:
+                    self._dup_claims.pop(index, None)
                     continue
                 peer_has = not conn.bitfield or conn.has_piece(index)
                 if index in self._claimed:
-                    # were this claim released, could this peer serve it?
-                    worth_waiting = worth_waiting or peer_has
+                    # never duplicate a piece this conn itself claimed:
+                    # its unflushed batch may already hold the bytes
+                    if peer_has and self._claimed[index] is not conn:
+                        in_flight.append(index)
                     continue
-                if not peer_has:
-                    continue  # peer lacks it; maybe the next one
-                self._claimed.add(index)
-                return index
-            return self.WAIT if worth_waiting else None
+                if peer_has:
+                    candidates.append(index)
 
-    def release(self, index: int) -> None:
+            def pick_rarest(indices: list[int]) -> int:
+                avail = {
+                    i: sum(
+                        1
+                        for c in self._conns
+                        if not c.bitfield or c.has_piece(i)
+                    )
+                    for i in indices
+                }
+                best = min(avail.values())
+                return self._rng.choice(
+                    [i for i in indices if avail[i] == best]
+                )
+
+            if candidates:
+                index = pick_rarest(candidates)
+                self._claimed[index] = conn
+                return index
+            # endgame: nothing unclaimed, but this peer could race an
+            # in-flight piece it hasn't already duplicated
+            fresh = [
+                i
+                for i in in_flight
+                if conn not in self._dup_claims.get(i, ())
+            ]
+            if fresh:
+                index = pick_rarest(fresh)
+                self._dup_claims.setdefault(index, set()).add(conn)
+                self.endgame = True
+                return index
+            return self.WAIT if in_flight else None
+
+    def release(self, index: int, owner=None) -> None:
+        """Give a claim back. With ``owner`` (the conn the claim was
+        handed to), only that conn's stake is released: a failed endgame
+        DUPLICATE clears its dup record — letting another conn race the
+        piece — without yanking the original downloader's still-active
+        claim out from under it. ``owner=None`` (direct callers, tests)
+        releases the original claim unconditionally."""
         with self._lock:
-            self._claimed.discard(index)
+            if owner is not None:
+                dups = self._dup_claims.get(index)
+                if dups is not None:
+                    dups.discard(owner)
+                if self._claimed.get(index) is not owner:
+                    return  # we only held (at most) a duplicate
+            self._claimed.pop(index, None)
 
     def tick_progress(self) -> None:
         store = self.store

@@ -547,10 +547,12 @@ class TestSwarmClaim:
     def test_wait_when_all_missing_pieces_claimed_elsewhere(self, tmp_path):
         swarm, store = self._swarm(tmp_path)
         full_peer = self.Conn()  # no bitfield => assume has everything
-        assert swarm.claim(full_peer) == 0
-        assert swarm.claim(full_peer) == 1
-        assert swarm.claim(full_peer) == 2
+        assert {swarm.claim(full_peer) for _ in range(3)} == {0, 1, 2}
+        # with every missing piece in flight, a late peer first races
+        # them as endgame duplicates (once per piece) ...
         late_peer = self.Conn()
+        assert {swarm.claim(late_peer) for _ in range(3)} == {0, 1, 2}
+        # ... and only parks in WAIT once it has duplicated everything
         assert swarm.claim(late_peer) is swarm.WAIT  # hold, don't drop
         swarm.release(1)
         assert swarm.claim(late_peer) == 1  # released claim picked up
@@ -927,8 +929,13 @@ class TestBatchVerifyFailure:
         info, _, data = make_torrent("b.bin", bytes(range(256)) * 512)
         store = PieceStore(info, str(tmp_path))
         swarm = _SwarmState(store, lambda p: None, 1.0)
-        for index in range(3):
-            assert swarm.claim(type("C", (), {"bitfield": None})()) == index
+        # claim everything (rarest-first breaks ties randomly, so order
+        # is not deterministic — the set is)
+        claimed = {
+            swarm.claim(type("C", (), {"bitfield": None})())
+            for _ in range(store.num_pieces)
+        }
+        assert claimed == set(range(store.num_pieces))
 
         batch = _PieceBatch(swarm)
         good0 = data[0:piece_length]
@@ -1216,3 +1223,118 @@ class TestInboundPeer:
             assert (d / "movie.mkv").read_bytes() == data
         # both sides actually served (mutual leeching, not one seeder)
         assert all(dl.blocks_served > 0 for dl in downloaders)
+
+
+def _bitfield(num_pieces: int, indices) -> bytes:
+    field = bytearray((num_pieces + 7) // 8)
+    for i in indices:
+        field[i // 8] |= 0x80 >> (i % 8)
+    return bytes(field)
+
+
+class _StubConn:
+    """Duck-typed stand-in for PeerConnection in claim() unit tests."""
+
+    def __init__(self, num_pieces: int, indices):
+        self.bitfield = _bitfield(num_pieces, indices)
+
+    def has_piece(self, index: int) -> bool:
+        byte_index, bit = divmod(index, 8)
+        return bool(self.bitfield[byte_index] & (0x80 >> bit))
+
+
+class TestPieceSelection:
+    """Rarest-first + endgame (round-4 verdict #2): claim order follows
+    availability across connected peers' bitfields, and the tail never
+    stalls behind one slow peer."""
+
+    def _swarm(self, tmp_path, pieces=6):
+        info, _, _ = make_torrent("r.bin", b"Z" * (pieces * 32 * 1024))
+        store = PieceStore(info, str(tmp_path))
+        assert store.num_pieces == pieces
+        from downloader_tpu.fetch.peer import _SwarmState
+
+        return _SwarmState(store, lambda p: None, 1.0), store
+
+    def test_claim_follows_rarity(self, tmp_path):
+        swarm, store = self._swarm(tmp_path)
+        n = store.num_pieces
+        seeder = _StubConn(n, range(n))  # has everything
+        common = _StubConn(n, [0, 1, 2, 3])  # the "hot" pieces
+        common2 = _StubConn(n, [0, 1, 2, 3])
+        for conn in (seeder, common, common2):
+            swarm.register(conn)
+        # availability: pieces 0-3 -> 3 peers, pieces 4,5 -> 1 peer.
+        # the seeder must be asked for the rare pieces FIRST.
+        first, second = swarm.claim(seeder), swarm.claim(seeder)
+        assert {first, second} == {4, 5}
+        # only common pieces remain; any of 0-3 is acceptable now
+        assert swarm.claim(seeder) in {0, 1, 2, 3}
+
+    def test_rarity_tracks_have_updates(self, tmp_path):
+        """A HAVE folded into a registered conn's bitfield changes the
+        ranking live: a piece everyone just acquired stops being rare."""
+        swarm, store = self._swarm(tmp_path)
+        n = store.num_pieces
+        seeder = _StubConn(n, range(n))
+        leecher = _StubConn(n, [])
+        swarm.register(seeder)
+        swarm.register(leecher)
+        # piece 5 becomes common (both peers have it); 0-4 stay rare
+        leecher.bitfield = _bitfield(n, [5])
+        assert swarm.claim(seeder) != 5
+
+    def test_endgame_duplicates_in_flight_piece(self, tmp_path):
+        swarm, store = self._swarm(tmp_path, pieces=2)
+        a = _StubConn(2, [0, 1])
+        b = _StubConn(2, [0, 1])
+        swarm.register(a)
+        swarm.register(b)
+        first = swarm.claim(a)
+        second = swarm.claim(a)
+        assert {first, second} == {0, 1}
+        # all pieces in flight: b gets a DUPLICATE claim, not WAIT
+        dup = swarm.claim(b)
+        assert dup in {0, 1}
+        assert swarm.endgame
+        # ... but b never gets the same duplicate twice; with both
+        # pieces duped it parks in WAIT
+        dup2 = swarm.claim(b)
+        assert dup2 in ({0, 1} - {dup})
+        assert swarm.claim(b) is swarm.WAIT
+
+    def test_tail_stall_completes_fast(self, tmp_path):
+        """A slow peer grinding on the last piece must not gate the job:
+        an endgame duplicate from the fast peer wins, and the slow
+        worker abandons via cancel-on-first-win."""
+        import time as time_mod
+
+        data = bytes(range(256)) * 1024  # 256 KiB => 8 pieces of 32 KiB
+        # slow seeder: 0.5 s per block => 1.0 s per 2-block piece;
+        # serial completion through it would take ~2 s+ for its share
+        with Seeder("movie.mkv", data, serve_delay=0.5) as slow:
+            with Seeder("movie.mkv", data) as fast:
+                with FakeUDPTracker(
+                    [slow.peer_address, fast.peer_address]
+                ) as tracker:
+                    magnet = (
+                        f"magnet:?xt=urn:btih:{slow.info_hash.hex()}"
+                        f"&tr={tracker.url}"
+                    )
+                    start = time_mod.monotonic()
+                    TorrentBackend(
+                        progress_interval=0.01, dht_bootstrap=()
+                    ).download(
+                        CancelToken(), str(tmp_path), lambda u, p: None, magnet
+                    )
+                    elapsed = time_mod.monotonic() - start
+                # the duplicate actually raced: some piece was requested
+                # from BOTH peers
+                overlap = set(slow.served_requests) & set(fast.served_requests)
+                assert overlap, "no endgame duplication happened"
+        assert (tmp_path / "movie.mkv").read_bytes() == data
+        # generous bound (loaded single-core box): the real regression
+        # signal is the overlap assert above — without endgame no piece
+        # is ever requested from both peers; the time bound only guards
+        # against gross serial grinding through the slow peer
+        assert elapsed < 3.0, f"tail stalled: {elapsed:.1f}s"
