@@ -28,7 +28,20 @@ both configurations at the disk's speed, hiding the framework entirely.
 Set BENCH_DIR to force a location (e.g. a real disk to measure that).
 
 Env knobs: BENCH_JOBS (default 16), BENCH_MB (MB per job, default 32),
-BENCH_CONCURRENCY (default 6), BENCH_DIR (default /dev/shm if present).
+BENCH_CONCURRENCY (default 6), BENCH_SLICES (alternating sub-runs per
+pair, default 4), BENCH_REPEATS (pairs, default 5), BENCH_DIR (default
+/dev/shm if present).
+
+On the measurement noise: this box's absolute throughput swings ~3x on
+multi-second timescales (the same configuration has measured 85 and 580
+MB/s minutes apart). The swings hit baseline and framework runs alike —
+round 3's "framework collapse" to 81.9 MB/s has baseline twins (85.0
+MB/s in a round-4 calibration run) and both configurations share the
+publish-confirm path, so a confirm stall is ruled out as the cause; the
+noise is environmental (shared host). The defense is structural:
+alternate sub-runs so bursts land on both configs, take per-pair
+ratios so shared noise cancels, and take the median so one unlucky
+pair cannot set the contract number.
 """
 
 from __future__ import annotations
@@ -262,9 +275,10 @@ def run_config(
     prefetch: int,
     site: str,
     zero_copy: bool = True,
-) -> float:
+) -> tuple[float, float]:
     """Drain ``jobs`` download jobs through the full daemon pipeline;
-    returns MB/s end-to-end (first enqueue → last Convert consumed)."""
+    returns (MB moved, seconds) end-to-end (first enqueue → last
+    Convert consumed) so callers can aggregate across runs."""
     pipeline = _Pipeline(concurrency, prefetch, site, zero_copy=zero_copy)
     try:
         start = time.monotonic()
@@ -272,7 +286,7 @@ def run_config(
             pipeline.publish_job(i)
         pipeline.wait_converts(jobs)
         elapsed = time.monotonic() - start
-        return jobs * mb_per_job / elapsed
+        return jobs * mb_per_job, elapsed
     finally:
         pipeline.close()
 
@@ -310,34 +324,60 @@ def main() -> None:
             for _ in range(mb_per_job):
                 sink.write(chunk)
 
-        repeats = max(1, int(os.environ.get("BENCH_REPEATS", 3)))
-        _log(f"bench: {jobs} jobs x {mb_per_job} MB, best of {repeats}")
+        repeats = max(1, int(os.environ.get("BENCH_REPEATS", 5)))
+        _log(f"bench: {jobs} jobs x {mb_per_job} MB, {repeats} interleaved pairs")
         # the baseline emulates the REFERENCE's shape on this machine:
         # concurrency 1 + prefetch 1 (cmd/downloader/downloader.go:62,
         # 100-103) AND userspace copy loops (Go grab/minio stream through
         # io.Copy; they have no splice/sendfile path)
         #
-        # INTERLEAVED baseline/framework runs, best-of-N each: this box is
-        # a 1-vCPU VM with noisy-neighbor swings (same config measured 2x
-        # apart minutes apart); interleaving puts both configurations in
-        # the same noise regime so the ratio converges even when the
-        # absolute numbers wander
-        baseline_runs: list[float] = []
-        framework_runs: list[float] = []
+        # INTERLEAVED baseline/framework PAIRS, median of per-pair
+        # ratios: this box is a 1-vCPU VM with noisy-neighbor swings
+        # (same config measured 2x apart minutes apart). Back-to-back
+        # pairing puts both configurations in the same noise regime, the
+        # per-pair ratio cancels the shared noise, and the MEDIAN keeps
+        # one outlier pair from deciding the contract number — round 3's
+        # max/max aggregation recorded 0.69 from runs whose paired
+        # ratios read 1.19/0.34/1.18.
+        #
+        # Each pair is further SLICED into alternating
+        # baseline/framework sub-runs (B F B F ...) whose MB and seconds
+        # are summed per config: a multi-second noise burst then lands
+        # on sub-runs of BOTH configs instead of deciding one side of
+        # the ratio wholesale.
+        slices = max(1, int(os.environ.get("BENCH_SLICES", 4)))
+        jobs_per_slice = max(concurrency, jobs // slices)
+        pairs: list[tuple[float, float]] = []
         for i in range(repeats):
-            baseline_runs.append(
-                run_config(jobs, mb_per_job, 1, 1, site, zero_copy=False)
+            mb = {"b": 0.0, "f": 0.0}
+            secs = {"b": 0.0, "f": 0.0}
+            for _ in range(slices):
+                moved, took = run_config(
+                    jobs_per_slice, mb_per_job, 1, 1, site, zero_copy=False
+                )
+                mb["b"] += moved
+                secs["b"] += took
+                moved, took = run_config(
+                    jobs_per_slice, mb_per_job, concurrency, concurrency, site
+                )
+                mb["f"] += moved
+                secs["f"] += took
+            base = mb["b"] / secs["b"]
+            frame = mb["f"] / secs["f"]
+            pairs.append((base, frame))
+            _log(
+                f"bench: pair {i + 1}: baseline {base:.1f} MB/s, "
+                f"framework {frame:.1f} MB/s, ratio {frame / base:.2f}"
             )
-            _log(f"bench: baseline run {i + 1}: {baseline_runs[-1]:.1f} MB/s")
-            framework_runs.append(
-                run_config(jobs, mb_per_job, concurrency, concurrency, site)
-            )
-            _log(f"bench: framework run {i + 1}: {framework_runs[-1]:.1f} MB/s")
-        baseline = max(baseline_runs)
-        value = max(framework_runs)
+        ratios = sorted(frame / base for base, frame in pairs)
+        vs_baseline = ratios[len(ratios) // 2]
+        baseline = sorted(base for base, _ in pairs)[len(pairs) // 2]
+        value = sorted(frame for _, frame in pairs)[len(pairs) // 2]
         _log(
-            f"bench: baseline {baseline:.1f} MB/s (concurrency 1, userspace), "
-            f"framework {value:.1f} MB/s (concurrency {concurrency}, zero-copy)"
+            f"bench: baseline {baseline:.1f} MB/s median (concurrency 1, "
+            f"userspace), framework {value:.1f} MB/s median (concurrency "
+            f"{concurrency}, zero-copy), per-pair ratios "
+            f"{[round(r, 2) for r in ratios]} -> vs_baseline {vs_baseline:.2f}"
         )
 
         latency_samples = max(3, int(os.environ.get("BENCH_LATENCY_SAMPLES", 15)))
@@ -353,7 +393,18 @@ def main() -> None:
                 "metric": "job_overhead_latency_ms",
                 "value": round(latency_ms, 1),
                 "unit": "ms",
-            }
+            },
+            {
+                # per-pair evidence for the contract number: one noisy
+                # pair must be visible, not silently folded in
+                "metric": "throughput_pairs",
+                "unit": "MB/s",
+                "pairs": [
+                    {"baseline": round(b, 1), "framework": round(f, 1),
+                     "ratio": round(f / b, 2)}
+                    for b, f in pairs
+                ],
+            },
         ]
         if os.environ.get("BENCH_DIGEST", "1") != "0":
             _log("bench: digest kernel micro-benchmark (pallas vs hashlib)")
@@ -378,7 +429,7 @@ def main() -> None:
                     "metric": "e2e_fetch_upload_MBps",
                     "value": round(value, 1),
                     "unit": "MB/s",
-                    "vs_baseline": round(value / baseline, 2),
+                    "vs_baseline": round(vs_baseline, 2),
                     "extra_metrics": extra_metrics,
                 }
             )
