@@ -27,7 +27,7 @@ VM-backed disks writeback throttling (~200 MB/s here) otherwise floors
 both configurations at the disk's speed, hiding the framework entirely.
 Set BENCH_DIR to force a location (e.g. a real disk to measure that).
 
-Env knobs: BENCH_JOBS (default 16), BENCH_MB (MB per job, default 32),
+Env knobs: BENCH_JOBS (default 24), BENCH_MB (MB per job, default 32),
 BENCH_CONCURRENCY (default 6), BENCH_SLICES (alternating sub-runs per
 pair, default 4), BENCH_REPEATS (pairs, default 5), BENCH_DIR (default
 /dev/shm if present).
@@ -312,7 +312,7 @@ def run_latency(site: str, samples: int, concurrency: int) -> float:
 
 
 def main() -> None:
-    jobs = int(os.environ.get("BENCH_JOBS", 16))
+    jobs = int(os.environ.get("BENCH_JOBS", 24))
     mb_per_job = int(os.environ.get("BENCH_MB", 32))
     concurrency = int(os.environ.get("BENCH_CONCURRENCY", 6))
 
@@ -325,7 +325,6 @@ def main() -> None:
                 sink.write(chunk)
 
         repeats = max(1, int(os.environ.get("BENCH_REPEATS", 5)))
-        _log(f"bench: {jobs} jobs x {mb_per_job} MB, {repeats} interleaved pairs")
         # the baseline emulates the REFERENCE's shape on this machine:
         # concurrency 1 + prefetch 1 (cmd/downloader/downloader.go:62,
         # 100-103) AND userspace copy loops (Go grab/minio stream through
@@ -346,7 +345,18 @@ def main() -> None:
         # on sub-runs of BOTH configs instead of deciding one side of
         # the ratio wholesale.
         slices = max(1, int(os.environ.get("BENCH_SLICES", 4)))
-        jobs_per_slice = max(concurrency, jobs // slices)
+        # never inflate or truncate the requested workload: shrink the
+        # slice count instead when BENCH_JOBS can't fill the slices
+        # with at least one full concurrency wave each
+        if jobs >= concurrency:
+            slices = max(1, min(slices, jobs // concurrency))
+        else:
+            slices = 1
+        jobs_per_slice = jobs // slices
+        _log(
+            f"bench: {repeats} pairs x {slices} alternating slices x "
+            f"{jobs_per_slice} jobs x {mb_per_job} MB per config"
+        )
         pairs: list[tuple[float, float]] = []
         for i in range(repeats):
             mb = {"b": 0.0, "f": 0.0}

@@ -65,12 +65,47 @@ def measure(
         hashlib_bps, transfer_bps, sync_s = engine._calibrate()
         result["transfer_MBps"] = round(transfer_bps / 1e6, 1)
         result["sync_ms"] = round(sync_s * 1e3, 1)
-        result["offload_wins_streaming"] = engine._worth_offloading(
-            total_bytes
-        )
+        result["offload_wins_streaming"] = engine._worth_offloading(pieces)
 
         if device.platform == "tpu":
             from downloader_tpu.parallel.sha1_pallas import sha1_tiled
+
+            # full-batch correctness gate BEFORE any timing: a kernel
+            # that disagrees with hashlib anywhere — including the
+            # ragged final lane and lanes beyond tile 0, which a
+            # spot-check of got[0] would never see — must not get a
+            # throughput number printed for it. Cheap shapes: 1030
+            # pieces forces a second (ragged) tile, the short tail
+            # piece exercises the mask path, and the empty piece the
+            # degenerate single-pad-block path.
+            check_pieces = (
+                [rng.bytes(4096) for _ in range(1029)]
+                + [rng.bytes(1000), b""]
+            )
+            check_blocks, check_nblocks = pack_pieces_tiled(check_pieces)
+            check_out = np.asarray(
+                sha1_tiled(
+                    jax.device_put(check_blocks, device),
+                    jax.device_put(check_nblocks, device),
+                )
+            )
+            check_got = digests_from_tiled(check_out, len(check_pieces))
+            mismatches = sum(
+                got_digest != hashlib.sha1(piece).digest()
+                for got_digest, piece in zip(check_got, check_pieces)
+            )
+            if mismatches:
+                # a wrong-answer kernel is NOT "device unavailable":
+                # record it distinctly, refuse the number, keep going
+                # so the caller sees the evidence in the metrics line
+                result["pallas_digest_mismatches"] = mismatches
+                result["pallas_GBps"] = None
+                _log(
+                    "bench_digest: KERNEL VALIDATION FAILED: "
+                    f"{mismatches}/{len(check_pieces)} digests wrong; "
+                    "refusing to time a broken kernel"
+                )
+                return result
 
             blocks, nblocks = pack_pieces_tiled(pieces)
             _log(
@@ -81,9 +116,20 @@ def measure(
             nblocks_d = jax.device_put(nblocks, device)
             out = np.asarray(sha1_tiled(blocks_d, nblocks_d))  # compile
             got = digests_from_tiled(out, len(pieces))
-            want = hashlib.sha1(pieces[0]).digest()
-            if got[0] != want:
-                raise RuntimeError("pallas digest mismatch vs hashlib")
+            # the timing batch itself must also be fully right
+            bad = sum(
+                got_digest != hashlib.sha1(piece).digest()
+                for got_digest, piece in zip(got, pieces)
+            )
+            if bad:
+                result["pallas_digest_mismatches"] = bad
+                result["pallas_GBps"] = None
+                _log(
+                    "bench_digest: KERNEL VALIDATION FAILED on the "
+                    f"timing batch: {bad} lanes wrong; refusing to "
+                    "time a broken kernel"
+                )
+                return result
             # per-call dispatch/sync overhead is large and noisy on a
             # tunneled dev chip (70-300 ms); differencing a 1-block run
             # of the same kernel cancels it exactly instead of
@@ -118,10 +164,15 @@ def measure(
 
 
 def main() -> None:
+    broken = False
     for piece_kb, batch in ((256, 1024), (256, 128), (16, 1024)):
         metrics = measure(piece_kb, batch)
         if metrics is not None:
             print(json.dumps({"metric": "digest_kernel", **metrics}))
+            broken = broken or bool(metrics.get("pallas_digest_mismatches"))
+    if broken:
+        # a wrong-answer kernel must not look like a clean run to CI
+        sys.exit(1)
 
 
 if __name__ == "__main__":

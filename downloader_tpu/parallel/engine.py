@@ -13,13 +13,17 @@ Policy lives here, math lives in sha1.py / sha1_pallas.py / mesh.py:
   wins — measured, r2); on a TPU VM with local PCIe/DMA the same probe
   picks a real threshold. ``hashlib``/``jax``/``pallas`` force a path.
 - **Kernel choice.** On a TPU platform the device path is the Pallas
-  kernel (sha1_pallas.py, ~70 GB/s on-chip on v5e vs ~1.4 GB/s
-  hashlib); elsewhere (CPU mesh tests, multi-device dryrun) it is the
-  XLA scan kernel, sharded via shard_map + psum when the mesh has more
-  than one device (parallel/mesh.py).
-- **Shape bucketing.** Piece counts (and the Pallas kernel's block
-  axis) are padded up to powers of two so repeated batches reuse the
-  compiled executable instead of re-tracing per torrent.
+  kernel (sha1_pallas.py; measured 49.1 GB/s device-resident in round
+  2 — BENCH_r02.json — and below timer resolution behind the dev
+  tunnel since, vs ~1.4 GB/s single-thread hashlib on this host);
+  elsewhere (CPU mesh tests, multi-device dryrun) it is the XLA scan
+  kernel, sharded via shard_map + psum when the mesh has more than one
+  device (parallel/mesh.py).
+- **Shape bucketing.** Piece counts are padded up to powers of two
+  (times the mesh size) and the Pallas kernel's block axis to the
+  smallest of {2^k, 2^k+1} — power-of-two piece sizes pad to 2^j+1
+  SHA-1 blocks, which plain pow2 would double — so repeated batches
+  reuse the compiled executable instead of re-tracing per torrent.
 
 The pipeline's callers are fetch/peer.py (resume re-verification of
 on-disk pieces and batched live verification) and fetch/seeder.py
@@ -58,6 +62,20 @@ def _next_pow2(n: int) -> int:
     return power
 
 
+def _block_bucket(n: int) -> int:
+    """Block-axis compile bucket: the smallest of {2^k, 2^k + 1} ≥ n.
+
+    Plain pow2 bucketing nearly DOUBLES the shipped array for the
+    dominant case: piece sizes are powers of two, so their SHA-1 block
+    counts are 2^j + 1 (the Merkle–Damgård pad block), which pow2 would
+    round to 2^(j+1). Admitting 2^k + 1 buckets keeps that case exact
+    while still bounding distinct compiled shapes to O(log B).
+    """
+    power = _next_pow2(n)
+    half_plus = power // 2 + 1
+    return half_plus if n <= half_plus else power
+
+
 class DigestEngine:
     """Batched SHA-1 with automatic accelerator offload."""
 
@@ -77,8 +95,14 @@ class DigestEngine:
         self._jax_failed = False
         self._pallas_fn = None  # lazily built tiled digest fn
         self._pallas_failed = False
-        # (hashlib_Bps, transfer_Bps, sync_s) measured once; None = not yet
+        # (hashlib_Bps, transfer_Bps, sync_s) measured once; None = not yet.
+        # A dedicated lock held across the WHOLE measurement: N swarm
+        # workers hitting first-flush concurrently must not each pay the
+        # multi-MB probe (they'd serialize on device_put anyway)
+        self._calibrate_lock = threading.Lock()
         self._calibration: tuple[float, float, float] | None = None
+        # None = topology not probed yet (see _tiled_layout)
+        self._tiled_possible: bool | None = None
 
     # -- backend plumbing ------------------------------------------------
 
@@ -152,11 +176,12 @@ class DigestEngine:
 
                 def fn(pieces: Sequence[bytes]) -> list[bytes]:
                     blocks, nblocks = pack_pieces_tiled(pieces)
-                    # bucket the block axis to a power of two so repeat
-                    # batches reuse the compiled executable; the padding
-                    # blocks are masked off by nblocks
+                    # bucket the block axis ({2^k, 2^k+1} — see
+                    # _block_bucket) so repeat batches reuse the
+                    # compiled executable; padding blocks are masked
+                    # off by nblocks
                     have = blocks.shape[1]
-                    want = _next_pow2(have)
+                    want = _block_bucket(have)
                     if want != have:
                         blocks = np.pad(
                             blocks,
@@ -185,6 +210,22 @@ class DigestEngine:
         never win — guessing either way ships the wrong default."""
         if self._calibration is not None:
             return self._calibration
+        with self._calibrate_lock:
+            if self._calibration is not None:
+                return self._calibration
+            calibration = self._measure_calibration()
+            log.with_fields(
+                hashlib_MBps=round(calibration[0] / 1e6),
+                transfer_MBps=round(calibration[1] / 1e6),
+                sync_ms=round(calibration[2] * 1e3, 1),
+            ).info("digest offload calibration")
+            # publish only after the full measurement so concurrent
+            # callers either see None (and wait on the lock) or the
+            # finished numbers — never a half-made calibration
+            self._calibration = calibration
+        return self._calibration
+
+    def _measure_calibration(self) -> tuple[float, float, float]:
         probe = os.urandom(_CALIBRATE_BYTES)
         start = time.monotonic()
         hashlib.sha1(probe).digest()
@@ -210,30 +251,70 @@ class DigestEngine:
             transfer_bps = _CALIBRATE_BYTES / max(elapsed - sync_s, 1e-9)
         except Exception as exc:  # pragma: no cover - env-dependent
             log.debug(f"digest offload calibration failed ({exc})")
-        with self._lock:
-            if self._calibration is None:
-                self._calibration = (hashlib_bps, transfer_bps, sync_s)
-                log.with_fields(
-                    hashlib_MBps=round(hashlib_bps / 1e6),
-                    transfer_MBps=round(transfer_bps / 1e6),
-                    sync_ms=round(sync_s * 1e3, 1),
-                ).info("digest offload calibration")
-        return self._calibration
+        return (hashlib_bps, transfer_bps, sync_s)
 
-    def _worth_offloading(self, total_bytes: int) -> bool:
+    def _tiled_layout(self) -> bool:
+        """Whether the pallas tiled layout is the one that would ship.
+        Decided from the device topology (exactly one TPU device), NOT
+        from ``_pallas_failed``: that flag only flips inside _pallas(),
+        which _use_device calls after the cost model passes — gating
+        the cost model on it would deadlock the policy on hosts where
+        pallas can never build (e.g. a multi-device mesh)."""
+        if self._backend == "jax" or self._pallas_failed:
+            return False
+        if self._tiled_possible is None:
+            try:
+                import jax
+
+                devices = self._devices or jax.devices()
+                self._tiled_possible = (
+                    len(devices) == 1 and devices[0].platform == "tpu"
+                )
+            except Exception:  # pragma: no cover - env-dependent
+                self._tiled_possible = False
+        return self._tiled_possible
+
+    def _shipped_bytes(self, pieces: Sequence[bytes]) -> int:
+        """The byte count the device transfer will ACTUALLY move for
+        this batch — the padded/tiled array, not the raw piece bytes.
+        The tiled layout pads the lane axis to whole 1024-piece tiles
+        and every lane to the bucketed max block count, so a batch of
+        many short pieces (or one long straggler) ships far more than
+        ``sum(len(p))``; pricing raw bytes underestimated the transfer
+        ~64x in the worst case (round-2/3 advisor finding)."""
+        from .pack import TILE, block_count
+
+        count = len(pieces)
+        max_blocks = max((block_count(len(p)) for p in pieces), default=1)
+        if self._tiled_layout():
+            # pallas tiled layout: (T, B, 16, 8, 128) uint32
+            tiles = max(1, -(-count // TILE))
+            return tiles * TILE * _block_bucket(max_blocks) * 64
+        # XLA layout: (P_padded, B, 16) uint32. pad_to is the mesh size
+        # once built; before that assume 1 (an underestimate of at most
+        # mesh_size/count, and the probe path is CPU-local anyway).
+        pad_to = self._jax_state[0] if self._jax_state is not None else 1
+        padded_count = pad_to * _next_pow2(-(-count // pad_to))
+        return padded_count * max_blocks * 64
+
+    def _worth_offloading(self, pieces: Sequence[bytes]) -> bool:
         """True when shipping the batch to the device beats hashing it
-        on the host: bytes/hashlib > bytes/transfer + sync (on-chip
-        compute, ~70 GB/s measured, is negligible next to either)."""
+        on the host: raw_bytes/hashlib > shipped_bytes/transfer + sync.
+        Hash time scales with the RAW bytes; transfer time scales with
+        the padded SHIPPED bytes. On-chip compute is ignored — orders
+        of magnitude faster than either per the round-2 device-resident
+        measurement (49 GB/s, BENCH_r02.json)."""
         mode = os.environ.get("DIGEST_OFFLOAD", "auto")
         if mode == "always":
             return True
         if mode == "never":
             return False
         hashlib_bps, transfer_bps, sync_s = self._calibrate()
-        if transfer_bps <= hashlib_bps:
+        if transfer_bps <= 0:
             return False
-        saved = total_bytes * (1.0 / hashlib_bps - 1.0 / transfer_bps)
-        return saved > sync_s
+        hash_s = sum(len(p) for p in pieces) / hashlib_bps
+        ship_s = self._shipped_bytes(pieces) / transfer_bps
+        return hash_s > ship_s + sync_s
 
     def _use_device(self, pieces: Sequence[bytes]) -> bool:
         if self._backend == "hashlib":
@@ -242,7 +323,7 @@ class DigestEngine:
             return True  # forced
         if len(pieces) < self._min_batch:
             return False
-        if not self._worth_offloading(sum(len(p) for p in pieces)):
+        if not self._worth_offloading(pieces):
             return False
         return self._pallas() is not None or self._jax() is not None
 

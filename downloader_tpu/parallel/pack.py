@@ -21,10 +21,17 @@ from typing import Sequence
 import numpy as np
 
 
+def block_count(length: int) -> int:
+    """SHA-1 block count for a message of ``length`` bytes after FIPS
+    180-4 padding. The single source of truth — the engine's offload
+    cost model prices shipped arrays with this same formula."""
+    return (length + 9 + 63) // 64
+
+
 def pad_piece(piece: bytes) -> np.ndarray:
     """Pad one message per FIPS 180-4 → (B, 16) big-endian uint32 words."""
     length = len(piece)
-    num_blocks = (length + 9 + 63) // 64
+    num_blocks = block_count(length)
     buf = np.zeros(num_blocks * 64, dtype=np.uint8)
     buf[:length] = np.frombuffer(piece, dtype=np.uint8)
     buf[length] = 0x80

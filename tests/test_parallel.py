@@ -214,33 +214,52 @@ class TestPallasKernel:
         assert TILE == 1024
 
 
+class _Sized:
+    """A length without the bytes: lets policy tests price terabyte
+    batches without allocating them (only len() is consulted)."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+
 class TestOffloadPolicy:
     """auto offload is decided by measured rates, not guesses: the
-    device must win bytes/hashlib > bytes/transfer + sync."""
+    device must win raw_bytes/hashlib > SHIPPED_bytes/transfer + sync,
+    where shipped is the padded tiled array actually moved."""
 
     def _engine(self, hashlib_bps, transfer_bps, sync_s):
         engine = DigestEngine(backend="auto", min_batch=1)
         engine._calibration = (hashlib_bps, transfer_bps, sync_s)
+        # pin the single-TPU tiled layout so pricing is deterministic
+        # regardless of this test host's (8-CPU virtual) topology
+        engine._tiled_possible = True
         return engine
 
     def test_slow_tunnel_never_offloads(self):
         # measured shape of the tunneled dev chip: 25 MB/s H2D vs
         # 1.4 GB/s hashlib — offload can never win
         engine = self._engine(1.4e9, 25e6, 0.067)
-        assert not engine._worth_offloading(1 << 40)
+        assert not engine._worth_offloading([_Sized(1 << 30)] * 1024)
 
-    def test_fast_link_offloads_past_breakeven(self):
-        # TPU-VM shape: 10 GB/s DMA, 5 ms sync → break-even ≈ 8 MB
+    def test_fast_link_offloads_dense_tile_only(self):
+        # TPU-VM shape: 10 GB/s DMA, 5 ms sync. A full 1024-lane tile
+        # of equal pieces ships ~its raw size and wins ...
         engine = self._engine(1.4e9, 10e9, 0.005)
-        assert not engine._worth_offloading(1 * 1024 * 1024)
-        assert engine._worth_offloading(32 * 1024 * 1024)
+        assert engine._worth_offloading([_Sized(256 * 1024)] * 1024)
+        # ... but a single 1 MB piece still pads to a full 1024-lane
+        # tile (~1 GB shipped for 1 MB hashed) and must NOT offload —
+        # the raw-bytes model got exactly this wrong
+        assert not engine._worth_offloading([_Sized(1024 * 1024)])
 
     def test_env_override_wins(self, monkeypatch):
         engine = self._engine(1.4e9, 25e6, 0.067)
         monkeypatch.setenv("DIGEST_OFFLOAD", "always")
-        assert engine._worth_offloading(1)
+        assert engine._worth_offloading([_Sized(1)])
         monkeypatch.setenv("DIGEST_OFFLOAD", "never")
-        assert not engine._worth_offloading(1 << 40)
+        assert not engine._worth_offloading([_Sized(1 << 30)] * 1024)
 
     def test_auto_falls_back_to_hashlib_below_breakeven(self):
         engine = self._engine(1.4e9, 25e6, 0.067)
@@ -255,6 +274,69 @@ class TestOffloadPolicy:
         assert engine._calibrate() is first
         hashlib_bps, _, _ = first
         assert hashlib_bps > 0
+
+    def test_calibration_once_under_concurrent_first_flush(self):
+        """N swarm workers hitting first-flush concurrently must pay
+        for exactly ONE probe (round-3 verdict: the measurement ran
+        outside the lock, so each racer paid it)."""
+        import threading as threading_mod
+        import time as time_mod
+
+        engine = DigestEngine(backend="auto", min_batch=1)
+        calls = []
+
+        def fake_measure():
+            calls.append(1)
+            time_mod.sleep(0.05)  # a window wide enough for every racer
+            return (1.4e9, 25e6, 0.067)
+
+        engine._measure_calibration = fake_measure
+        results = []
+        workers = [
+            threading_mod.Thread(
+                target=lambda: results.append(engine._calibrate())
+            )
+            for _ in range(8)
+        ]
+        for worker in workers:
+            worker.start()
+        for worker in workers:
+            worker.join()
+        assert len(calls) == 1
+        assert all(r == (1.4e9, 25e6, 0.067) for r in results)
+
+    def test_cost_model_prices_the_array_actually_shipped(self):
+        """_shipped_bytes must equal the nbytes of the padded tiled
+        array the pallas path would device_put for the same batch."""
+        from downloader_tpu.parallel.engine import _block_bucket
+        from downloader_tpu.parallel.pack import pack_pieces_tiled
+
+        engine = DigestEngine(backend="auto", min_batch=1)
+        engine._tiled_possible = True  # price the pallas tiled layout
+        rng = np.random.default_rng(3)
+        for sizes in (
+            [256 * 1024] * 7,  # uniform, partial tile
+            [32 * 1024] * 1024 + [100],  # two tiles, ragged tail
+            [1],  # degenerate
+            list(rng.integers(1, 100_000, size=50)),  # ragged mix
+        ):
+            pieces = [b"\x00" * int(n) for n in sizes]
+            blocks, _ = pack_pieces_tiled(pieces)
+            bucketed = _block_bucket(blocks.shape[1])
+            padded_nbytes = (blocks.nbytes // blocks.shape[1]) * bucketed
+            assert engine._shipped_bytes(pieces) == padded_nbytes, sizes
+
+    def test_block_bucket_admits_pow2_plus_one(self):
+        """Power-of-two piece sizes pad to 2^j + 1 SHA-1 blocks; the
+        bucket must keep them exact instead of doubling to 2^(j+1)."""
+        from downloader_tpu.parallel.engine import _block_bucket
+
+        assert _block_bucket(513) == 513  # 32 KiB piece: exact
+        assert _block_bucket(512) == 512
+        assert _block_bucket(514) == 1024  # genuinely past the bucket
+        assert _block_bucket(1) == 1
+        assert _block_bucket(3) == 3
+        assert _block_bucket(4) == 4
 
 
 class TestReviewRegressions:
