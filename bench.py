@@ -346,29 +346,34 @@ def main() -> None:
         # the ratio wholesale.
         slices = max(1, int(os.environ.get("BENCH_SLICES", 4)))
         # never inflate or truncate the requested workload: shrink the
-        # slice count instead when BENCH_JOBS can't fill the slices
-        # with at least one full concurrency wave each
+        # slice count when BENCH_JOBS can't fill the slices with at
+        # least one full concurrency wave each, and spread any
+        # remainder over the first slices so every requested job runs
+        jobs = max(1, jobs)
         if jobs >= concurrency:
             slices = max(1, min(slices, jobs // concurrency))
         else:
             slices = 1
-        jobs_per_slice = jobs // slices
+        slice_jobs = [
+            jobs // slices + (1 if i < jobs % slices else 0)
+            for i in range(slices)
+        ]
         _log(
-            f"bench: {repeats} pairs x {slices} alternating slices x "
-            f"{jobs_per_slice} jobs x {mb_per_job} MB per config"
+            f"bench: {repeats} pairs x {slices} alternating slices of "
+            f"{slice_jobs} jobs x {mb_per_job} MB per config"
         )
         pairs: list[tuple[float, float]] = []
         for i in range(repeats):
             mb = {"b": 0.0, "f": 0.0}
             secs = {"b": 0.0, "f": 0.0}
-            for _ in range(slices):
+            for slice_n in slice_jobs:
                 moved, took = run_config(
-                    jobs_per_slice, mb_per_job, 1, 1, site, zero_copy=False
+                    slice_n, mb_per_job, 1, 1, site, zero_copy=False
                 )
                 mb["b"] += moved
                 secs["b"] += took
                 moved, took = run_config(
-                    jobs_per_slice, mb_per_job, concurrency, concurrency, site
+                    slice_n, mb_per_job, concurrency, concurrency, site
                 )
                 mb["f"] += moved
                 secs["f"] += took

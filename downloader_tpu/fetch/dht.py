@@ -129,7 +129,13 @@ class DHTClient:
         # peers/nodes, so the datagram's recvfrom address must also match
         # the node the query went to. Hostnames (bootstrap routers) are
         # resolved up front so the comparison is IP-vs-IP.
-        pending: dict[tuple[bytes, tuple[str, int]], tuple[str, int]] = {}
+        # keyed by (tid, source IP) — NOT (tid, ip, port): NAT'd nodes
+        # legitimately answer from a different source port than the one
+        # queried, and dropping those silently loses real nodes. The
+        # tid (unique per batch) plus the IP match keeps the
+        # stale/spoofed-reply protection; a spoofer must now guess the
+        # 16-bit tid AND forge the source address.
+        pending: dict[tuple[bytes, str], tuple[str, int]] = {}
         used_tids: set[bytes] = set()
         for addr in addrs:
             try:
@@ -172,7 +178,7 @@ class DHTClient:
                     f"dht send failed: {exc}"
                 )
                 continue
-            pending[(tid, resolved)] = addr
+            pending[(tid, resolved[0])] = addr
 
         replies: dict[tuple[str, int], dict] = {}
         deadline = time.monotonic() + self._query_timeout
@@ -200,7 +206,7 @@ class DHTClient:
                         # an unhashable list/dict; treat as junk rather
                         # than letting a TypeError abort the whole job
                         continue
-                    addr = pending.pop((tid, tuple(src[:2])), None)
+                    addr = pending.pop((tid, src[0]), None)
                     if addr is None:
                         continue  # stale, foreign, or spoofed transaction
                     kind = reply.get(b"y")

@@ -5,13 +5,16 @@ Policy lives here, math lives in sha1.py / sha1_pallas.py / mesh.py:
 - **Backend selection.** ``auto`` offloads to the accelerator when the
   batch is at least ``min_batch`` pieces AND a one-time runtime
   calibration says the offload actually wins: the device only beats
-  ``hashlib`` when ``bytes/hashlib_rate > bytes/transfer_rate +
-  sync_overhead``, so the engine measures the host hash rate, the
-  host→device transfer rate, and the per-call sync overhead once, and
-  derives the break-even byte count. On a dev box whose TPU sits
-  behind a ~25 MB/s tunnel that break-even is infinite (hashlib always
-  wins — measured, r2); on a TPU VM with local PCIe/DMA the same probe
-  picks a real threshold. ``hashlib``/``jax``/``pallas`` force a path.
+  ``hashlib`` when ``raw_bytes/hashlib_rate >
+  SHIPPED_bytes/transfer_rate + sync_overhead``, where shipped bytes
+  are the padded/tiled array the transfer actually moves for this
+  batch's shape (so there is no single break-even byte count — a full
+  dense tile ships ~its raw size, one lone piece ships a whole padded
+  tile). The engine measures the host hash rate, the host→device
+  transfer rate, and the per-call sync overhead once. On a dev box
+  whose TPU sits behind a ~25 MB/s tunnel the answer is always
+  "hashlib" (measured, r2); on a TPU VM with local PCIe/DMA dense
+  batches offload. ``hashlib``/``jax``/``pallas`` force a path.
 - **Kernel choice.** On a TPU platform the device path is the Pallas
   kernel (sha1_pallas.py; measured 49.1 GB/s device-resident in round
   2 — BENCH_r02.json — and below timer resolution behind the dev
@@ -274,7 +277,9 @@ class DigestEngine:
                 self._tiled_possible = False
         return self._tiled_possible
 
-    def _shipped_bytes(self, pieces: Sequence[bytes]) -> int:
+    def _shipped_bytes(
+        self, pieces: Sequence[bytes], tiled: bool | None = None
+    ) -> int:
         """The byte count the device transfer will ACTUALLY move for
         this batch — the padded/tiled array, not the raw piece bytes.
         The tiled layout pads the lane axis to whole 1024-piece tiles
@@ -286,7 +291,7 @@ class DigestEngine:
 
         count = len(pieces)
         max_blocks = max((block_count(len(p)) for p in pieces), default=1)
-        if self._tiled_layout():
+        if tiled if tiled is not None else self._tiled_layout():
             # pallas tiled layout: (T, B, 16, 8, 128) uint32
             tiles = max(1, -(-count // TILE))
             return tiles * TILE * _block_bucket(max_blocks) * 64
@@ -313,8 +318,25 @@ class DigestEngine:
         if transfer_bps <= 0:
             return False
         hash_s = sum(len(p) for p in pieces) / hashlib_bps
-        ship_s = self._shipped_bytes(pieces) / transfer_bps
-        return hash_s > ship_s + sync_s
+
+        def wins(shipped: int) -> bool:
+            return hash_s > shipped / transfer_bps + sync_s
+
+        if wins(self._shipped_bytes(pieces)):
+            return True
+        # The tiled pricing may be for a path that cannot even build
+        # (single-TPU host, broken pallas kernel). If the XLA layout
+        # would win, resolve reality by attempting the pallas build
+        # once: on failure the flag flips, the layout re-prices as
+        # XLA, and sub-tile batches stop being blocked forever by a
+        # phantom tile pad (review finding, round 4).
+        if (
+            self._tiled_layout()
+            and wins(self._shipped_bytes(pieces, tiled=False))
+            and self._pallas() is None
+        ):
+            return wins(self._shipped_bytes(pieces))
+        return False
 
     def _use_device(self, pieces: Sequence[bytes]) -> bool:
         if self._backend == "hashlib":

@@ -166,6 +166,34 @@ class AmqpChannel:
         )
         self._rpc(wire.QUEUE_BIND, args, wire.QUEUE_BIND_OK)
 
+    def delete_queue(self, name: str) -> None:
+        """queue.delete (if-unused/if-empty false: delete regardless) —
+        integration tests clean their per-run entities off shared
+        brokers with this."""
+        self._check()
+        args = (
+            wire.Writer()
+            .short(0)
+            .shortstr(name)
+            .bit(False)  # if-unused
+            .bit(False)  # if-empty
+            .bit(False)  # no-wait
+            .done()
+        )
+        self._rpc(wire.QUEUE_DELETE, args, wire.QUEUE_DELETE_OK)
+
+    def delete_exchange(self, name: str) -> None:
+        self._check()
+        args = (
+            wire.Writer()
+            .short(0)
+            .shortstr(name)
+            .bit(False)  # if-unused
+            .bit(False)  # no-wait
+            .done()
+        )
+        self._rpc(wire.EXCHANGE_DELETE, args, wire.EXCHANGE_DELETE_OK)
+
     def set_prefetch(self, count: int) -> None:
         self._check()
         args = (
@@ -214,7 +242,18 @@ class AmqpChannel:
         # _resolve_confirms can always make progress even while a
         # publisher is wedged in sendall against a flow-controlled
         # broker (otherwise heartbeat reads would stall behind it and
-        # the monitor would tear down a healthy connection)
+        # the monitor would tear down a healthy connection).
+        #
+        # Design tradeoff (deliberate): the write lock serializes every
+        # publisher on this CONNECTION for the duration of sendall, so
+        # against a broker that stops reading, all channels' publishes
+        # park behind the wedged one until its confirm timeout. The
+        # confirm WAIT below happens outside the lock, so slow acks
+        # (the common slow-broker case) do overlap across threads —
+        # proven by test_amqp.py::test_concurrent_publish_confirm_waits
+        # _overlap. With the QueueClient's one-publisher-thread shape
+        # this never bites; give each publisher its own connection
+        # before adding a second concurrent publisher channel.
         with self._connection._write_lock:
             with self._confirm_lock:
                 self._publish_seq += 1
@@ -402,6 +441,8 @@ class AmqpConnection:
         self._frame_max = FRAME_MAX
         self._heartbeat = 0.0  # outbound send pacing; 0 = disabled
         self._heartbeat_deadline = 0.0  # inbound idle limit (2x wire value)
+        self.server_properties: dict = {}  # connection.start field table
+        self.negotiated_heartbeat = 0  # tune-ok wire seconds (0 = off)
         self._last_recv = time.monotonic()
 
     # -- dial ------------------------------------------------------------
@@ -480,7 +521,11 @@ class AmqpConnection:
             raise AmqpError(f"expected connection.start, got {method}")
         # args: version-major, version-minor, server-properties, mechanisms, locales
         reader.octet(), reader.octet()
-        reader.table()
+        # kept: a real RabbitMQ's server-properties exercises field-table
+        # types the in-repo stub never emits (nested capabilities table
+        # of booleans, longstrs, ...) — the opt-in integration test
+        # asserts this decode against a live broker
+        self.server_properties = reader.table()
         mechanisms = reader.longstr()
         if b"PLAIN" not in mechanisms:
             raise AmqpError(f"server offers no PLAIN auth: {mechanisms!r}")
@@ -524,6 +569,7 @@ class AmqpConnection:
             # faster would flap against a healthy spec-compliant broker
             self._heartbeat = min(heartbeat, float(wire_heartbeat))
             self._heartbeat_deadline = 2.0 * wire_heartbeat
+        self.negotiated_heartbeat = wire_heartbeat
         tune_ok = (
             wire.Writer()
             .short(channel_max)

@@ -46,6 +46,10 @@ class AmqpServerStub:
         # but never send the basic.ack, so wire clients waiting on a
         # confirm see the timeout/teardown path
         self.hold_confirm_acks = False
+        # slow-broker simulation: acks are sent, but this many seconds
+        # late (off the session loop, so publish RECEIPT stays fast —
+        # only the confirm is slow, as with a loaded real broker)
+        self.confirm_ack_delay = 0.0
         stub = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -324,6 +328,17 @@ class _ClientSession:
                     self._close_channel_with_error(channel_num, 404, str(exc))
                     continue
                 self._send_method(channel_num, wire.QUEUE_BIND_OK, b"")
+            elif method == wire.QUEUE_DELETE:
+                reader.short()
+                name = reader.shortstr()
+                dropped = channel.delete_queue(name)
+                ok = wire.Writer().long(dropped).done()
+                self._send_method(channel_num, wire.QUEUE_DELETE_OK, ok)
+            elif method == wire.EXCHANGE_DELETE:
+                reader.short()
+                name = reader.shortstr()
+                channel.delete_exchange(name)
+                self._send_method(channel_num, wire.EXCHANGE_DELETE_OK, b"")
             elif method == wire.BASIC_QOS:
                 reader.long()
                 channel.set_prefetch(reader.short())
@@ -381,13 +396,29 @@ class _ClientSession:
         if channel_num in self._confirm_seq:
             self._confirm_seq[channel_num] += 1
             if not self._stub.hold_confirm_acks:
-                ack = (
-                    wire.Writer()
-                    .longlong(self._confirm_seq[channel_num])
-                    .bit(False)  # multiple
-                    .done()
-                )
-                self._send_method(channel_num, wire.BASIC_ACK, ack)
+                seq = self._confirm_seq[channel_num]
+
+                def send_ack(seq=seq):
+                    ack = (
+                        wire.Writer()
+                        .longlong(seq)
+                        .bit(False)  # multiple
+                        .done()
+                    )
+                    try:
+                        self._send_method(channel_num, wire.BASIC_ACK, ack)
+                    except OSError:
+                        pass  # session died while the ack was pending
+
+                delay = self._stub.confirm_ack_delay
+                if delay > 0:
+                    # Timer thread, not an inline sleep: sleeping here
+                    # would stall the session loop and serialize publish
+                    # RECEIPT, hiding exactly the client-side overlap
+                    # the slow-ack tests exist to measure
+                    threading.Timer(delay, send_ack).start()
+                else:
+                    send_ack()
 
     def _close_channel_with_error(self, channel_num: int, code: int, text: str):
         args = (

@@ -43,8 +43,12 @@ CHANNEL_CLOSE = (20, 40)
 CHANNEL_CLOSE_OK = (20, 41)
 EXCHANGE_DECLARE = (40, 10)
 EXCHANGE_DECLARE_OK = (40, 11)
+EXCHANGE_DELETE = (40, 20)
+EXCHANGE_DELETE_OK = (40, 21)
 QUEUE_DECLARE = (50, 10)
 QUEUE_DECLARE_OK = (50, 11)
+QUEUE_DELETE = (50, 40)
+QUEUE_DELETE_OK = (50, 41)
 QUEUE_BIND = (50, 20)
 QUEUE_BIND_OK = (50, 21)
 BASIC_QOS = (60, 10)

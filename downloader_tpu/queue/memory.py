@@ -85,6 +85,21 @@ class MemoryBroker:
                 raise BrokerError(f"no such queue '{queue}'")
             self._exchanges[exchange].setdefault(routing_key, set()).add(queue)
 
+    def delete_queue(self, name: str) -> int:
+        """Drop a queue, its bindings, and its consumers; returns the
+        message count discarded (RabbitMQ queue.delete-ok semantics)."""
+        with self._lock:
+            dropped = len(self._queues.pop(name, ()))
+            self._consumers.pop(name, None)
+            for bindings in self._exchanges.values():
+                for queues in bindings.values():
+                    queues.discard(name)
+            return dropped
+
+    def delete_exchange(self, name: str) -> None:
+        with self._lock:
+            self._exchanges.pop(name, None)
+
     def _publish(
         self, exchange: str, routing_key: str, body: bytes, headers: dict
     ) -> None:
@@ -268,6 +283,14 @@ class MemoryChannel:
     def bind_queue(self, queue: str, exchange: str, routing_key: str) -> None:
         self._check()
         self._broker._bind(queue, exchange, routing_key)
+
+    def delete_queue(self, name: str) -> int:
+        self._check()
+        return self._broker.delete_queue(name)
+
+    def delete_exchange(self, name: str) -> None:
+        self._check()
+        self._broker.delete_exchange(name)
 
     def set_prefetch(self, count: int) -> None:
         self._check()

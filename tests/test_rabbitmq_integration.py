@@ -1,0 +1,162 @@
+"""Opt-in integration tests against a REAL RabbitMQ broker.
+
+Skipped unless ``RABBITMQ_ENDPOINT`` is set (e.g. ``127.0.0.1:5672``);
+``RABBITMQ_USERNAME``/``RABBITMQ_PASSWORD`` default to guest/guest, the
+broker's out-of-the-box account. Run once against a live broker to prove
+what the hermetic suite structurally cannot (round-4 verdict #6): this
+client and the in-repo stub share ``amqp_wire.py``, so only a foreign
+implementation can catch a codec misunderstanding — field-table types
+RabbitMQ emits that the stub never does, its heartbeat tune behavior,
+and its confirm semantics.
+
+    docker run -d -p 5672:5672 rabbitmq:3
+    RABBITMQ_ENDPOINT=127.0.0.1:5672 python -m pytest tests/test_rabbitmq_integration.py -v
+
+Every queue/exchange name carries a per-run random suffix so reruns and
+parallel runs don't collide on a shared broker; entities are deleted on
+the way out.
+
+The field-table decode surface these tests exercise live is ALSO pinned
+hermetically (against reconstructed RabbitMQ-shaped frames, clearly
+labelled as such) in test_amqp.py::TestRabbitMQShapedFrames — so the
+codec coverage does not silently depend on an env var nobody sets.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import threading
+import time
+
+import pytest
+
+ENDPOINT = os.environ.get("RABBITMQ_ENDPOINT")
+
+pytestmark = pytest.mark.skipif(
+    not ENDPOINT,
+    reason="RABBITMQ_ENDPOINT not set (opt-in real-broker integration)",
+)
+
+USERNAME = os.environ.get("RABBITMQ_USERNAME", "guest")
+PASSWORD = os.environ.get("RABBITMQ_PASSWORD", "guest")
+RUN_ID = secrets.token_hex(4)
+
+
+def _dial(**kwargs):
+    from downloader_tpu.queue.amqp import AmqpConnection
+
+    return AmqpConnection.dial(
+        ENDPOINT, username=USERNAME, password=PASSWORD, **kwargs
+    )
+
+
+def _name(kind: str) -> str:
+    return f"dt-int-{kind}-{RUN_ID}"
+
+
+class TestRealBrokerHandshake:
+    def test_server_properties_field_tables_decode(self):
+        """The connection.start server-properties from a real RabbitMQ
+        carries nested field tables (capabilities: booleans), longstrs
+        (product/version/platform) and more — types the in-repo stub
+        never emits. Decoding them at all is the test; shape checks
+        pin the known RabbitMQ surface."""
+        conn = _dial()
+        try:
+            props = conn.server_properties
+            assert props, "server-properties decoded empty"
+            assert isinstance(props.get("product"), str)
+            capabilities = props.get("capabilities")
+            assert isinstance(capabilities, dict), props
+            # RabbitMQ advertises these as field-table booleans ('t')
+            assert capabilities.get("publisher_confirms") is True
+            assert isinstance(
+                capabilities.get("consumer_cancel_notify"), bool
+            )
+        finally:
+            conn.close()
+
+    def test_heartbeat_negotiated_with_real_broker(self):
+        """RabbitMQ proposes 60 s; we request 10 → tune-ok must land on
+        min(ours, theirs) and the connection must survive several
+        intervals of idleness (i.e. our heartbeat frames are accepted)."""
+        conn = _dial(heartbeat=2.0)
+        try:
+            assert 0 < conn.negotiated_heartbeat <= 2
+            time.sleep(conn.negotiated_heartbeat * 3.0)
+            # still alive: a broker that saw no heartbeats would have
+            # closed us after ~2 intervals
+            channel = conn.channel()
+            channel.declare_queue(_name("hb"))
+            channel.delete_queue(_name("hb"))
+        finally:
+            conn.close()
+
+
+class TestRealBrokerConfirmPublish:
+    def test_confirm_publish_roundtrip_with_headers(self):
+        """Confirm-mode publish to a real broker, consumed back with the
+        X-Retries header intact (the delivery wrapper's wire contract,
+        reference delivery.go:32-42)."""
+        conn = _dial()
+        exchange, queue = _name("ex"), _name("q")
+        try:
+            channel = conn.channel()
+            channel.declare_exchange(exchange)
+            channel.declare_queue(queue)
+            channel.bind_queue(queue, exchange, queue)
+            channel.confirm_select()
+            channel.publish(
+                exchange, queue, b"hello-real-broker",
+                headers={"X-Retries": 2},
+            )  # blocks until the broker's basic.ack
+
+            got = []
+            done = threading.Event()
+
+            def on_message(message):
+                got.append(message)
+                channel.ack(message.delivery_tag)
+                done.set()
+
+            channel.consume(queue, on_message)
+            assert done.wait(10), "message never delivered back"
+            assert got[0].body == b"hello-real-broker"
+            assert got[0].headers.get("X-Retries") == 2
+        finally:
+            try:
+                cleanup = conn.channel()
+                cleanup.delete_queue(queue)
+                cleanup.delete_exchange(exchange)
+            except Exception:
+                pass
+            conn.close()
+
+
+class TestRealBrokerQueueClient:
+    def test_queue_client_end_to_end(self):
+        """The full QueueClient (supervisor, sharded queues, confirm-
+        gated publish) against a real broker: publish with wait= must
+        only return True on a real confirm, and the message must come
+        back through the sharded consume path."""
+        from downloader_tpu.queue import QueueClient
+        from downloader_tpu.utils.cancel import CancelToken
+
+        topic = _name("topic")
+        token = CancelToken()
+        client = QueueClient(
+            token,
+            lambda: _dial(),
+            supervisor_interval=0.1,
+            drain_timeout=5,
+            publish_confirm_timeout=10.0,
+        )
+        try:
+            deliveries = client.consume(topic)
+            assert client.publish(topic, b"e2e", wait=15) is True
+            delivery = deliveries.get(timeout=10)
+            assert delivery.body == b"e2e"
+            delivery.ack()
+        finally:
+            token.cancel()
