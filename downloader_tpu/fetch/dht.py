@@ -118,11 +118,14 @@ class DHTClient:
         pool: _SockPool,
         addrs: list[tuple[str, int]],
         method: bytes,
-        args: dict,
+        args,
     ) -> dict[tuple[str, int], dict]:
         """Send one KRPC query to every address concurrently and collect
         replies until all have answered or the round times out. Returns
-        {addr: reply_args} for the nodes that answered well-formed."""
+        {addr: reply_args} for the nodes that answered well-formed.
+        ``args`` is either one dict for every address, or a callable
+        addr -> dict for queries that differ per node (announce_peer's
+        per-node write token)."""
         # pending is keyed on (transaction id, resolved source address):
         # matching on the 2-byte tid alone would let any host that
         # guesses a tid answer for another node and inject bogus
@@ -163,12 +166,13 @@ class DHTClient:
             while tid in used_tids:
                 tid = secrets.token_bytes(2)
             used_tids.add(tid)
+            node_args = args(addr) if callable(args) else args
             payload = bencode.encode(
                 {
                     b"t": tid,
                     b"y": b"q",
                     b"q": method,
-                    b"a": {b"id": self._node_id, **args},
+                    b"a": {b"id": self._node_id, **node_args},
                 }
             )
             try:
@@ -226,9 +230,18 @@ class DHTClient:
         token: CancelToken | None = None,
         max_peers: int = 50,
         max_rounds: int = 12,
+        announce_port: int | None = None,
     ) -> list[tuple[str, int]]:
         """Iterative get_peers lookup; returns discovered peer addresses
-        (possibly empty — the caller decides whether that is fatal)."""
+        (possibly empty — the caller decides whether that is fatal).
+
+        With ``announce_port``, the lookup finishes with a BEP 5
+        announce_peer to the closest responding nodes (using the write
+        token each returned), registering this client's live listener
+        in the DHT so other leechers can find it — the reciprocating
+        half of what anacrolix's full node does (torrent.go:44). We
+        still don't SERVE get_peers queries (no long-lived routing
+        table, by design: fresh state per job, torrent.go:43-44)."""
         if len(info_hash) != 20:
             raise DHTError("info-hash must be 20 bytes")
 
@@ -238,6 +251,8 @@ class DHTClient:
             )
 
         peers: list[tuple[str, int]] = []
+        # addr -> (node distance, write token): announce targets
+        write_tokens: dict[tuple[str, int], tuple[int, bytes]] = {}
         queried: set[tuple[str, int]] = set()
         # shortlist entries: (distance, node_id, host, port); bootstrap
         # routers get the maximum distance so real nodes displace them
@@ -261,7 +276,18 @@ class DHTClient:
                     pool, candidates, b"get_peers", {b"info_hash": info_hash}
                 )
                 progressed = False
-                for reply in replies.values():
+                for reply_addr, reply in replies.items():
+                    reply_token = reply.get(b"token")
+                    node_id = reply.get(b"id")
+                    if (
+                        isinstance(reply_token, bytes)
+                        and isinstance(node_id, bytes)
+                        and len(node_id) == 20
+                    ):
+                        write_tokens[reply_addr] = (
+                            distance(node_id),
+                            reply_token,
+                        )
                     for peer in _decode_compact_values(reply.get(b"values")):
                         if peer not in peers:
                             peers.append(peer)
@@ -280,6 +306,28 @@ class DHTClient:
                     break
                 if not progressed:
                     break  # round learned nothing new: lookup is done
+
+            if announce_port and write_tokens:
+                # BEP 5: announce to the K closest token-bearing nodes;
+                # best-effort (an unregistered announce only costs us
+                # inbound discoverability, never the download)
+                targets = sorted(
+                    write_tokens.items(), key=lambda item: item[1][0]
+                )[:K]
+                acks = self._query_round(
+                    pool,
+                    [addr for addr, _ in targets],
+                    b"announce_peer",
+                    lambda addr: {
+                        b"info_hash": info_hash,
+                        b"port": announce_port,
+                        b"implied_port": 0,
+                        b"token": write_tokens[addr][1],
+                    },
+                )
+                log.with_fields(
+                    announced=len(acks), targets=len(targets)
+                ).info("dht announce_peer")
         if peers:
             log.with_fields(peers=len(peers), queried=len(queried)).info(
                 "dht lookup found peers"

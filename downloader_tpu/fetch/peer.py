@@ -107,17 +107,21 @@ def announce(
     port: int = 6881,
     timeout: float = 15.0,
     event: str = "started",
+    uploaded: int = 0,
+    downloaded: int = 0,
 ) -> list[tuple[str, int]]:
     """HTTP announce; returns peer (host, port) pairs. Supports compact
     (BEP 23) and dict-form peer lists. ``event=""`` is a regular
     re-announce — repeating "started" would reset the session on real
-    trackers (and some rate-limit it)."""
+    trackers (and some rate-limit it). ``uploaded``/``downloaded`` are
+    real session counters (the listener serves blocks now), not the
+    zeros a leech-only client reports."""
     params = {
         "info_hash": info_hash,
         "peer_id": peer_id,
         "port": str(port),
-        "uploaded": "0",
-        "downloaded": "0",
+        "uploaded": str(uploaded),
+        "downloaded": str(downloaded),
         "left": str(left),
         "compact": "1",
     }
@@ -227,6 +231,8 @@ def announce_udp(
     timeout: float = 3.0,
     retries: int = 1,
     event: str = "started",
+    uploaded: int = 0,
+    downloaded: int = 0,
 ) -> list[tuple[str, int]]:
     """UDP announce (BEP 15): connect handshake to obtain a connection
     id, then announce; returns peer (host, port) pairs. Defaults bound a
@@ -268,9 +274,9 @@ def announce_udp(
                 tid,
                 info_hash,
                 peer_id,
-                0,  # downloaded
+                downloaded,
                 left,
-                0,  # uploaded
+                uploaded,
                 # BEP 15 event codes; 0 = none (regular re-announce)
                 {"": 0, "completed": 1, "started": 2, "stopped": 3}[event],
                 0,  # IP (default: sender address)
@@ -1175,6 +1181,9 @@ class SwarmDownloader:
         port: int = 6881,
         allow_empty: bool = False,
         event: str = "started",
+        uploaded: int = 0,
+        downloaded: int = 0,
+        dht_announce_port: int | None = None,
     ) -> list[tuple[str, int]]:
         """Explicit x.pe hints first (they cost nothing), then every
         tracker — http(s) per BEP 3/23, udp per BEP 15 — and a DHT
@@ -1200,6 +1209,8 @@ class SwarmDownloader:
                     left,
                     port=port,
                     event=event,
+                    uploaded=uploaded,
+                    downloaded=downloaded,
                 )
             if tracker.startswith("udp://"):
                 return announce_udp(
@@ -1209,6 +1220,8 @@ class SwarmDownloader:
                     left,
                     port=port,
                     event=event,
+                    uploaded=uploaded,
+                    downloaded=downloaded,
                 )
             raise TransferError("unsupported tracker scheme")
 
@@ -1255,7 +1268,15 @@ class SwarmDownloader:
                     if self._dht_bootstrap is not None
                     else DHTClient()
                 )
-                for peer in client.get_peers(self._job.info_hash, token):
+                # announce our live listener port into the DHT so other
+                # leechers can find us (anacrolix's node does the same);
+                # None when no listener actually BOUND — a config flag
+                # alone must never register a dead port in the DHT
+                for peer in client.get_peers(
+                    self._job.info_hash,
+                    token,
+                    announce_port=dht_announce_port,
+                ):
                     if peer not in peers:
                         peers.append(peer)
             except DHTError as exc:
@@ -1314,8 +1335,11 @@ class SwarmDownloader:
         # "started" exactly once per job; every later announce is a
         # regular re-announce (event="") per tracker semantics
         announce_event = "started"
+        dht_port = listener.port if listener is not None else None
         if info is None:
-            peers = self._discover_peers(left=1, token=token, port=port)
+            peers = self._discover_peers(
+                left=1, token=token, port=port, dht_announce_port=dht_port
+            )
             announce_event = ""
             log.info("fetching torrent metadata")
             for host, peer_port in peers:
@@ -1344,6 +1368,10 @@ class SwarmDownloader:
         if all(store.have):
             progress(100.0)
             return
+        # BEP 3 "downloaded" is a per-SESSION counter: bytes verified
+        # off disk by the resume scan were not served by anyone this
+        # session and must not inflate tracker ratio accounting
+        session_start_bytes = store.bytes_completed()
 
         if listener is not None:
             # arm the serving side; metadata is served only if the
@@ -1378,6 +1406,9 @@ class SwarmDownloader:
                         port=port,
                         allow_empty=True,
                         event=announce_event,
+                        uploaded=listener.bytes_served if listener else 0,
+                        downloaded=store.bytes_completed() - session_start_bytes,
+                        dht_announce_port=dht_port,
                     )
                     announce_event = ""
                 except TransferError as exc:
@@ -1418,6 +1449,55 @@ class SwarmDownloader:
                 f"failed to download torrents: {missing}/{store.num_pieces} "
                 f"pieces missing (recent errors: {swarm.error_summary()})"
             )
+
+        if self._job.trackers:
+            # fire-and-forget "completed" announce (anacrolix announces
+            # completion too); a slow tracker must not add tail latency
+            # to a finished job, hence the daemon thread + short timeout
+            uploaded = listener.bytes_served if listener else 0
+            threading.Thread(
+                target=self._announce_completed,
+                args=(
+                    port,
+                    uploaded,
+                    store.total_length - session_start_bytes,
+                ),
+                daemon=True,
+                name="announce-completed",
+            ).start()
+
+    def _announce_completed(
+        self, port: int, uploaded: int, downloaded: int
+    ) -> None:
+        for tracker in self._job.trackers:
+            try:
+                if tracker.startswith(("http://", "https://")):
+                    announce(
+                        tracker,
+                        self._job.info_hash,
+                        self._peer_id,
+                        left=0,
+                        port=port,
+                        timeout=5.0,
+                        event="completed",
+                        uploaded=uploaded,
+                        downloaded=downloaded,
+                    )
+                elif tracker.startswith("udp://"):
+                    announce_udp(
+                        tracker,
+                        self._job.info_hash,
+                        self._peer_id,
+                        left=0,
+                        port=port,
+                        timeout=2.0,
+                        retries=0,
+                        event="completed",
+                        uploaded=uploaded,
+                        downloaded=downloaded,
+                    )
+            except TransferError:
+                pass  # best-effort: completion stats only
 
     def _peer_worker(self, swarm: "_SwarmState", token: CancelToken) -> None:
         """One swarm worker: pull peers off the shared queue and serve

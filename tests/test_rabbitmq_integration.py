@@ -78,8 +78,8 @@ class TestRealBrokerHandshake:
             conn.close()
 
     def test_heartbeat_negotiated_with_real_broker(self):
-        """RabbitMQ proposes 60 s; we request 10 → tune-ok must land on
-        min(ours, theirs) and the connection must survive several
+        """RabbitMQ proposes 60 s; we request 2 → tune-ok must land on
+        min(ours, theirs) = 2 and the connection must survive several
         intervals of idleness (i.e. our heartbeat frames are accepted)."""
         conn = _dial(heartbeat=2.0)
         try:

@@ -582,6 +582,8 @@ class FakeDHTNode:
         # port differs from the port the query was sent to
         self.reply_from_new_port = reply_from_new_port
         self.queries = []
+        self.announces = []  # announce_peer query args received
+        self.write_token = b"tok-" + os.urandom(4)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sock.bind(("127.0.0.1", 0))
         self._sock.settimeout(0.2)
@@ -606,7 +608,16 @@ class FakeDHTNode:
             except BencodeError:
                 continue
             self.queries.append(message)
-            response = {b"id": self.node_id}
+            if message.get(b"q") == b"announce_peer":
+                args = message.get(b"a", {})
+                self.announces.append(args)
+                ok = encode(
+                    {b"t": message[b"t"], b"y": b"r", b"r": {b"id": self.node_id}}
+                )
+                if args.get(b"token") == self.write_token:
+                    self._sock.sendto(ok, addr)
+                continue  # bad token: real nodes silently drop
+            response = {b"id": self.node_id, b"token": self.write_token}
             if self.values:
                 response[b"values"] = [
                     ipaddress.IPv4Address(host).packed + struct.pack(">H", port)
@@ -653,6 +664,32 @@ class TestDHT:
             client = DHTClient(bootstrap=(node.address,), query_timeout=1.0)
             peers = client.get_peers(self.INFO_HASH)
         assert peers == [("10.9.8.7", 1234)]
+
+    def test_announce_peer_registers_listen_port(self):
+        """With announce_port set, the lookup finishes by announcing our
+        listener into the DHT using each node's write token (BEP 5) —
+        the discoverability half of being a real peer."""
+        from downloader_tpu.fetch.dht import DHTClient
+
+        with FakeDHTNode(values=[("10.9.8.7", 1234)]) as node:
+            client = DHTClient(bootstrap=(node.address,), query_timeout=1.0)
+            peers = client.get_peers(
+                self.INFO_HASH, announce_port=51413
+            )
+        assert peers == [("10.9.8.7", 1234)]
+        assert len(node.announces) == 1
+        args = node.announces[0]
+        assert args[b"info_hash"] == self.INFO_HASH
+        assert args[b"port"] == 51413
+        assert args[b"token"] == node.write_token
+
+    def test_no_announce_without_port(self):
+        from downloader_tpu.fetch.dht import DHTClient
+
+        with FakeDHTNode(values=[("10.9.8.7", 1234)]) as node:
+            client = DHTClient(bootstrap=(node.address,), query_timeout=1.0)
+            client.get_peers(self.INFO_HASH)
+        assert node.announces == []
 
     def test_lookup_follows_nodes_to_peers(self):
         from downloader_tpu.fetch.dht import DHTClient
@@ -896,6 +933,8 @@ class TestResume:
         assert updates == [100.0]
 
     def test_partial_resume_completes_from_swarm(self, tmp_path):
+        import time as time_mod
+
         payload = bytes(range(256)) * 600
         with Seeder("movie.mkv", payload) as s:
             info, _, _ = make_torrent("movie.mkv", payload, piece_length=32 * 1024)
@@ -905,6 +944,18 @@ class TestResume:
             backend.download(
                 CancelToken(), str(tmp_path), lambda u, p: None, s.magnet_uri
             )
+            # BEP 3 "downloaded" is per-session: the resumed piece was
+            # verified off disk, not served, and must not be counted in
+            # the completed announce's tracker accounting
+            deadline = time_mod.monotonic() + 5
+            completed = []
+            while time_mod.monotonic() < deadline and not completed:
+                completed = [
+                    a for a in s.announces if a.get("event") == "completed"
+                ]
+                time_mod.sleep(0.02)
+            assert completed
+            assert int(completed[0]["downloaded"]) == len(payload) - 32 * 1024
         assert (tmp_path / "movie.mkv").read_bytes() == payload
 
 
@@ -1126,6 +1177,16 @@ class TestInboundPeer:
                 CancelToken(),
                 timeout=5,
             ) as conn:
+                from downloader_tpu.fetch.peer import MSG_BITFIELD
+
+                # wait for the (all-zero) bitfield: once it has arrived,
+                # the listener's snapshot predates the write below, so
+                # the new piece MUST come through as a HAVE broadcast
+                while True:
+                    msg_id, _ = conn.read_message()
+                    if msg_id == MSG_BITFIELD:
+                        break
+                assert not conn.has_piece(1)
                 store.write_piece(1, data[self.PIECE : 2 * self.PIECE])
                 while True:
                     msg_id, payload = conn.read_message()
@@ -1186,6 +1247,30 @@ class TestInboundPeer:
         assert announced == {str(downloader.listen_port)}
         assert downloader.listen_port != 6881  # ephemeral, real
 
+    def test_completed_event_announced_with_real_counters(self, tmp_path):
+        """A finished job fires a best-effort "completed" announce whose
+        uploaded/downloaded are real session counters (the listener
+        serves blocks now), not a leech-only client's zeros."""
+        import time as time_mod
+
+        payload = bytes(range(256)) * 600
+        with Seeder("movie.mkv", payload) as s:
+            job = parse_magnet(s.magnet_uri)
+            downloader = SwarmDownloader(
+                job, str(tmp_path), progress_interval=0.01, dht_bootstrap=()
+            )
+            downloader.run(CancelToken(), lambda p: None)
+            deadline = time_mod.monotonic() + 5
+            completed = []
+            while time_mod.monotonic() < deadline and not completed:
+                completed = [
+                    a for a in s.announces if a.get("event") == "completed"
+                ]
+                time_mod.sleep(0.02)
+        assert completed, "no completed announce arrived"
+        assert int(completed[0]["downloaded"]) == len(payload)
+        assert completed[0]["left"] == "0"
+
     def test_two_downloaders_complete_from_each_other(self, tmp_path):
         """Verdict #1 done-criterion (a): two SwarmDownloaders, no
         Seeder. Each starts with half the pieces on disk; each can only
@@ -1240,7 +1325,9 @@ class TestInboundPeer:
                 by_port.setdefault(a["port"], []).append(a.get("event"))
             for events in by_port.values():
                 assert events[0] == "started"
-                assert all(e is None for e in events[1:])
+                # later announces: regular (no event) or the final
+                # fire-and-forget "completed" — never "started" again
+                assert all(e in (None, "completed") for e in events[1:])
         for d in dirs:
             assert (d / "movie.mkv").read_bytes() == data
         # both sides actually served (mutual leeching, not one seeder)
