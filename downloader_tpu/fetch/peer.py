@@ -318,6 +318,18 @@ class PeerConnection:
         self.bitfield = b""
         self.remote_extensions: dict[bytes, int] = {}
         self.metadata_size = 0
+        # reciprocation state: with a store attached (attach_store),
+        # the remote's INTERESTED/REQUEST frames are served inline from
+        # read_message — a real peer serves on connections it initiated
+        # too (anacrolix does; NAT'd remotes may have no other way in)
+        self._serve_store: "PieceStore | None" = None
+        self._remote_interested = False
+        self._remote_unchoked = False
+        # deque: appends come from other workers (GIL-atomic), popleft
+        # from the owner; O(1) both ways even for a 10k-piece catch-up
+        self._pending_haves: "collections.deque[int]" = collections.deque()
+        self.blocks_served = 0
+        self.bytes_served = 0
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.settimeout(timeout)
         self._poll_waiter: SocketWaiter | None = None
@@ -356,6 +368,63 @@ class PeerConnection:
         payload = bencode.encode({b"m": {b"ut_metadata": UT_METADATA}})
         self.send_message(MSG_EXTENDED, bytes([0]) + payload)
 
+    def attach_store(self, store: "PieceStore") -> None:
+        """Arm reciprocation: the remote's INTERESTED is answered with
+        UNCHOKE and its REQUESTs are served from ``store`` as side
+        effects of read_message. Everything runs on the single worker
+        thread that owns this connection — socket writes stay
+        single-writer (no shearing), and a served block adds at most
+        one write between our own reads. Pieces we already have go out
+        as HAVE frames (a post-handshake BITFIELD is not spec-legal),
+        via the pending queue the owner flushes at its loop points."""
+        self._serve_store = store
+        for index, done in enumerate(store.have):
+            if done:
+                self._pending_haves.append(index)
+        # the remote may have declared interest before the store existed
+        if self._remote_interested and not self._remote_unchoked:
+            self._remote_unchoked = True
+            self.send_message(MSG_UNCHOKE)
+
+    def queue_have(self, index: int) -> None:
+        """Record a newly-acquired piece for the remote. Called by
+        WHICHEVER worker completed the piece — only queues (deque
+        append, GIL-atomic); the owning worker sends on its next
+        flush_haves so the socket keeps a single writer."""
+        self._pending_haves.append(index)
+
+    def flush_haves(self) -> None:
+        """Owner-thread only: send queued HAVE announcements, batched
+        into ONE sendall (a mostly-resumed 10k-piece torrent queues
+        thousands of 9-byte frames at attach; one syscall each would
+        flood the socket path)."""
+        if not self._pending_haves:
+            return
+        frames = bytearray()
+        while True:
+            try:
+                index = self._pending_haves.popleft()
+            except IndexError:
+                break
+            frames += _frame(MSG_HAVE, struct.pack(">I", index))
+        if frames:
+            self._sock.sendall(frames)
+
+    def _serve_remote_request(self, payload: bytes) -> None:
+        if self._serve_store is None or not self._remote_unchoked:
+            return  # nothing to serve yet / requests-while-choked drop
+        if len(payload) != 12:
+            return
+        index, begin, length = struct.unpack(">III", payload)
+        if length > MAX_REQUEST_LENGTH:
+            return  # hostile size; don't kill our own download over it
+        block = self._serve_store.read_block(index, begin, length)
+        if block is None:
+            return
+        self.blocks_served += 1
+        self.bytes_served += len(block)
+        self.send_message(MSG_PIECE, struct.pack(">II", index, begin) + block)
+
     # -- framing ---------------------------------------------------------
 
     def _recv_exact(self, count: int) -> bytes:
@@ -386,6 +455,15 @@ class PeerConnection:
                 self.bitfield = payload
             elif msg_id == MSG_HAVE and len(payload) >= 4:
                 self._mark_have(struct.unpack(">I", payload[:4])[0])
+            elif msg_id == MSG_INTERESTED:
+                self._remote_interested = True
+                if self._serve_store is not None and not self._remote_unchoked:
+                    self._remote_unchoked = True
+                    self.send_message(MSG_UNCHOKE)
+            elif msg_id == MSG_NOT_INTERESTED:
+                self._remote_interested = False
+            elif msg_id == MSG_REQUEST:
+                self._serve_remote_request(payload)
             elif msg_id == MSG_EXTENDED and payload and payload[0] == 0:
                 self._parse_extended_handshake(payload[1:])
             return msg_id, payload
@@ -1304,6 +1382,8 @@ class SwarmDownloader:
                 log.warning(f"peer listener disabled: {exc}")
         completed = False
         self._observed_leecher_ids: set[bytes] = set()
+        self.blocks_served = 0  # per-run totals: listener + outbound conns
+        self.bytes_served = 0
         try:
             self._run(token, progress, listener)
             completed = True
@@ -1319,8 +1399,8 @@ class SwarmDownloader:
                     else 0.0,
                     expected_leechers=self._observed_leecher_ids,
                 )
-                self.blocks_served = listener.blocks_served
-                self.bytes_served = listener.bytes_served
+                self.blocks_served += listener.blocks_served
+                self.bytes_served += listener.bytes_served
 
     def _run(
         self, token: CancelToken, progress, listener: "PeerListener | None"
@@ -1390,6 +1470,10 @@ class SwarmDownloader:
         ).info("waiting for torrent download")
 
         swarm = _SwarmState(store, progress, self._progress_interval)
+        # outbound reciprocation: completed pieces are announced (HAVE)
+        # on every live outbound connection, mirroring the listener's
+        # observer on the inbound side
+        store.add_observer(swarm.broadcast_have)
         # Re-announce loop: anacrolix keeps announcing on the tracker
         # interval for the life of the client; this loop does the
         # bounded-job version — when the current peers are exhausted but
@@ -1406,7 +1490,8 @@ class SwarmDownloader:
                         port=port,
                         allow_empty=True,
                         event=announce_event,
-                        uploaded=listener.bytes_served if listener else 0,
+                        uploaded=(listener.bytes_served if listener else 0)
+                        + self.bytes_served,
                         downloaded=store.bytes_completed() - session_start_bytes,
                         dht_announce_port=dht_port,
                     )
@@ -1454,7 +1539,9 @@ class SwarmDownloader:
             # fire-and-forget "completed" announce (anacrolix announces
             # completion too); a slow tracker must not add tail latency
             # to a finished job, hence the daemon thread + short timeout
-            uploaded = listener.bytes_served if listener else 0
+            uploaded = (
+                listener.bytes_served if listener else 0
+            ) + self.bytes_served
             threading.Thread(
                 target=self._announce_completed,
                 args=(
@@ -1516,6 +1603,9 @@ class SwarmDownloader:
                         self._serve_pieces(conn, swarm, token)
                     finally:
                         swarm.unregister(conn)
+                        with swarm._lock:  # concurrent worker exits
+                            self.blocks_served += conn.blocks_served
+                            self.bytes_served += conn.bytes_served
                         # a peer whose bitfield is incomplete is a
                         # leecher that will want our pieces; remember
                         # its peer_id so the post-completion drain gives
@@ -1542,13 +1632,24 @@ class SwarmDownloader:
     ) -> None:
         store = swarm.store
         batch = _PieceBatch(swarm, owner=conn)
+        # reciprocate on this connection too: the remote may have no
+        # inbound path to us (NAT); serve its requests from the store
+        # and announce what we already have / newly acquire
+        conn.attach_store(store)
         conn.send_message(MSG_INTERESTED)
+        # announce what we hold BEFORE waiting on the unchoke: a
+        # tit-for-tat remote that keeps unproven peers choked decides
+        # whether to reciprocate based on these HAVEs — flushing only
+        # after unchoke would deadlock against exactly such peers
+        conn.flush_haves()
         while conn.choked:
             msg_id, _ = conn.read_message()
+            conn.flush_haves()
 
         try:
             while True:
                 token.raise_if_cancelled()
+                conn.flush_haves()
                 index = swarm.claim(conn)
                 if index is swarm.WAIT:
                     # every missing piece is claimed by another worker;
@@ -1752,6 +1853,15 @@ class _SwarmState:
     def unregister(self, conn) -> None:
         with self._lock:
             self._conns.discard(conn)
+
+    def broadcast_have(self, index: int) -> None:
+        """Store observer: queue a HAVE for every live outbound
+        connection (each conn's owner thread flushes — queue only, so
+        a stalled remote can never block the completing worker)."""
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
+            conn.queue_have(index)
 
     def done(self) -> bool:
         return all(self.store.have)
