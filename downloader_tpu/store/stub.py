@@ -14,6 +14,7 @@ from __future__ import annotations
 import hashlib
 import http.server
 import re
+import socket
 import threading
 import urllib.parse
 
@@ -82,9 +83,18 @@ class S3Stub:
                 return body
 
             def _drain_body(self) -> tuple[int, str]:
-                """Read and discard the request body through a small
-                reusable window; returns (bytes read, sha256 hex) so auth
-                can still verify signed payloads without retaining them."""
+                """Read and discard the request body; returns
+                (bytes read, sha256 hex) so auth can still verify
+                signed payloads without retaining them.
+
+                Unsigned bodies (the client's streaming default) are
+                discarded KERNEL-SIDE with recv(MSG_TRUNC) — Linux TCP
+                consumes the bytes without copying them to userspace —
+                so the stub models a remote peer instead of competing
+                with the client under test for this host's one vCPU.
+                Signed bodies still stream through userspace (sha256
+                needs the bytes), as does any platform where MSG_TRUNC
+                misbehaves."""
                 length = int(self.headers.get("Content-Length", "0"))
                 # hash only when the client signed the payload; the
                 # common UNSIGNED-PAYLOAD path must not pay sha256 here
@@ -92,8 +102,40 @@ class S3Stub:
                     "x-amz-content-sha256", sigv4.EMPTY_SHA256
                 ) not in ("UNSIGNED-PAYLOAD",)
                 digest = hashlib.sha256() if signed else None
-                scratch = memoryview(bytearray(1024 * 1024))
                 read = 0
+                scratch = memoryview(bytearray(1024 * 1024))
+                if digest is None and length:
+                    # `length` guard: peek blocks on an empty buffer
+                    # waiting for bytes a zero-length body never sends.
+                    # The header parser's BufferedReader may already
+                    # hold body bytes; those must come from the buffer
+                    # or the raw-socket discard would break framing.
+                    # ONE peek only — peek refills an empty buffer with
+                    # a raw read, so peeking in a loop would pull the
+                    # whole body through 8 KiB buffer fills and never
+                    # reach the kernel-side discard below
+                    buffered = self.rfile.peek(0)
+                    if buffered and read < length:
+                        take = min(len(buffered), length - read)
+                        self.rfile.read(take)
+                        read += take
+                    try:
+                        while read < length:
+                            # recv_into + MSG_TRUNC: the kernel consumes
+                            # the bytes without filling the buffer, and
+                            # the reused scratch avoids a fresh 1 MiB
+                            # allocation per call (recv would allocate)
+                            got = self.connection.recv_into(
+                                scratch,
+                                min(len(scratch), length - read),
+                                socket.MSG_TRUNC,
+                            )
+                            if not got:
+                                return read, ""
+                            read += got
+                        return read, ""
+                    except (OSError, ValueError):
+                        pass  # MSG_TRUNC unsupported: userspace fallback
                 while read < length:
                     got = self.rfile.readinto(
                         scratch[: min(len(scratch), length - read)]

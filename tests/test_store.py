@@ -248,3 +248,33 @@ def test_put_object_from_pipe_falls_back_to_copy_loop():
         finally:
             writer.join()
         assert bytes(stub.buckets["pipes"]["obj"]) == payload
+
+
+def test_drain_stub_zero_length_unsigned_put_does_not_hang():
+    """retain_objects=False drains unsigned bodies kernel-side; a
+    zero-length body must short-circuit — an unconditional peek would
+    block waiting for bytes that never come (round-4 review finding)."""
+    import threading
+
+    with S3Stub(credentials=CREDS, retain_objects=False) as stub:
+        client = S3Client(stub.endpoint, CREDS)
+        client.make_bucket("b")
+        done = []
+        worker = threading.Thread(
+            target=lambda: done.append(client.put_bytes("b", "empty", b"")),
+            daemon=True,
+        )
+        worker.start()
+        worker.join(timeout=10)
+        assert done, "zero-length PUT deadlocked the drain-mode stub"
+
+
+def test_drain_stub_large_unsigned_put_framing_preserved():
+    """The kernel-side MSG_TRUNC discard must consume exactly the body:
+    a second request on the same keep-alive connection still parses."""
+    with S3Stub(credentials=CREDS, retain_objects=False) as stub:
+        client = S3Client(stub.endpoint, CREDS)
+        client.make_bucket("b")
+        client.put_bytes("b", "big", b"Z" * (3 * 1024 * 1024 + 17))
+        # same client/connection: framing intact => this parses cleanly
+        client.put_bytes("b", "after", b"tail")
