@@ -1565,3 +1565,116 @@ class TestOutboundReciprocation:
         assert sorted(result["haves"]) == list(range(store.num_pieces))
         assert conn.blocks_served == 1
         assert conn.bytes_served == 2048
+
+
+class TestInboundHostility:
+    """The listener faces the open internet (its port is announced to
+    trackers and the DHT); hostile input must be reaped quietly and
+    must never wedge serving for honest peers."""
+
+    def _listener(self, tmp_path):
+        data = bytes(range(256)) * 300
+        info, _, _ = make_torrent("movie.mkv", data, 32 * 1024)
+        store = PieceStore(info, str(tmp_path))
+        for i in range(store.num_pieces):
+            store.write_piece(
+                i, data[i * 32 * 1024 : i * 32 * 1024 + store.piece_size(i)]
+            )
+        info_bytes = encode(info)
+        listener = PeerListener(
+            hashlib.sha1(info_bytes).digest(), generate_peer_id()
+        )
+        listener.attach(store, info_bytes)
+        return listener, data
+
+    def test_wrong_infohash_handshake_is_dropped(self, tmp_path):
+        from downloader_tpu.fetch.peer import HANDSHAKE_PSTR
+
+        listener, _ = self._listener(tmp_path)
+        try:
+            sock = socket.create_connection(("127.0.0.1", listener.port), 5)
+            sock.settimeout(2)
+            sock.sendall(
+                bytes([len(HANDSHAKE_PSTR)]) + HANDSHAKE_PSTR + bytes(8)
+                + b"\xee" * 20 + b"-XX0000-" + b"x" * 12
+            )
+            # no handshake reply; the connection just closes
+            assert sock.recv(1) == b""
+            sock.close()
+        finally:
+            listener.close()
+
+    def test_garbage_bytes_do_not_crash_listener(self, tmp_path):
+        from downloader_tpu.fetch.peer import PeerConnection
+
+        listener, data = self._listener(tmp_path)
+        try:
+            for _ in range(3):
+                sock = socket.create_connection(
+                    ("127.0.0.1", listener.port), 5
+                )
+                sock.sendall(os.urandom(200))
+                sock.close()
+            # an honest peer is still served after the garbage storm
+            with PeerConnection(
+                "127.0.0.1",
+                listener.port,
+                listener.info_hash,
+                generate_peer_id(),
+                CancelToken(),
+                timeout=5,
+            ) as conn:
+                while not conn.bitfield:
+                    conn.read_message()
+                assert conn.has_piece(0)
+        finally:
+            listener.close()
+
+    def test_oversized_frame_drops_connection_only(self, tmp_path):
+        from downloader_tpu.fetch.peer import HANDSHAKE_PSTR
+
+        listener, _ = self._listener(tmp_path)
+        try:
+            sock = socket.create_connection(("127.0.0.1", listener.port), 5)
+            sock.settimeout(5)
+            reserved = bytearray(8)
+            reserved[5] |= 0x10
+            sock.sendall(
+                bytes([len(HANDSHAKE_PSTR)]) + HANDSHAKE_PSTR
+                + bytes(reserved) + listener.info_hash
+                + b"-YY0000-" + b"y" * 12
+            )
+            # read their handshake back, then claim a 100 MB frame
+            buf = bytearray()
+            while len(buf) < 68:
+                buf += sock.recv(68 - len(buf))
+            sock.sendall(struct.pack(">I", 100 * 1024 * 1024))
+            assert sock.recv(1 << 16) is not None  # eventually EOF/reset
+            deadline = 50
+            while listener.active_leechers() and deadline:
+                import time as time_mod
+
+                time_mod.sleep(0.05)
+                deadline -= 1
+            assert not listener.active_leechers()
+        finally:
+            listener.close()
+
+    def test_inbound_connection_cap(self, tmp_path):
+        listener, _ = self._listener(tmp_path)
+        try:
+            listener._max_inbound = 2
+            socks = [
+                socket.create_connection(("127.0.0.1", listener.port), 5)
+                for _ in range(4)
+            ]
+            import time as time_mod
+
+            time_mod.sleep(0.3)
+            with listener._lock:
+                live = len(listener._conns)
+            assert live <= 2, f"cap not enforced: {live} connections"
+            for sock in socks:
+                sock.close()
+        finally:
+            listener.close()
