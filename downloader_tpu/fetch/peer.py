@@ -330,6 +330,7 @@ class PeerConnection:
         self._pending_haves: "collections.deque[int]" = collections.deque()
         self.blocks_served = 0
         self.bytes_served = 0
+        self._last_send = time.monotonic()
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.settimeout(timeout)
         self._poll_waiter: SocketWaiter | None = None
@@ -434,6 +435,7 @@ class PeerConnection:
         return data
 
     def send_message(self, msg_id: int, payload: bytes = b"") -> None:
+        self._last_send = time.monotonic()
         self._sock.sendall(_frame(msg_id, payload))
 
     def read_message(self) -> tuple[int, bytes]:
@@ -520,6 +522,12 @@ class PeerConnection:
         if self._poll_waiter is None:
             self._poll_waiter = SocketWaiter(self._sock, write=False, what="read")
         while True:
+            # a long WAIT state is pure silence from our side; peers
+            # following the spec reap connections idle ~2 min, so send
+            # the 4-byte keepalive frame once a minute (BEP 3)
+            if time.monotonic() - self._last_send > 60.0:
+                self._last_send = time.monotonic()
+                self._sock.sendall(struct.pack(">I", 0))
             remain = deadline - time.monotonic()
             if remain <= 0:
                 return
@@ -877,7 +885,14 @@ class _InboundPeer:
 
     def _sender_loop(self) -> None:
         while True:
-            frame = self._outq.get()
+            try:
+                frame = self._outq.get(timeout=55.0)
+            except queue.Empty:
+                if not self._ready.is_set():
+                    continue  # mid-handshake: nothing may precede it
+                # nothing to say for ~a minute: keepalive, so a remote
+                # idling in its WAIT state doesn't reap us as dead
+                frame = struct.pack(">I", 0)
             if frame is None:
                 return
             try:

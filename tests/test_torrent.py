@@ -1678,3 +1678,55 @@ class TestInboundHostility:
                 sock.close()
         finally:
             listener.close()
+
+
+class TestKeepalive:
+    def test_idle_wait_sends_keepalive(self, tmp_path):
+        """A worker parked in WAIT is pure silence otherwise; peers
+        following the spec reap ~2-min-idle connections, so the poll
+        loop must emit the 4-byte keepalive frame (BEP 3)."""
+        import time as time_mod
+
+        from downloader_tpu.fetch.peer import HANDSHAKE_PSTR, PeerConnection
+
+        info_hash = hashlib.sha1(b"ka").digest()
+        server = socket.create_server(("127.0.0.1", 0))
+        got: dict = {}
+
+        def remote():
+            sock, _ = server.accept()
+            sock.settimeout(5)
+            data = bytearray()
+            while len(data) < 68:
+                data += sock.recv(68 - len(data))
+            sock.sendall(
+                bytes([len(HANDSHAKE_PSTR)]) + HANDSHAKE_PSTR + bytes(8)
+                + info_hash + b"-KA0000-" + b"k" * 12
+            )
+            try:
+                got["frame"] = sock.recv(4)
+            except OSError:
+                pass
+            sock.close()
+
+        th = threading.Thread(target=remote, daemon=True)
+        th.start()
+        conn = PeerConnection(
+            "127.0.0.1",
+            server.getsockname()[1],
+            info_hash,
+            generate_peer_id(),
+            CancelToken(),
+            timeout=5,
+        )
+        try:
+            conn._last_send = time_mod.monotonic() - 61  # force due
+            try:
+                conn.poll_messages(0.2)
+            except TransferError:
+                pass  # remote hangs up right after taking the keepalive
+            th.join(timeout=5)
+            assert got.get("frame") == struct.pack(">I", 0)
+        finally:
+            conn.close()
+            server.close()
