@@ -1416,6 +1416,10 @@ class SwarmDownloader:
                 )
                 self.blocks_served += listener.blocks_served
                 self.bytes_served += listener.bytes_served
+                if self.bytes_served:
+                    log.with_fields(
+                        blocks=self.blocks_served, bytes=self.bytes_served
+                    ).info("served peers while downloading")
 
     def _run(
         self, token: CancelToken, progress, listener: "PeerListener | None"
