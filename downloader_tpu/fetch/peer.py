@@ -895,11 +895,27 @@ class _InboundPeer:
                 frame = struct.pack(">I", 0)
             if frame is None:
                 return
+            # batch whatever else is queued into one sendall: an
+            # attach-time catch-up can queue thousands of 9-byte HAVE
+            # frames, and per-frame syscalls would flood the socket path
+            batch = bytearray(frame)
+            done = False
+            while True:
+                try:
+                    extra = self._outq.get_nowait()
+                except queue.Empty:
+                    break
+                if extra is None:
+                    done = True
+                    break
+                batch += extra
             try:
                 with self._send_lock:
-                    self._sock.sendall(frame)
+                    self._sock.sendall(batch)
             except OSError:
                 return  # dying connection; the serve loop reaps it
+            if done:
+                return
 
     def notify_have(self, index: int) -> None:
         self._enqueue(_frame(MSG_HAVE, struct.pack(">I", index)))
