@@ -63,6 +63,10 @@ MSG_BITFIELD = 5
 MSG_REQUEST = 6
 MSG_PIECE = 7
 MSG_CANCEL = 8
+# BEP 6 fast extension (reserved[7] & 0x04); anacrolix speaks it too
+MSG_HAVE_ALL = 14
+MSG_HAVE_NONE = 15
+MSG_REJECT = 16
 MSG_EXTENDED = 20
 
 # largest block an inbound REQUEST may ask for; the de-facto norm is
@@ -316,6 +320,7 @@ class PeerConnection:
         self.info_hash = info_hash
         self.choked = True
         self.bitfield = b""
+        self.remote_have_all = False  # BEP 6 HAVE_ALL received
         self.remote_extensions: dict[bytes, int] = {}
         self.metadata_size = 0
         # reciprocation state: with a store attached (attach_store),
@@ -344,6 +349,7 @@ class PeerConnection:
     def _handshake(self, peer_id: bytes) -> None:
         reserved = bytearray(8)
         reserved[5] |= 0x10  # BEP 10 extension protocol
+        reserved[7] |= 0x04  # BEP 6 fast extension
         self._sock.sendall(
             bytes([len(HANDSHAKE_PSTR)])
             + HANDSHAKE_PSTR
@@ -362,6 +368,13 @@ class PeerConnection:
             # own listener would idle-loop (we have nothing we need)
             raise PeerProtocolError("connected to ourselves")
         self.remote_supports_extended = bool(reply[25] & 0x10)
+        self.remote_supports_fast = bool(reply[27] & 0x04)
+        if self.remote_supports_fast:
+            # BEP 6: exactly one of BITFIELD/HAVE_ALL/HAVE_NONE MUST
+            # precede any other message once fast is negotiated. The
+            # store isn't attached yet, so HAVE_NONE now + HAVE catch-up
+            # later (the lazy-bitfield flow BEP 6 sanctions).
+            self.send_message(MSG_HAVE_NONE)
         if self.remote_supports_extended:
             self.send_extended_handshake()
 
@@ -412,15 +425,22 @@ class PeerConnection:
             self._sock.sendall(frames)
 
     def _serve_remote_request(self, payload: bytes) -> None:
-        if self._serve_store is None or not self._remote_unchoked:
-            return  # nothing to serve yet / requests-while-choked drop
         if len(payload) != 12:
             return
         index, begin, length = struct.unpack(">III", payload)
-        if length > MAX_REQUEST_LENGTH:
-            return  # hostile size; don't kill our own download over it
-        block = self._serve_store.read_block(index, begin, length)
+        block = None
+        if (
+            self._serve_store is not None
+            and self._remote_unchoked
+            and length <= MAX_REQUEST_LENGTH
+        ):
+            block = self._serve_store.read_block(index, begin, length)
         if block is None:
+            # BEP 6 remotes get an explicit REJECT (echoed request) so
+            # they re-request elsewhere now; legacy remotes get the
+            # historical silent drop
+            if self.remote_supports_fast:
+                self.send_message(MSG_REJECT, payload)
             return
         self.blocks_served += 1
         self.bytes_served += len(block)
@@ -457,6 +477,17 @@ class PeerConnection:
                 self.bitfield = payload
             elif msg_id == MSG_HAVE and len(payload) >= 4:
                 self._mark_have(struct.unpack(">I", payload[:4])[0])
+            elif msg_id == MSG_HAVE_ALL:
+                # BEP 6: empty bitfield already means "assume seeder"
+                # to the claim heuristic; the flag keeps has_piece
+                # truthful too
+                self.bitfield = b""
+                self.remote_have_all = True
+            elif msg_id == MSG_HAVE_NONE:
+                # one all-zero byte: non-empty => "has nothing (yet)";
+                # later HAVE frames grow it via _mark_have
+                self.bitfield = b"\x00"
+                self.remote_have_all = False
             elif msg_id == MSG_INTERESTED:
                 self._remote_interested = True
                 if self._serve_store is not None and not self._remote_unchoked:
@@ -500,6 +531,8 @@ class PeerConnection:
                 self.metadata_size = size
 
     def has_piece(self, index: int) -> bool:
+        if self.remote_have_all:
+            return True  # BEP 6 HAVE_ALL
         byte_index, bit = divmod(index, 8)
         if byte_index >= len(self.bitfield):
             return False
@@ -564,6 +597,10 @@ class PeerConnection:
 def fetch_metadata(conn: PeerConnection, info_hash: bytes, deadline: float) -> dict:
     """Download the info dict from a peer via ut_metadata and verify its
     SHA-1 equals the info-hash (the reference's GotInfo phase)."""
+    if not conn.remote_supports_extended:
+        # no BEP 10 bit in its handshake: this peer can never provide
+        # metadata — fail in microseconds, not a read-timeout stall
+        raise PeerProtocolError("peer does not support extensions (BEP 10)")
     while not conn.remote_extensions and time.monotonic() < deadline:
         conn.read_message()
     remote_id = conn.remote_extensions.get(b"ut_metadata")
@@ -855,6 +892,7 @@ class _InboundPeer:
         # NOT_INTERESTED when finished (spec-compliant behavior)
         self.ever_interested = False
         self.remote_peer_id = b""  # set once the handshake arrives
+        self.remote_supports_fast = False  # BEP 6, from the handshake
         self._unchoked = False
         self._remote_ext: dict[bytes, int] = {}
         # nothing may be written before our handshake reply is on the
@@ -982,8 +1020,10 @@ class _InboundPeer:
             return
         self.remote_peer_id = hs[48:68]
         remote_supports_ext = bool(hs[25] & 0x10)
+        self.remote_supports_fast = bool(hs[27] & 0x04)  # BEP 6
         reserved = bytearray(8)
         reserved[5] |= 0x10  # BEP 10
+        reserved[7] |= 0x04  # BEP 6
         with self._send_lock:
             self._sock.sendall(
                 bytes([len(HANDSHAKE_PSTR)])
@@ -995,15 +1035,26 @@ class _InboundPeer:
         store, info_bytes = self._listener.snapshot()
         sent_have: list[bool] = []
         if store is not None:
-            # always a bitfield post-attach, even all-zero: an absent
-            # bitfield reads as "seeder" to permissive clients
-            # (including our own claim heuristic)
+            # availability goes out post-attach, even when empty: an
+            # absent bitfield reads as "seeder" to permissive clients
+            # (including our own claim heuristic). BEP 6 remotes get
+            # the compact HAVE_ALL/HAVE_NONE forms.
             sent_have = list(store.have)
-            field = bytearray((len(sent_have) + 7) // 8)
-            for i, done in enumerate(sent_have):
-                if done:
-                    field[i // 8] |= 0x80 >> (i % 8)
-            self._send(MSG_BITFIELD, bytes(field))
+            if self.remote_supports_fast and all(sent_have):
+                self._send(MSG_HAVE_ALL)
+            elif self.remote_supports_fast and not any(sent_have):
+                self._send(MSG_HAVE_NONE)
+            else:
+                field = bytearray((len(sent_have) + 7) // 8)
+                for i, done in enumerate(sent_have):
+                    if done:
+                        field[i // 8] |= 0x80 >> (i % 8)
+                self._send(MSG_BITFIELD, bytes(field))
+        elif self.remote_supports_fast:
+            # pre-attach (metadata/resume still running): BEP 6 demands
+            # an availability message first; HAVE_NONE is the truthful
+            # one, and the attach catch-up upgrades it with HAVEs
+            self._send(MSG_HAVE_NONE)
         if remote_supports_ext:
             # only to peers that advertised BEP 10 — a vanilla client
             # would drop us over an unknown message id
@@ -1044,15 +1095,19 @@ class _InboundPeer:
             # is synchronous so a CANCEL always arrives too late.
 
     def _serve_request(self, payload: bytes) -> None:
-        if not self._unchoked:
-            return  # spec: requests while choked are dropped
         index, begin, length = struct.unpack(">III", payload)
         if length > MAX_REQUEST_LENGTH:
             raise PeerProtocolError(f"oversized block request: {length}")
-        store, _ = self._listener.snapshot()
-        block = store.read_block(index, begin, length) if store else None
+        block = None
+        if self._unchoked:  # spec: requests while choked are dropped
+            store, _ = self._listener.snapshot()
+            block = store.read_block(index, begin, length) if store else None
         if block is None:
-            return  # piece we don't have (yet): drop, remote retries elsewhere
+            # BEP 6 remotes get an explicit REJECT so they re-request
+            # elsewhere now; legacy remotes get the silent drop
+            if self.remote_supports_fast:
+                self._send(MSG_REJECT, payload)
+            return
         # count before the send: a reader that saw the PIECE frame must
         # also see it counted (the reverse order races observers)
         self._listener.count_block(len(block))
@@ -1739,6 +1794,16 @@ class SwarmDownloader:
                         msg_id, payload = conn.read_message()
                         if msg_id == MSG_CHOKE:
                             raise PeerProtocolError("peer choked mid-piece")
+                        if (
+                            msg_id == MSG_REJECT
+                            and len(payload) >= 4
+                            and struct.unpack(">I", payload[:4])[0] == index
+                        ):
+                            # BEP 6: an explicit no — move on NOW instead
+                            # of grinding to the 20 s socket timeout
+                            raise PeerProtocolError(
+                                f"peer rejected piece {index}"
+                            )
                         if msg_id != MSG_PIECE or len(payload) < 8:
                             continue
                         got_index, begin = struct.unpack(">II", payload[:8])

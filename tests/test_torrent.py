@@ -1096,7 +1096,6 @@ class TestInboundPeer:
 
     def test_serves_blocks_after_unchoke(self, tmp_path):
         from downloader_tpu.fetch.peer import (
-            MSG_BITFIELD,
             MSG_INTERESTED,
             MSG_PIECE,
             MSG_REQUEST,
@@ -1115,7 +1114,9 @@ class TestInboundPeer:
                 CancelToken(),
                 timeout=5,
             ) as conn:
-                while not conn.bitfield:
+                # a fully-seeded listener talking to a BEP 6 client
+                # sends the compact HAVE_ALL instead of a bitfield
+                while not conn.remote_have_all:
                     conn.read_message()
                 assert all(conn.has_piece(i) for i in range(store.num_pieces))
                 conn.send_message(MSG_INTERESTED)
@@ -1180,14 +1181,15 @@ class TestInboundPeer:
                 CancelToken(),
                 timeout=5,
             ) as conn:
-                from downloader_tpu.fetch.peer import MSG_BITFIELD
+                from downloader_tpu.fetch.peer import MSG_HAVE_NONE
 
-                # wait for the (all-zero) bitfield: once it has arrived,
-                # the listener's snapshot predates the write below, so
-                # the new piece MUST come through as a HAVE broadcast
+                # wait for the availability frame (HAVE_NONE to a BEP 6
+                # client with an empty store): once it has arrived, the
+                # listener's snapshot predates the write below, so the
+                # new piece MUST come through as a HAVE broadcast
                 while True:
                     msg_id, _ = conn.read_message()
-                    if msg_id == MSG_BITFIELD:
+                    if msg_id == MSG_HAVE_NONE:
                         break
                 assert not conn.has_piece(1)
                 store.write_piece(1, data[self.PIECE : 2 * self.PIECE])
@@ -1624,7 +1626,7 @@ class TestInboundHostility:
                 CancelToken(),
                 timeout=5,
             ) as conn:
-                while not conn.bitfield:
+                while not conn.remote_have_all:
                     conn.read_message()
                 assert conn.has_piece(0)
         finally:
@@ -1730,3 +1732,213 @@ class TestKeepalive:
         finally:
             conn.close()
             server.close()
+
+
+class TestFastExtension:
+    """BEP 6 surface: compact availability (covered in TestInboundPeer)
+    plus explicit REJECTs instead of silent request drops."""
+
+    def test_choked_request_gets_reject(self, tmp_path):
+        from downloader_tpu.fetch.peer import (
+            MSG_REJECT,
+            MSG_REQUEST,
+            PeerConnection,
+        )
+
+        data = bytes(range(256)) * 300
+        info, _, _ = make_torrent("movie.mkv", data, 32 * 1024)
+        store = PieceStore(info, str(tmp_path))
+        for i in range(store.num_pieces):
+            store.write_piece(
+                i, data[i * 32768 : i * 32768 + store.piece_size(i)]
+            )
+        info_bytes = encode(info)
+        listener = PeerListener(
+            hashlib.sha1(info_bytes).digest(), generate_peer_id()
+        )
+        listener.attach(store, info_bytes)
+        try:
+            with PeerConnection(
+                "127.0.0.1",
+                listener.port,
+                listener.info_hash,
+                generate_peer_id(),
+                CancelToken(),
+                timeout=5,
+            ) as conn:
+                # REQUEST while still choked (no INTERESTED sent): a
+                # BEP 6 server answers with REJECT echoing the request
+                request = struct.pack(">III", 0, 0, 1024)
+                conn.send_message(MSG_REQUEST, request)
+                while True:
+                    msg_id, payload = conn.read_message()
+                    if msg_id == MSG_REJECT:
+                        break
+                assert payload == request
+        finally:
+            listener.close()
+        assert listener.blocks_served == 0
+
+    def test_reject_aborts_piece_promptly(self, tmp_path):
+        """A peer that REJECTs our request must cost milliseconds, not
+        the 20 s read timeout: the worker abandons and the honest peer
+        completes the download."""
+        import time as time_mod
+
+        from downloader_tpu.fetch.peer import (
+            HANDSHAKE_PSTR,
+            MSG_HAVE_ALL,
+            MSG_INTERESTED,
+            MSG_REJECT,
+            MSG_REQUEST,
+            MSG_UNCHOKE,
+        )
+
+        payload_data = bytes(range(256)) * 600
+        with Seeder("movie.mkv", payload_data) as honest:
+            info_hash = honest.info_hash
+
+            # a fast-ext "seeder" that unchokes, claims HAVE_ALL, then
+            # rejects every request
+            server = socket.create_server(("127.0.0.1", 0))
+
+            def rejecting_peer():
+                while True:
+                    try:
+                        sock, _ = server.accept()
+                    except OSError:
+                        return
+                    sock.settimeout(10)
+                    try:
+                        data = bytearray()
+                        while len(data) < 68:
+                            data += sock.recv(68 - len(data))
+                        reserved = bytearray(8)
+                        reserved[7] |= 0x04
+                        sock.sendall(
+                            bytes([len(HANDSHAKE_PSTR)]) + HANDSHAKE_PSTR
+                            + bytes(reserved) + info_hash
+                            + b"-RJ0000-" + b"j" * 12
+                        )
+                        sock.sendall(struct.pack(">IB", 1, MSG_HAVE_ALL))
+                        while True:
+                            length = struct.unpack(
+                                ">I", recv_n(sock, 4)
+                            )[0]
+                            if length == 0:
+                                continue
+                            body = recv_n(sock, length)
+                            if body[0] == MSG_INTERESTED:
+                                sock.sendall(
+                                    struct.pack(">IB", 1, MSG_UNCHOKE)
+                                )
+                            elif body[0] == MSG_REQUEST:
+                                sock.sendall(
+                                    struct.pack(
+                                        ">IB", 1 + len(body[1:]), MSG_REJECT
+                                    )
+                                    + body[1:]
+                                )
+                    except OSError:
+                        sock.close()
+
+            def recv_n(sock, n):
+                buf = bytearray()
+                while len(buf) < n:
+                    chunk = sock.recv(n - len(buf))
+                    if not chunk:
+                        raise OSError("closed")
+                    buf += chunk
+                return bytes(buf)
+
+            threading.Thread(target=rejecting_peer, daemon=True).start()
+            try:
+                with FakeUDPTracker(
+                    [server.getsockname(), honest.peer_address]
+                ) as tracker:
+                    magnet = (
+                        f"magnet:?xt=urn:btih:{info_hash.hex()}"
+                        f"&tr={tracker.url}"
+                    )
+                    start = time_mod.monotonic()
+                    TorrentBackend(
+                        progress_interval=0.01, dht_bootstrap=()
+                    ).download(
+                        CancelToken(),
+                        str(tmp_path),
+                        lambda u, p: None,
+                        magnet,
+                    )
+                    elapsed = time_mod.monotonic() - start
+            finally:
+                server.close()
+        assert (tmp_path / "movie.mkv").read_bytes() == payload_data
+        # silent-drop behavior would park the worker in a 20 s read
+        # timeout per piece attempt; the explicit REJECT keeps it fast
+        assert elapsed < 10, f"REJECT not honored promptly: {elapsed:.1f}s"
+
+
+class TestLegacyPeerCompat:
+    """A remote WITHOUT the BEP 6 bit must get the legacy wire surface:
+    a real BITFIELD (never HAVE_ALL/HAVE_NONE) and silent request drops
+    (never REJECT) — pinned with a raw socket since every in-repo
+    client now advertises fast."""
+
+    def test_no_fast_bit_gets_bitfield_and_silence(self, tmp_path):
+        from downloader_tpu.fetch.peer import (
+            HANDSHAKE_PSTR,
+            MSG_BITFIELD,
+            MSG_REQUEST,
+        )
+
+        data = bytes(range(256)) * 300  # 3 pieces
+        info, _, _ = make_torrent("movie.mkv", data, 32 * 1024)
+        store = PieceStore(info, str(tmp_path))
+        for i in range(store.num_pieces):
+            store.write_piece(
+                i, data[i * 32768 : i * 32768 + store.piece_size(i)]
+            )
+        info_bytes = encode(info)
+        listener = PeerListener(
+            hashlib.sha1(info_bytes).digest(), generate_peer_id()
+        )
+        listener.attach(store, info_bytes)
+
+        def recv_n(sock, n):
+            buf = bytearray()
+            while len(buf) < n:
+                chunk = sock.recv(n - len(buf))
+                if not chunk:
+                    raise OSError("closed")
+                buf += chunk
+            return bytes(buf)
+
+        try:
+            sock = socket.create_connection(("127.0.0.1", listener.port), 5)
+            sock.settimeout(2)
+            # handshake with NO reserved bits at all (pre-BEP6/BEP10 era)
+            sock.sendall(
+                bytes([len(HANDSHAKE_PSTR)]) + HANDSHAKE_PSTR + bytes(8)
+                + listener.info_hash + b"-LG0000-" + b"l" * 12
+            )
+            recv_n(sock, 68)
+            # first frame: a BITFIELD with all three pieces set
+            length = struct.unpack(">I", recv_n(sock, 4))[0]
+            body = recv_n(sock, length)
+            assert body[0] == MSG_BITFIELD
+            assert body[1] == 0b11100000  # pieces 0,1,2 of a 3-piece torrent
+            # choked REQUEST: silence for legacy peers, never a REJECT
+            sock.sendall(
+                struct.pack(">IB", 13, MSG_REQUEST)
+                + struct.pack(">III", 0, 0, 1024)
+            )
+            got = b""
+            try:
+                got = sock.recv(4096)
+            except socket.timeout:
+                pass  # silence is the pass condition
+            # keepalives (zero frames) are the only tolerated traffic
+            assert not got or set(got) == {0}, got
+            sock.close()
+        finally:
+            listener.close()
