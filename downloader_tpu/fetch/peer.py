@@ -1570,8 +1570,13 @@ class SwarmDownloader:
         # pieces remain, re-discover and retry. This is what lets two
         # leechers bootstrap off each other: whichever announces first
         # sees an empty swarm, and finds the other on the next round.
-        rounds = 0
+        # count CONSECUTIVE fruitless rounds: a round that completed
+        # pieces proves the swarm is alive, so the budget resets — a
+        # large torrent trickling through flaky peers must not be
+        # aborted after a fixed number of rounds while it is working
+        fruitless_rounds = 0
         while True:
+            progress_before = store.bytes_completed()
             if peers is None:
                 try:
                     peers = self._discover_peers(
@@ -1611,10 +1616,13 @@ class SwarmDownloader:
             token.raise_if_cancelled()
             if swarm.done():
                 break
-            rounds += 1
-            if rounds >= self._discovery_rounds:
-                break
-            time.sleep(min(0.2 * rounds, 1.0))
+            if store.bytes_completed() > progress_before:
+                fruitless_rounds = 0
+            else:
+                fruitless_rounds += 1
+                if fruitless_rounds >= self._discovery_rounds:
+                    break
+            time.sleep(min(0.2 * (fruitless_rounds + 1), 1.0))
             token.raise_if_cancelled()
             peers = None  # re-announce next round
 
