@@ -156,6 +156,50 @@ def measure(
                 # throughput
                 result["pallas_GBps"] = None
                 result["pallas_below_timer_resolution"] = True
+
+            # Sustained on-chip rate, robust to the jitter: chain R
+            # DEPENDENT kernel passes inside one jit (each pass's
+            # message blocks are perturbed by the previous pass's
+            # digest, so XLA can neither CSE nor dead-code them) and
+            # difference two rep counts — the per-call dispatch/sync
+            # cost cancels exactly, and 30 extra passes of real
+            # compression work dwarf the timer's resolution. This is a
+            # kernel-throughput measurement on same-shaped data, not a
+            # correctness claim: correctness is the full-batch
+            # hashlib equality gate above.
+            import functools
+
+            @functools.partial(jax.jit, static_argnames=("reps",))
+            def chained(blocks_in, nblocks_in, reps: int):
+                def body(_, carry):
+                    out = sha1_tiled(carry, nblocks_in)
+                    return carry.at[:, 0, :5].set(carry[:, 0, :5] ^ out)
+
+                final = jax.lax.fori_loop(0, reps, body, blocks_in)
+                # scalar return: forces the whole chain to compute but
+                # ships 4 bytes back — fetching the 256 MB carry would
+                # cost seconds through the tunnel and swamp the timing
+                return final[0, 0, 0, 0, 0]
+
+            reps_lo, reps_hi = 2, 32
+            np.asarray(chained(blocks_d, nblocks_d, reps_lo))  # compile
+            np.asarray(chained(blocks_d, nblocks_d, reps_hi))  # compile
+
+            def timed(reps):
+                start = time.monotonic()
+                np.asarray(chained(blocks_d, nblocks_d, reps))
+                return time.monotonic() - start
+
+            # median of 5, not min: the differencing assumes the same
+            # per-call overhead in both samples, and a min can pair a
+            # lucky low-jitter draw with an unlucky one
+            lows = sorted(timed(reps_lo) for _ in range(5))
+            highs = sorted(timed(reps_hi) for _ in range(5))
+            per_pass = (highs[2] - lows[2]) / (reps_hi - reps_lo)
+            if per_pass > 0.002:
+                result["pallas_sustained_GBps"] = round(
+                    total_bytes / per_pass / 1e9, 2
+                )
     except Exception as exc:  # pragma: no cover - device-dependent
         _log(f"bench_digest: device path unavailable ({exc})")
         if "hashlib_GBps" not in result:

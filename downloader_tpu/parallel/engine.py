@@ -16,12 +16,14 @@ Policy lives here, math lives in sha1.py / sha1_pallas.py / mesh.py:
   "hashlib" (measured, r2); on a TPU VM with local PCIe/DMA dense
   batches offload. ``hashlib``/``jax``/``pallas`` force a path.
 - **Kernel choice.** On a TPU platform the device path is the Pallas
-  kernel (sha1_pallas.py; measured 49.1 GB/s device-resident in round
-  2 — BENCH_r02.json — and below timer resolution behind the dev
-  tunnel since, vs ~1.4 GB/s single-thread hashlib on this host);
-  elsewhere (CPU mesh tests, multi-device dryrun) it is the XLA scan
-  kernel, sharded via shard_map + psum when the mesh has more than one
-  device (parallel/mesh.py).
+  kernel (sha1_pallas.py; sustains ~98 GB/s on-chip on v5e by the
+  chained-pass measurement in bench_digest.py — single-call timings
+  behind the dev tunnel sit below its ~70 ms sync jitter, which is
+  why round 2's 49.1 GB/s single-call figure under-read it — vs
+  ~1.5 GB/s single-thread hashlib on this host); elsewhere (CPU mesh
+  tests, multi-device dryrun) it is the XLA scan kernel, sharded via
+  shard_map + psum when the mesh has more than one device
+  (parallel/mesh.py).
 - **Shape bucketing.** Piece counts are padded up to powers of two
   (times the mesh size) and the Pallas kernel's block axis to the
   smallest of {2^k, 2^k+1} — power-of-two piece sizes pad to 2^j+1
@@ -307,8 +309,8 @@ class DigestEngine:
         on the host: raw_bytes/hashlib > shipped_bytes/transfer + sync.
         Hash time scales with the RAW bytes; transfer time scales with
         the padded SHIPPED bytes. On-chip compute is ignored — orders
-        of magnitude faster than either per the round-2 device-resident
-        measurement (49 GB/s, BENCH_r02.json)."""
+        of magnitude faster than either per the sustained chained-pass
+        measurement (~98 GB/s on v5e, bench_digest.py)."""
         mode = os.environ.get("DIGEST_OFFLOAD", "auto")
         if mode == "always":
             return True
