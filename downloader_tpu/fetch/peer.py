@@ -166,6 +166,9 @@ def announce(
                 result.append(
                     (entry[b"ip"].decode("utf-8", "replace"), int(entry[b"port"]))
                 )
+    peers6 = reply.get(b"peers6", b"")
+    if isinstance(peers6, bytes):
+        result.extend(decode_compact_peers6(peers6))
     return result
 
 
@@ -177,6 +180,19 @@ def decode_compact_peers(blob: bytes) -> list[tuple[str, int]]:
             struct.unpack(">H", blob[i + 4 : i + 6])[0],
         )
         for i in range(0, len(blob) - 5, 6)
+    ]
+
+
+def decode_compact_peers6(blob: bytes) -> list[tuple[str, int]]:
+    """BEP 7 compact IPv6 peer list: 18 bytes per peer (IPv6 + port).
+    socket.create_connection takes the literal address as-is, so these
+    flow through the normal peer path."""
+    return [
+        (
+            str(ipaddress.IPv6Address(blob[i : i + 16])),
+            struct.unpack(">H", blob[i + 16 : i + 18])[0],
+        )
+        for i in range(0, len(blob) - 17, 18)
     ]
 
 
