@@ -52,7 +52,6 @@ log = get_logger("fetch.peer")
 
 BLOCK_SIZE = 16 * 1024
 HANDSHAKE_PSTR = b"BitTorrent protocol"
-EXTENSION_BIT = 0x100000  # reserved[5] & 0x10 → BEP 10 support
 
 MSG_CHOKE = 0
 MSG_UNCHOKE = 1
@@ -97,6 +96,17 @@ def _recv_into(sock: socket.socket, count: int) -> bytes | None:
             return None
         data += chunk
     return bytes(data)
+
+
+def pack_bitfield(flags) -> bytes:
+    """BEP 3 BITFIELD payload from an iterable of have-booleans
+    (MSB-first within each byte)."""
+    flags = list(flags)
+    field = bytearray((len(flags) + 7) // 8)
+    for i, done in enumerate(flags):
+        if done:
+            field[i // 8] |= 0x80 >> (i % 8)
+    return bytes(field)
 
 
 # ---------------------------------------------------------------------------
@@ -1061,11 +1071,7 @@ class _InboundPeer:
             elif self.remote_supports_fast and not any(sent_have):
                 self._send(MSG_HAVE_NONE)
             else:
-                field = bytearray((len(sent_have) + 7) // 8)
-                for i, done in enumerate(sent_have):
-                    if done:
-                        field[i // 8] |= 0x80 >> (i % 8)
-                self._send(MSG_BITFIELD, bytes(field))
+                self._send(MSG_BITFIELD, pack_bitfield(sent_have))
         elif self.remote_supports_fast:
             # pre-attach (metadata/resume still running): BEP 6 demands
             # an availability message first; HAVE_NONE is the truthful
@@ -1741,6 +1747,61 @@ class SwarmDownloader:
                     f"peer failed: {exc}; trying next"
                 )
 
+    @staticmethod
+    def _download_piece(
+        conn: PeerConnection, store: PieceStore, index: int
+    ) -> bytes | None:
+        """Pipeline all block requests for one piece and collect the
+        blocks; None when the piece was abandoned because an endgame
+        duplicate verified first (cancel-on-first-win). Raises on CHOKE
+        mid-piece and on a BEP 6 REJECT of this piece — both mean the
+        caller should release the claim and move on."""
+        size = store.piece_size(index)
+        blocks: dict[int, bytes] = {}
+        offsets = list(range(0, size, BLOCK_SIZE))
+        for begin in offsets:
+            conn.send_message(
+                MSG_REQUEST,
+                struct.pack(
+                    ">III", index, begin, min(BLOCK_SIZE, size - begin)
+                ),
+            )
+        while len(blocks) < len(offsets):
+            if store.have[index]:
+                # endgame cancel-on-first-win: another worker's
+                # duplicate of this piece verified first; cancel the
+                # outstanding requests and move on rather than
+                # finishing a download nobody needs
+                for begin in offsets:
+                    if begin not in blocks:
+                        conn.send_message(
+                            MSG_CANCEL,
+                            struct.pack(
+                                ">III",
+                                index,
+                                begin,
+                                min(BLOCK_SIZE, size - begin),
+                            ),
+                        )
+                return None
+            msg_id, payload = conn.read_message()
+            if msg_id == MSG_CHOKE:
+                raise PeerProtocolError("peer choked mid-piece")
+            if (
+                msg_id == MSG_REJECT
+                and len(payload) >= 4
+                and struct.unpack(">I", payload[:4])[0] == index
+            ):
+                # BEP 6: an explicit no — move on NOW instead of
+                # grinding to the 20 s socket timeout
+                raise PeerProtocolError(f"peer rejected piece {index}")
+            if msg_id != MSG_PIECE or len(payload) < 8:
+                continue
+            got_index, begin = struct.unpack(">II", payload[:8])
+            if got_index == index:
+                blocks[begin] = payload[8:]
+        return b"".join(blocks[b] for b in sorted(blocks))
+
     def _serve_pieces(
         self, conn: PeerConnection, swarm: "_SwarmState", token: CancelToken
     ) -> None:
@@ -1780,63 +1841,9 @@ class SwarmDownloader:
                     if conn.choked:  # choked while we idled in WAIT
                         while conn.choked:
                             conn.read_message()
-                    size = store.piece_size(index)
-                    blocks: dict[int, bytes] = {}
-                    offsets = list(range(0, size, BLOCK_SIZE))
-                    # pipeline all block requests for the piece
-                    for begin in offsets:
-                        conn.send_message(
-                            MSG_REQUEST,
-                            struct.pack(
-                                ">III",
-                                index,
-                                begin,
-                                min(BLOCK_SIZE, size - begin),
-                            ),
-                        )
-                    abandoned = False
-                    while len(blocks) < len(offsets):
-                        if store.have[index]:
-                            # endgame cancel-on-first-win: another
-                            # worker's duplicate of this piece verified
-                            # first; cancel what's still outstanding
-                            # and move on rather than finishing a
-                            # download nobody needs
-                            for begin in offsets:
-                                if begin not in blocks:
-                                    conn.send_message(
-                                        MSG_CANCEL,
-                                        struct.pack(
-                                            ">III",
-                                            index,
-                                            begin,
-                                            min(BLOCK_SIZE, size - begin),
-                                        ),
-                                    )
-                            abandoned = True
-                            break
-                        msg_id, payload = conn.read_message()
-                        if msg_id == MSG_CHOKE:
-                            raise PeerProtocolError("peer choked mid-piece")
-                        if (
-                            msg_id == MSG_REJECT
-                            and len(payload) >= 4
-                            and struct.unpack(">I", payload[:4])[0] == index
-                        ):
-                            # BEP 6: an explicit no — move on NOW instead
-                            # of grinding to the 20 s socket timeout
-                            raise PeerProtocolError(
-                                f"peer rejected piece {index}"
-                            )
-                        if msg_id != MSG_PIECE or len(payload) < 8:
-                            continue
-                        got_index, begin = struct.unpack(">II", payload[:8])
-                        if got_index == index:
-                            blocks[begin] = payload[8:]
-                    if not abandoned:
-                        batch.add(
-                            index, b"".join(blocks[b] for b in sorted(blocks))
-                        )
+                    data = self._download_piece(conn, store, index)
+                    if data is not None:
+                        batch.add(index, data)
                         if swarm.endgame:
                             # tail pieces settle immediately: batching an
                             # endgame piece would delay the very win that

@@ -258,13 +258,12 @@ class Seeder:
     # -- peer protocol ---------------------------------------------------
 
     def _recv_exact(self, sock: socket.socket, count: int) -> bytes:
-        data = bytearray()
-        while len(data) < count:
-            chunk = sock.recv(count - len(data))
-            if not chunk:
-                raise OSError("client gone")
-            data += chunk
-        return bytes(data)
+        from .peer import _recv_into
+
+        data = _recv_into(sock, count)
+        if data is None:
+            raise OSError("client gone")
+        return data
 
     def _serve_peer(self, sock: socket.socket) -> None:
         hs = self._recv_exact(sock, 68)
