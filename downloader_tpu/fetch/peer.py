@@ -74,6 +74,7 @@ MSG_EXTENDED = 20
 MAX_REQUEST_LENGTH = 128 * 1024
 
 UT_METADATA = 1  # our local extended-message id for ut_metadata
+UT_PEX = 2  # our local extended-message id for ut_pex (BEP 11)
 
 
 def generate_peer_id() -> bytes:
@@ -349,6 +350,10 @@ class PeerConnection:
         self.remote_have_all = False  # BEP 6 HAVE_ALL received
         self.remote_extensions: dict[bytes, int] = {}
         self.metadata_size = 0
+        # BEP 11 gossip: peers this peer told us about; the swarm
+        # worker drains these into the shared peer queue
+        self.pex_peers: list[tuple[str, int]] = []
+        self._pex_received = 0  # lifetime count, enforces _PEX_PER_CONN
         # reciprocation state: with a store attached (attach_store),
         # the remote's INTERESTED/REQUEST frames are served inline from
         # read_message — a real peer serves on connections it initiated
@@ -405,7 +410,9 @@ class PeerConnection:
             self.send_extended_handshake()
 
     def send_extended_handshake(self) -> None:
-        payload = bencode.encode({b"m": {b"ut_metadata": UT_METADATA}})
+        payload = bencode.encode(
+            {b"m": {b"ut_metadata": UT_METADATA, b"ut_pex": UT_PEX}}
+        )
         self.send_message(MSG_EXTENDED, bytes([0]) + payload)
 
     def attach_store(self, store: "PieceStore") -> None:
@@ -525,7 +532,41 @@ class PeerConnection:
                 self._serve_remote_request(payload)
             elif msg_id == MSG_EXTENDED and payload and payload[0] == 0:
                 self._parse_extended_handshake(payload[1:])
+            elif msg_id == MSG_EXTENDED and payload and payload[0] == UT_PEX:
+                self._parse_pex(payload[1:])
             return msg_id, payload
+
+    # gossip bounds: BEP 11 suggests <=50 peers per message, and one
+    # connection has no business naming hundreds of peers over a job's
+    # lifetime — beyond that it's an address-flood, not a swarm
+    _PEX_PER_MESSAGE = 50
+    _PEX_PER_CONN = 200
+
+    def _parse_pex(self, body: bytes) -> None:
+        """BEP 11 ut_pex: fold the peer's 'added' lists into
+        ``pex_peers`` for the swarm to drain — tracker-thin swarms grow
+        through gossip this way (anacrolix speaks PEX too). Bounded per
+        message and per connection so a hostile peer cannot flood the
+        job with bogus addresses."""
+        try:
+            info = bencode.decode(body)
+        except bencode.BencodeError:
+            return
+        if not isinstance(info, dict):
+            return
+        fresh: list[tuple[str, int]] = []
+        added = info.get(b"added")
+        if isinstance(added, bytes):
+            fresh.extend(decode_compact_peers(added))
+        added6 = info.get(b"added6")
+        if isinstance(added6, bytes):
+            fresh.extend(decode_compact_peers6(added6))
+        # cumulative per-conn budget: pex_peers is drained (emptied) by
+        # the worker, so its length cannot carry the cap
+        room = self._PEX_PER_CONN - self._pex_received
+        take = fresh[: min(self._PEX_PER_MESSAGE, max(0, room))]
+        self._pex_received += len(take)
+        self.pex_peers.extend(take)
 
     def _mark_have(self, index: int) -> None:
         """Fold a HAVE announcement into the peer's bitfield, so piece
@@ -549,8 +590,12 @@ class PeerConnection:
         if isinstance(info, dict):
             mapping = info.get(b"m", {})
             if isinstance(mapping, dict):
+                # ids outside one byte can't go on the wire: bytes([v])
+                # would raise and kill the worker on a crafted handshake
                 self.remote_extensions = {
-                    k: v for k, v in mapping.items() if isinstance(v, int)
+                    k: v
+                    for k, v in mapping.items()
+                    if isinstance(v, int) and 0 < v < 256
                 }
             size = info.get(b"metadata_size", 0)
             if isinstance(size, int):
@@ -1080,7 +1125,7 @@ class _InboundPeer:
         if remote_supports_ext:
             # only to peers that advertised BEP 10 — a vanilla client
             # would drop us over an unknown message id
-            ext = {b"m": {b"ut_metadata": UT_METADATA}}
+            ext = {b"m": {b"ut_metadata": UT_METADATA, b"ut_pex": UT_PEX}}
             if info_bytes is not None:
                 ext[b"metadata_size"] = len(info_bytes)
             self._send(MSG_EXTENDED, bytes([0]) + bencode.encode(ext))
@@ -1143,9 +1188,14 @@ class _InboundPeer:
             except bencode.BencodeError:
                 return
             if isinstance(info, dict) and isinstance(info.get(b"m"), dict):
+                # one-byte ids only: bytes([v]) on a crafted id > 255
+                # would raise and kill this serving thread
                 self._remote_ext = {
-                    k: v for k, v in info[b"m"].items() if isinstance(v, int)
+                    k: v
+                    for k, v in info[b"m"].items()
+                    if isinstance(v, int) and 0 < v < 256
                 }
+            self._maybe_send_pex()
             return
         if ext_id != UT_METADATA:
             return
@@ -1168,6 +1218,28 @@ class _InboundPeer:
             {b"msg_type": 1, b"piece": piece, b"total_size": len(info_bytes)}
         )
         self._send(MSG_EXTENDED, bytes([remote_id]) + header + chunk)
+
+    def _maybe_send_pex(self) -> None:
+        """One-shot BEP 11 ut_pex after the extended handshakes: share
+        the peers this job knows about with a leecher that asked to
+        gossip. IPv4 compact only (added6 when the job ever sees v6
+        swarms); flags bytes are zeros."""
+        remote_id = self._remote_ext.get(b"ut_pex")
+        peers = self._listener.known_peers()
+        if not remote_id or not peers:
+            return
+        compact = bytearray()
+        for host, port in peers:
+            try:
+                compact += socket.inet_aton(host) + struct.pack(">H", port)
+            except (OSError, struct.error):
+                continue  # hostname or v6 literal: not compact-v4-able
+        if not compact:
+            return
+        payload = bencode.encode(
+            {b"added": bytes(compact), b"added.f": bytes(len(compact) // 6)}
+        )
+        self._send(MSG_EXTENDED, bytes([remote_id]) + payload)
 
 
 class PeerListener:
@@ -1198,6 +1270,7 @@ class PeerListener:
         self._max_inbound = max_inbound
         self._store: PieceStore | None = None
         self._info_bytes: bytes | None = None
+        self._peer_source = None  # ut_pex gossip source (attach)
         self._lock = threading.Lock()
         self._conns: set[_InboundPeer] = set()
         self._finished_leecher_ids: set[bytes] = set()
@@ -1246,15 +1319,33 @@ class PeerListener:
         with self._lock:
             return self._store, self._info_bytes
 
-    def attach(self, store: PieceStore, info_bytes: bytes | None) -> None:
+    def known_peers(self) -> list[tuple[str, int]]:
+        """Peers to gossip via ut_pex; empty until attach provides a
+        source (and on any source failure — gossip is best-effort)."""
+        source = self._peer_source
+        if source is None:
+            return []
+        try:
+            return list(source())[:50]
+        except Exception:  # pragma: no cover - defensive
+            return []
+
+    def attach(
+        self,
+        store: PieceStore,
+        info_bytes: bytes | None,
+        peer_source=None,
+    ) -> None:
         """Arm serving once metadata + store exist. Connections accepted
         during the metadata/resume phase are caught up (HAVE frames +
         deferred UNCHOKE); the store observer keeps every connection
-        fed with HAVE as new pieces complete."""
+        fed with HAVE as new pieces complete. ``peer_source`` feeds
+        outgoing ut_pex gossip."""
         store.add_observer(self.notify_have)
         with self._lock:
             self._store = store
             self._info_bytes = info_bytes
+            self._peer_source = peer_source
             conns = list(self._conns)
         have = [i for i, done in enumerate(store.have) if done]
         for conn in conns:
@@ -1565,6 +1656,12 @@ class SwarmDownloader:
         # session and must not inflate tracker ratio accounting
         session_start_bytes = store.bytes_completed()
 
+        swarm = _SwarmState(store, progress, self._progress_interval)
+        # outbound reciprocation: completed pieces are announced (HAVE)
+        # on every live outbound connection, mirroring the listener's
+        # observer on the inbound side
+        store.add_observer(swarm.broadcast_have)
+
         if listener is not None:
             # arm the serving side; metadata is served only if the
             # canonical re-encoding reproduces the info-hash (a peer
@@ -1574,18 +1671,14 @@ class SwarmDownloader:
             info_bytes = bencode.encode(info)
             if hashlib.sha1(info_bytes).digest() != self._job.info_hash:
                 info_bytes = None
-            listener.attach(store, info_bytes)
+            listener.attach(
+                store, info_bytes, peer_source=swarm.known_peers
+            )
 
         log.with_fields(
             pieces=store.num_pieces,
             total=store.total_length,
         ).info("waiting for torrent download")
-
-        swarm = _SwarmState(store, progress, self._progress_interval)
-        # outbound reciprocation: completed pieces are announced (HAVE)
-        # on every live outbound connection, mirroring the listener's
-        # observer on the inbound side
-        store.add_observer(swarm.broadcast_have)
         # Re-announce loop: anacrolix keeps announcing on the tracker
         # interval for the life of the client; this loop does the
         # bounded-job version — when the current peers are exhausted but
@@ -1616,9 +1709,7 @@ class SwarmDownloader:
                 except TransferError as exc:
                     swarm.last_error = exc
                     break  # every peer source is dead: fail now
-            for peer in peers:
-                if peer not in swarm.peer_queue:
-                    swarm.peer_queue.append(peer)
+            swarm.enqueue_discovered(peers)
             workers = [
                 threading.Thread(
                     target=self._peer_worker,
@@ -1816,15 +1907,22 @@ class SwarmDownloader:
         # tit-for-tat remote that keeps unproven peers choked decides
         # whether to reciprocate based on these HAVEs — flushing only
         # after unchoke would deadlock against exactly such peers
+        def drain_gossip() -> None:
+            if conn.pex_peers:
+                swarm.add_peers(conn.pex_peers)
+                conn.pex_peers = []
+
         conn.flush_haves()
         while conn.choked:
             msg_id, _ = conn.read_message()
             conn.flush_haves()
+            drain_gossip()
 
         try:
             while True:
                 token.raise_if_cancelled()
                 conn.flush_haves()
+                drain_gossip()
                 index = swarm.claim(conn)
                 if index is swarm.WAIT:
                     # every missing piece is claimed by another worker;
@@ -1858,6 +1956,7 @@ class SwarmDownloader:
             # normal exit: settle the tail batch here, where a failed
             # verdict propagates and the worker moves to the next peer
             batch.flush()
+            drain_gossip()
         finally:
             # exception paths only (flush() is a no-op when empty): a
             # second failure while unwinding — verification OR a write
@@ -1966,6 +2065,9 @@ class _SwarmState:
         self.endgame = False  # sticky; flips when the first dup is handed out
         # connected peers' bitfields drive rarest-first availability
         self._conns: set = set()
+        # every peer address ever enqueued (dedupes PEX gossip and
+        # feeds the listener's own outgoing PEX messages)
+        self.seen_peers: set[tuple[str, int]] = set()
         self._rng = random.Random()
         self._lock = threading.Lock()
         self._progress = progress
@@ -2013,6 +2115,34 @@ class _SwarmState:
     def next_peer(self) -> tuple[str, int] | None:
         with self._lock:
             return self.peer_queue.pop(0) if self.peer_queue else None
+
+    def add_peers(self, peers) -> None:
+        """Fold gossiped (PEX) peers into the queue, each at most once
+        for the life of the job — tracker/DHT rediscovery handles
+        deliberate retries; gossip must not re-queue dead peers
+        forever."""
+        with self._lock:
+            for peer in peers:
+                if peer not in self.seen_peers:
+                    self.seen_peers.add(peer)
+                    self.peer_queue.append(peer)
+
+    def known_peers(self) -> list[tuple[str, int]]:
+        """Snapshot of every peer this job has seen (the listener's
+        outgoing PEX payload)."""
+        with self._lock:
+            return list(self.seen_peers)
+
+    def enqueue_discovered(self, peers) -> None:
+        """Tracker/DHT (re)discovery: (re)queue anything not already
+        queued — deliberate retries are the point — and register in
+        seen_peers under the lock (listener threads snapshot that set
+        concurrently for PEX gossip)."""
+        with self._lock:
+            for peer in peers:
+                self.seen_peers.add(peer)
+                if peer not in self.peer_queue:
+                    self.peer_queue.append(peer)
 
     def claim(self, conn: PeerConnection):
         """The RAREST unclaimed missing piece this peer advertises

@@ -278,11 +278,10 @@ class Seeder:
             + self.info_hash
             + b"-SEED00-" + b"0" * 12
         )
+        from .peer import pack_bitfield
+
         num_pieces = len(self.info[b"pieces"]) // 20
-        bitfield = bytearray((num_pieces + 7) // 8)
-        for i in range(num_pieces):
-            bitfield[i // 8] |= 0x80 >> (i % 8)
-        self._send(sock, MSG_BITFIELD, bytes(bitfield))
+        self._send(sock, MSG_BITFIELD, pack_bitfield([True] * num_pieces))
         # extended handshake advertising ut_metadata
         ext_hs = bencode.encode(
             {b"m": {b"ut_metadata": 3}, b"metadata_size": len(self.info_bytes)}

@@ -2053,3 +2053,148 @@ def test_announce_decodes_compact_ipv6_peers():
         httpd.shutdown()
     assert ("10.1.2.3", 6881) in got
     assert ("2001:db8::42", 51413) in got
+
+
+class TestPEX:
+    """BEP 11 peer exchange: swarms grow through gossip when trackers
+    are thin (anacrolix speaks ut_pex; so do we, both directions)."""
+
+    def test_download_completes_via_pex_only_peer(self, tmp_path):
+        """The only configured peer has NO pieces — it just gossips the
+        honest seeder's address via ut_pex. The job must complete."""
+        from downloader_tpu.fetch.bencode import encode as benc
+        from downloader_tpu.fetch.peer import (
+            HANDSHAKE_PSTR,
+            MSG_HAVE_NONE,
+            MSG_INTERESTED,
+            MSG_UNCHOKE,
+        )
+
+        payload = bytes(range(256)) * 600
+        with Seeder("movie.mkv", payload) as honest:
+            info_hash = honest.info_hash
+            seeder_host, seeder_port = honest.peer_address
+
+            server = socket.create_server(("127.0.0.1", 0))
+
+            def recv_n(sock, n):
+                buf = bytearray()
+                while len(buf) < n:
+                    chunk = sock.recv(n - len(buf))
+                    if not chunk:
+                        raise OSError("closed")
+                    buf += chunk
+                return bytes(buf)
+
+            def gossip_peer():
+                while True:
+                    try:
+                        sock, _ = server.accept()
+                    except OSError:
+                        return
+                    sock.settimeout(10)
+                    try:
+                        recv_n(sock, 68)
+                        reserved = bytearray(8)
+                        reserved[5] |= 0x10
+                        reserved[7] |= 0x04
+                        sock.sendall(
+                            bytes([len(HANDSHAKE_PSTR)]) + HANDSHAKE_PSTR
+                            + bytes(reserved) + info_hash
+                            + b"-PX0000-" + b"p" * 12
+                        )
+                        sock.sendall(struct.pack(">IB", 1, MSG_HAVE_NONE))
+                        # extended handshake declaring ut_pex support
+                        hs = benc({b"m": {b"ut_pex": 7}})
+                        sock.sendall(
+                            struct.pack(">IB", 2 + len(hs), 20)
+                            + bytes([0]) + hs
+                        )
+                        # gossip the honest seeder (to OUR declared
+                        # ut_pex id, 2) with one flags byte
+                        pex = benc(
+                            {
+                                b"added": socket.inet_aton(seeder_host)
+                                + struct.pack(">H", seeder_port),
+                                b"added.f": b"\x00",
+                            }
+                        )
+                        sock.sendall(
+                            struct.pack(">IB", 2 + len(pex), 20)
+                            + bytes([2]) + pex
+                        )
+                        while True:
+                            length = struct.unpack(
+                                ">I", recv_n(sock, 4)
+                            )[0]
+                            if length == 0:
+                                continue
+                            body = recv_n(sock, length)
+                            if body[0] == MSG_INTERESTED:
+                                sock.sendall(
+                                    struct.pack(">IB", 1, MSG_UNCHOKE)
+                                )
+                    except OSError:
+                        sock.close()
+
+            threading.Thread(target=gossip_peer, daemon=True).start()
+            try:
+                import dataclasses
+
+                host, port = server.getsockname()
+                # metainfo job (info in hand): the gossip peer serves no
+                # metadata, so a magnet flow would die before PEX runs
+                _, meta, _ = make_torrent("movie.mkv", payload)
+                job = dataclasses.replace(
+                    parse_metainfo(meta), peer_hints=((host, port),)
+                )
+                SwarmDownloader(
+                    job,
+                    str(tmp_path),
+                    progress_interval=0.01,
+                    dht_bootstrap=(),
+                    # the gossip peer registers as an observed leecher
+                    # but never visits our listener; don't pay the full
+                    # reciprocity drain for it in a unit test
+                    seed_drain_timeout=0.3,
+                ).run(CancelToken(), lambda p: None)
+            finally:
+                server.close()
+        assert (tmp_path / "movie.mkv").read_bytes() == payload
+        assert honest.served_requests, "seeder discovered via PEX served"
+
+    def test_listener_gossips_known_peers(self, tmp_path):
+        """The inbound side shares the job's known peers with a PEX-
+        capable leecher (one-shot, after the extended handshakes)."""
+        from downloader_tpu.fetch.peer import PeerConnection
+
+        data = bytes(range(256)) * 300
+        info, _, _ = make_torrent("movie.mkv", data, 32 * 1024)
+        store = PieceStore(info, str(tmp_path))
+        info_bytes = encode(info)
+        listener = PeerListener(
+            hashlib.sha1(info_bytes).digest(), generate_peer_id()
+        )
+        listener.attach(
+            store,
+            info_bytes,
+            peer_source=lambda: [("10.1.2.3", 6881), ("10.4.5.6", 51413)],
+        )
+        try:
+            with PeerConnection(
+                "127.0.0.1",
+                listener.port,
+                listener.info_hash,
+                generate_peer_id(),
+                CancelToken(),
+                timeout=5,
+            ) as conn:
+                import time as time_mod
+
+                deadline = time_mod.monotonic() + 5
+                while not conn.pex_peers and time_mod.monotonic() < deadline:
+                    conn.read_message()
+            assert ("10.1.2.3", 6881) in conn.pex_peers
+            assert ("10.4.5.6", 51413) in conn.pex_peers
+        finally:
+            listener.close()
