@@ -46,6 +46,9 @@ class TorrentJob:
     trackers: tuple[str, ...] = ()
     # explicit peer addresses from the magnet's x.pe params (BEP 9)
     peer_hints: tuple[tuple[str, int], ...] = ()
+    # BEP 19 webseeds: HTTP(S) sources for the content itself, from the
+    # metainfo's url-list or the magnet's ws= params
+    web_seeds: tuple[str, ...] = ()
     # populated when parsed from a .torrent file (magnet jobs fetch it
     # from peers via BEP 9 metadata exchange)
     info: dict | None = field(default=None, repr=False)
@@ -85,11 +88,18 @@ def parse_magnet(uri: str) -> TorrentJob:
         if parsed_hint is not None
     ]
 
+    web_seeds = [
+        url
+        for url in params.get("ws", [])
+        if url.startswith(("http://", "https://"))
+    ]
+
     return TorrentJob(
         info_hash=info_hash,
         display_name=params.get("dn", [""])[0],
         trackers=tuple(params.get("tr", [])),
         peer_hints=tuple(peer_hints),
+        web_seeds=tuple(web_seeds),
     )
 
 
@@ -138,10 +148,23 @@ def parse_metainfo(data: bytes) -> TorrentJob:
                     if url not in trackers:
                         trackers.append(url)
 
+    web_seeds: list[str] = []
+    url_list = meta.get(b"url-list")
+    if isinstance(url_list, bytes):  # BEP 19 allows a bare string
+        url_list = [url_list]
+    if not isinstance(url_list, list):
+        url_list = []  # hostile metainfo: url-list of a non-list type
+    for entry in url_list:
+        if isinstance(entry, bytes):
+            url = entry.decode("utf-8", "replace")
+            if url.startswith(("http://", "https://")) and url not in web_seeds:
+                web_seeds.append(url)
+
     name = info.get(b"name", b"")
     return TorrentJob(
         info_hash=info_hash,
         display_name=name.decode("utf-8", "replace") if isinstance(name, bytes) else "",
         trackers=tuple(trackers),
+        web_seeds=tuple(web_seeds),
         info=info,
     )

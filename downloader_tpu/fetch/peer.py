@@ -733,7 +733,10 @@ class PieceStore:
         ) or "download"
 
         self.files: list[tuple[str, int]] = []  # (path, length)
-        if b"files" in info:  # multi-file: base_dir/name/<path...>
+        # torrent-relative path segments per file (webseed URL building)
+        self.relative_paths: list[tuple[str, ...]] = []
+        self.single_file = b"files" not in info
+        if not self.single_file:  # multi-file: base_dir/name/<path...>
             for entry in info[b"files"]:
                 parts = [
                     p.decode("utf-8", "replace")
@@ -746,8 +749,10 @@ class PieceStore:
                 self.files.append(
                     (os.path.join(base_dir, name, *safe_parts), int(entry[b"length"]))
                 )
+                self.relative_paths.append((name, *safe_parts))
         else:  # single file: base_dir/name
             self.files.append((os.path.join(base_dir, name), int(info[b"length"])))
+            self.relative_paths.append((name,))
 
         self.total_length = sum(length for _, length in self.files)
         expected_pieces = (
@@ -784,6 +789,24 @@ class PieceStore:
         return sum(
             self.piece_size(i) for i, done in enumerate(self.have) if done
         )
+
+    def piece_file_ranges(
+        self, index: int
+    ) -> list[tuple[tuple[str, ...], int, int]]:
+        """[(relative_path_parts, offset_in_file, length)] covering one
+        piece — the per-file ranges a webseed fetch must request."""
+        offset = index * self.piece_length
+        size = self.piece_size(index)
+        out = []
+        file_start = 0
+        for (path, length), parts in zip(self.files, self.relative_paths):
+            file_end = file_start + length
+            lo = max(offset, file_start)
+            hi = min(offset + size, file_end)
+            if lo < hi:
+                out.append((parts, lo - file_start, hi - lo))
+            file_start = file_end
+        return out
 
     def read_piece(self, index: int, handles: dict | None = None) -> bytes | None:
         """Read one piece back from the on-disk file layout.
@@ -933,6 +956,145 @@ class PieceStore:
         # remote's socket
         for callback in list(self._observers):
             callback(index)
+
+
+# ---------------------------------------------------------------------------
+# webseeds (BEP 19): HTTP servers as piece sources
+
+
+class _WebSeedSource:
+    """Virtual 'peer' a webseed worker hands to claim(): it has every
+    piece, never gossips, and is never registered for rarity (it would
+    shift every piece's availability uniformly anyway)."""
+
+    bitfield = b""  # empty = has-everything to the claim heuristic
+
+    def has_piece(self, index: int) -> bool:
+        return True
+
+    def queue_have(self, index: int) -> None:
+        pass
+
+
+class _WebSeedPermanent(TransferError):
+    """A webseed error retrying cannot fix (4xx, redirect, bad scheme):
+    the worker gives the URL up for the job instead of burning its
+    transient-failure budget on it."""
+
+
+def _webseed_file_url(base: str, parts: tuple[str, ...], single: bool) -> str:
+    """BEP 19 URL rules: a single-file URL not ending in '/' IS the
+    file; otherwise the torrent name (and subpaths) are appended."""
+    if single and not base.endswith("/"):
+        return base
+    path = "/".join(urllib.parse.quote(part) for part in parts)
+    return base.rstrip("/") + "/" + path
+
+
+class _WebSeedClient:
+    """Per-worker HTTP client with a persistent connection: a 4 GB
+    torrent at 1 MiB pieces would otherwise pay ~4000 TCP(/TLS)
+    handshakes to the same host, one per piece. Cancellation closes
+    the connection (the token callback), unblocking any in-flight
+    read immediately."""
+
+    def __init__(self, timeout: float = 30.0):
+        self._timeout = timeout
+        self._conn: "http.client.HTTPConnection | None" = None
+        self._key: tuple[str, str] | None = None
+
+    def close(self) -> None:
+        conn, self._conn = self._conn, None
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def fetch_range(self, url: str, offset: int, length: int) -> bytes:
+        import http.client
+
+        parsed = urllib.parse.urlsplit(url)
+        if parsed.scheme not in ("http", "https") or not parsed.netloc:
+            raise _WebSeedPermanent(f"unsupported webseed url: {url}")
+        key = (parsed.scheme, parsed.netloc)
+        last: Exception | None = None
+        for attempt in range(2):  # one silent retry: stale keep-alive
+            if self._conn is None or self._key != key:
+                self.close()
+                conn_cls = (
+                    http.client.HTTPSConnection
+                    if parsed.scheme == "https"
+                    else http.client.HTTPConnection
+                )
+                self._conn = conn_cls(parsed.netloc, timeout=self._timeout)
+                self._key = key
+            path = parsed.path or "/"
+            if parsed.query:
+                path += "?" + parsed.query
+            try:
+                self._conn.request(
+                    "GET",
+                    path,
+                    headers={"Range": f"bytes={offset}-{offset + length - 1}"},
+                )
+                response = self._conn.getresponse()
+            except (http.client.HTTPException, OSError) as exc:
+                self.close()
+                last = exc
+                continue
+            return self._consume(response, offset, length, url)
+        raise TransferError(f"webseed fetch failed: {last}")
+
+    def _consume(self, response, offset: int, length: int, url: str) -> bytes:
+        import http.client
+
+        status = response.status
+        if status >= 300:
+            # http.client follows nothing: redirects and 4xx are
+            # deterministic — permanent; 5xx/429 are worth a retry
+            try:
+                response.read()  # drain so the connection stays usable
+            except (http.client.HTTPException, OSError):
+                self.close()
+            if status == 429 or status >= 500:
+                raise TransferError(f"webseed status {status}: {url}")
+            raise _WebSeedPermanent(f"webseed status {status}: {url}")
+        try:
+            if status != 206 and offset:
+                # server ignored Range: discard the prefix — correct,
+                # if wasteful, which only hurts the degraded case
+                remaining = offset
+                while remaining > 0:
+                    skipped = response.read(min(1 << 20, remaining))
+                    if not skipped:
+                        raise TransferError(f"webseed short body: {url}")
+                    remaining -= len(skipped)
+            chunk = bytearray()
+            while len(chunk) < length:
+                got = response.read(length - len(chunk))
+                if not got:
+                    raise TransferError(f"webseed short read: {url}")
+                chunk += got
+            if response.read(1):
+                # unread remainder (Range-ignoring server): it would
+                # desync the next request on this connection
+                self.close()
+            return bytes(chunk)
+        except (http.client.HTTPException, OSError) as exc:
+            self.close()
+            raise TransferError(f"webseed read failed: {exc}") from exc
+
+
+def _fetch_webseed_piece(
+    client: _WebSeedClient, url: str, store: PieceStore, index: int
+) -> bytes:
+    """One piece via HTTP Range requests (one per file the piece spans)."""
+    out = bytearray()
+    for parts, offset, length in store.piece_file_ranges(index):
+        file_url = _webseed_file_url(url, parts, store.single_file)
+        out += client.fetch_range(file_url, offset, length)
+    return bytes(out)
 
 
 # ---------------------------------------------------------------------------
@@ -1685,6 +1847,22 @@ class SwarmDownloader:
         # pieces remain, re-discover and retry. This is what lets two
         # leechers bootstrap off each other: whichever announces first
         # sees an empty swarm, and finds the other on the next round.
+        # BEP 19 webseeds run as independent workers for the life of
+        # the job: they claim pieces through the same swarm state, so
+        # rarest-first/endgame coordination covers them, and a job with
+        # zero reachable peers can still complete over HTTP
+        web_workers = [
+            threading.Thread(
+                target=self._web_seed_worker,
+                args=(url, swarm, token),
+                daemon=True,
+                name=f"webseed-{i}",
+            )
+            for i, url in enumerate(self._job.web_seeds)
+        ]
+        for worker in web_workers:
+            worker.start()
+
         # count CONSECUTIVE fruitless rounds: a round that completed
         # pieces proves the swarm is alive, so the budget resets — a
         # large torrent trickling through flaky peers must not be
@@ -1708,7 +1886,7 @@ class SwarmDownloader:
                     announce_event = ""
                 except TransferError as exc:
                     swarm.last_error = exc
-                    break  # every peer source is dead: fail now
+                    break  # every PEER source is dead (webseeds below)
             swarm.enqueue_discovered(peers)
             workers = [
                 threading.Thread(
@@ -1738,6 +1916,12 @@ class SwarmDownloader:
             time.sleep(min(0.2 * (fruitless_rounds + 1), 1.0))
             token.raise_if_cancelled()
             peers = None  # re-announce next round
+
+        # webseeds may still be mid-fetch when the peer rounds end —
+        # including the zero-peers case, where they're the only source
+        for worker in web_workers:
+            worker.join()
+        token.raise_if_cancelled()
 
         if not all(store.have):
             missing = store.have.count(False)
@@ -1796,6 +1980,72 @@ class SwarmDownloader:
                     )
             except TransferError:
                 pass  # best-effort: completion stats only
+
+    def _web_seed_worker(
+        self, url: str, swarm: "_SwarmState", token: CancelToken
+    ) -> None:
+        """One BEP 19 webseed: claim pieces like any worker, fetch them
+        over HTTP Range, verify through the same batch path. Tolerates
+        transient fetch failures (peers get retried via re-announce
+        rounds; a webseed's retry budget lives here) and gives up for
+        the job after 3 consecutive ones."""
+        source = _WebSeedSource()
+        batch = _PieceBatch(swarm, owner=source)
+        store = swarm.store
+        client = _WebSeedClient()
+        # cancellation must unblock an in-flight HTTP read immediately
+        # (the established pattern — HTTPBackend registers the same
+        # kind of hook on its response)
+        remove_hook = token.add_callback(client.close)
+        failures = 0
+        try:
+            while not token.cancelled() and not swarm.done():
+                index = swarm.claim(source)
+                if index is swarm.WAIT:
+                    batch.flush()
+                    time.sleep(0.05)
+                    continue
+                if index is None:
+                    break
+                try:
+                    data = _fetch_webseed_piece(client, url, store, index)
+                    failures = 0
+                except _WebSeedPermanent:
+                    swarm.release(index, source)
+                    raise  # retrying cannot fix a 4xx/redirect
+                except TransferError as exc:
+                    swarm.release(index, source)
+                    token.raise_if_cancelled()  # close() looks transient
+                    swarm.last_error = exc
+                    failures += 1
+                    if failures >= 3:
+                        raise
+                    time.sleep(0.2 * failures)
+                    continue
+                except BaseException:
+                    swarm.release(index, source)
+                    raise
+                batch.add(index, data)
+                if swarm.endgame:
+                    batch.flush()
+                swarm.tick_progress()
+            if not token.cancelled():
+                batch.flush()
+        except Cancelled:
+            return
+        except Exception as exc:
+            swarm.last_error = exc
+            log.with_fields(webseed=url).warning(f"webseed failed: {exc}")
+        finally:
+            remove_hook()
+            client.close()
+            if not token.cancelled():
+                try:
+                    batch.flush()
+                except Exception as exc:
+                    swarm.last_error = exc
+                    log.warning(f"webseed flush while unwinding failed: {exc}")
+            swarm.tick_progress()
 
     def _peer_worker(self, swarm: "_SwarmState", token: CancelToken) -> None:
         """One swarm worker: pull peers off the shared queue and serve

@@ -2198,3 +2198,159 @@ class TestPEX:
             assert ("10.4.5.6", 51413) in conn.pex_peers
         finally:
             listener.close()
+
+
+class _RangeHTTPServer:
+    """Static file server with HTTP Range support (python's built-in
+    handler has none); ``support_ranges=False`` ignores Range and
+    returns 200 + the whole file, like a bare static host."""
+
+    def __init__(
+        self,
+        files: dict[str, bytes],
+        support_ranges: bool = True,
+        delay: float = 0.0,
+    ):
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                import time as time_mod
+                import urllib.parse as up
+
+                if server.delay:
+                    time_mod.sleep(server.delay)
+                path = up.unquote(self.path.lstrip("/"))
+                body = files.get(path)
+                server.requests.append((path, self.headers.get("Range")))
+                if body is None:
+                    self.send_error(404)
+                    return
+                range_header = self.headers.get("Range")
+                if range_header and server.support_ranges:
+                    lo, hi = range_header.split("=")[1].split("-")
+                    lo, hi = int(lo), int(hi)
+                    chunk = body[lo : hi + 1]
+                    self.send_response(206)
+                    self.send_header(
+                        "Content-Range", f"bytes {lo}-{hi}/{len(body)}"
+                    )
+                    self.send_header("Content-Length", str(len(chunk)))
+                    self.end_headers()
+                    self.wfile.write(chunk)
+                else:
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+        self.requests: list = []
+        self.support_ranges = support_ranges
+        self.delay = delay
+        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self._server.server_address[1]}"
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class TestWebSeeds:
+    """BEP 19: HTTP servers as piece sources — a torrent job with zero
+    reachable peers completes over plain HTTP (anacrolix supports
+    webseeds; the reference inherits that)."""
+
+    def test_metainfo_url_list_and_magnet_ws_parsed(self):
+        _, meta, _ = make_torrent("movie.mkv", b"A" * 1000)
+        raw = decode(meta)
+        raw[b"url-list"] = [b"http://seed.example/d/", b"ftp://nope"]
+        job = parse_metainfo(encode(raw))
+        assert job.web_seeds == ("http://seed.example/d/",)
+        magnet_job = parse_magnet(
+            f"magnet:?xt=urn:btih:{'a' * 40}"
+            "&ws=http%3A%2F%2Fcdn%2Fmovie.mkv&ws=junk"
+        )
+        assert magnet_job.web_seeds == ("http://cdn/movie.mkv",)
+
+    def test_zero_peer_download_via_webseed(self, tmp_path):
+        payload = bytes(range(256)) * 600
+        with _RangeHTTPServer({"movie.mkv": payload}) as server:
+            _, meta, _ = make_torrent("movie.mkv", payload)
+            raw = decode(meta)
+            # directory-style webseed: name is appended per BEP 19
+            raw[b"url-list"] = (server.url + "/").encode()
+            job = parse_metainfo(encode(raw))
+            assert job.web_seeds
+            SwarmDownloader(
+                job,
+                str(tmp_path),
+                progress_interval=0.01,
+                dht_bootstrap=(),
+                seed_drain_timeout=0.2,
+            ).run(CancelToken(), lambda p: None)
+        assert (tmp_path / "movie.mkv").read_bytes() == payload
+        assert any(r[1] for r in server.requests), "no Range requests made"
+
+    def test_multi_file_webseed_with_range_ignoring_server(self, tmp_path):
+        """Multi-file layout over a server that IGNORES Range (bare
+        static host): the fetch discards the prefix and still produces
+        byte-exact files."""
+        files = {"season 1/e1.mkv": b"H" * 50_000, "notes.txt": b"I" * 999}
+        with _RangeHTTPServer(
+            {"pack/season 1/e1.mkv": files["season 1/e1.mkv"],
+             "pack/notes.txt": files["notes.txt"]},
+            support_ranges=False,
+        ) as server:
+            _, meta, _ = make_torrent("pack", files)
+            raw = decode(meta)
+            raw[b"url-list"] = [(server.url + "/").encode()]
+            job = parse_metainfo(encode(raw))
+            SwarmDownloader(
+                job,
+                str(tmp_path),
+                progress_interval=0.01,
+                dht_bootstrap=(),
+                seed_drain_timeout=0.2,
+            ).run(CancelToken(), lambda p: None)
+        assert (tmp_path / "pack/season 1/e1.mkv").read_bytes() == files["season 1/e1.mkv"]
+        assert (tmp_path / "pack/notes.txt").read_bytes() == files["notes.txt"]
+
+    def test_webseed_supplements_swarm(self, tmp_path):
+        """Peers and webseeds drain the same claim pool: both source
+        kinds contribute pieces to one job."""
+        payload = bytes(range(256)) * 4800  # 38 pieces
+        # comparable per-piece delays on BOTH sources, so neither can
+        # drain the whole claim pool before the other connects
+        with Seeder("movie.mkv", payload, serve_delay=0.005) as s:
+            with _RangeHTTPServer(
+                {"movie.mkv": payload}, delay=0.01
+            ) as server:
+                _, meta, _ = make_torrent("movie.mkv", payload)
+                raw = decode(meta)
+                raw[b"url-list"] = (server.url + "/").encode()
+                job = parse_metainfo(encode(raw))
+                import dataclasses
+
+                job = dataclasses.replace(
+                    job, peer_hints=(s.peer_address,)
+                )
+                SwarmDownloader(
+                    job,
+                    str(tmp_path),
+                    progress_interval=0.01,
+                    dht_bootstrap=(),
+                    seed_drain_timeout=0.2,
+                ).run(CancelToken(), lambda p: None)
+                both = bool(s.served_requests) and bool(server.requests)
+        assert (tmp_path / "movie.mkv").read_bytes() == payload
+        assert both, "expected both the peer and the webseed to serve"
