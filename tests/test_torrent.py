@@ -2354,3 +2354,65 @@ class TestWebSeeds:
                 both = bool(s.served_requests) and bool(server.requests)
         assert (tmp_path / "movie.mkv").read_bytes() == payload
         assert both, "expected both the peer and the webseed to serve"
+
+
+class TestMidDownloadCancellation:
+    def test_cancel_mid_swarm_tears_down_promptly(self, tmp_path):
+        """Cancel while pieces are in flight across peer workers, the
+        listener, and a webseed: run() must raise Cancelled within a
+        couple of seconds — no worker may linger on its socket timeout,
+        and nothing may keep writing into the job dir afterwards."""
+        import time as time_mod
+
+        from downloader_tpu.utils.cancel import Cancelled
+
+        data = bytes(range(256)) * 3200  # 25 pieces
+        # slow sources so the cancel lands mid-transfer for sure
+        with Seeder("movie.mkv", data, serve_delay=0.1) as s:
+            with _RangeHTTPServer({"movie.mkv": data}, delay=0.1) as server:
+                _, meta, _ = make_torrent("movie.mkv", data)
+                raw = decode(meta)
+                raw[b"url-list"] = (server.url + "/").encode()
+                import dataclasses
+
+                job = dataclasses.replace(
+                    parse_metainfo(encode(raw)), peer_hints=(s.peer_address,)
+                )
+                token = CancelToken()
+                outcome: dict = {}
+
+                def run():
+                    start = time_mod.monotonic()
+                    try:
+                        SwarmDownloader(
+                            job,
+                            str(tmp_path),
+                            progress_interval=0.01,
+                            dht_bootstrap=(),
+                        ).run(token, lambda p: None)
+                        outcome["result"] = "completed"
+                    except Cancelled:
+                        outcome["result"] = "cancelled"
+                    except Exception as exc:  # noqa: BLE001
+                        outcome["result"] = exc
+                    outcome["elapsed"] = time_mod.monotonic() - start
+
+                th = threading.Thread(target=run)
+                th.start()
+                time_mod.sleep(0.4)  # mid-download (25 pieces x 0.1s+)
+                cancel_at = time_mod.monotonic()
+                token.cancel()
+                th.join(timeout=10)
+                assert not th.is_alive(), "run() wedged after cancel"
+                teardown = time_mod.monotonic() - cancel_at
+        assert outcome["result"] == "cancelled", outcome
+        assert teardown < 3.0, f"teardown took {teardown:.1f}s"
+        # nothing kept writing after teardown: snapshot, wait, compare
+        snapshot = {
+            p: p.stat().st_size for p in tmp_path.rglob("*") if p.is_file()
+        }
+        time_mod.sleep(0.5)
+        after = {
+            p: p.stat().st_size for p in tmp_path.rglob("*") if p.is_file()
+        }
+        assert snapshot == after, "files changed after cancellation"
