@@ -1667,9 +1667,12 @@ class SwarmDownloader:
         if self._job.trackers:
             if token is not None:
                 token.raise_if_cancelled()
-            # announce to every tracker concurrently: real magnets carry
-            # many tr= entries, mostly dead, and each dead one costs its
-            # full timeout — serially that is minutes before DHT fires
+            # announce to every tracker concurrently — a deliberate
+            # divergence from BEP 12's try-tiers-in-order semantics:
+            # real magnets carry many tr= entries, mostly dead, and
+            # each dead one costs its full timeout — serially that is
+            # minutes before DHT fires. The cost is slightly more
+            # tracker traffic; the win is bounded discovery latency.
             with concurrent.futures.ThreadPoolExecutor(
                 max_workers=min(8, len(self._job.trackers)),
                 thread_name_prefix="announce",
