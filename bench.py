@@ -27,7 +27,9 @@ VM-backed disks writeback throttling (~200 MB/s here) otherwise floors
 both configurations at the disk's speed, hiding the framework entirely.
 Set BENCH_DIR to force a location (e.g. a real disk to measure that).
 
-Env knobs: BENCH_JOBS (default 24), BENCH_MB (MB per job, default 32),
+Env knobs: BENCH_JOBS (default 24), BENCH_MB (MB per job, default 48 —
+longer runs average the host's multi-second noise bursts, measured
+tightening per-pair ratio spread from ~0.1 to ~0.03),
 BENCH_CONCURRENCY (default 6), BENCH_SLICES (alternating sub-runs per
 pair, default 4), BENCH_REPEATS (pairs, default 5), BENCH_DIR (default
 /dev/shm if present).
@@ -313,7 +315,7 @@ def run_latency(site: str, samples: int, concurrency: int) -> float:
 
 def main() -> None:
     jobs = int(os.environ.get("BENCH_JOBS", 24))
-    mb_per_job = int(os.environ.get("BENCH_MB", 32))
+    mb_per_job = int(os.environ.get("BENCH_MB", 48))
     concurrency = int(os.environ.get("BENCH_CONCURRENCY", 6))
 
     site = tempfile.mkdtemp(prefix="bench-site-", dir=_bench_root())
