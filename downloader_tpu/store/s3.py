@@ -8,7 +8,10 @@ BucketLookupAuto, uploader.go:50).
 
 Operations implemented are exactly the reference's usage surface:
 ``bucket_exists`` + ``make_bucket`` (uploader.go:64-70) and ``put_object``
-streaming from a file (uploader.go:86-89).
+streaming from a file (uploader.go:86-89) — including the behavior
+minio-go gives the reference for free: objects above 64 MiB go through
+the multipart API (initiate / upload-part / complete, abort on failure),
+since a single PUT tops out at 5 GiB on real S3 and media files don't.
 """
 
 from __future__ import annotations
@@ -17,10 +20,11 @@ import hashlib
 import http.client
 import io
 import os
+import re
 import stat
 import time
 import urllib.parse
-from typing import BinaryIO
+from typing import BinaryIO, Mapping
 
 from ..utils import zero_copy_from_env
 from ..utils.cancel import CancelToken
@@ -30,6 +34,13 @@ from .credentials import Credentials
 
 _STREAM_CHUNK = 1024 * 1024
 _SENDFILE_WINDOW = 4 * 1024 * 1024
+
+# multipart sizing mirrors minio-go v6's optimalPartInfo: single PUT up
+# to 64 MiB, then parts of max(64 MiB, ceil(size/10000)) so any object
+# fits in S3's 10,000-part limit
+MULTIPART_THRESHOLD = 64 * 1024 * 1024
+_MAX_PARTS = 10_000
+_UPLOAD_ID_RE = re.compile(rb"<UploadId>([^<]+)</UploadId>")
 
 
 def _fileno_of(body) -> int | None:
@@ -66,6 +77,8 @@ class S3Client:
         region: str = "us-east-1",
         timeout: float = 60.0,
         zero_copy: bool = True,
+        multipart_threshold: int = MULTIPART_THRESHOLD,
+        part_size: int | None = None,
     ):
         self._host = endpoint
         self._credentials = credentials
@@ -75,6 +88,8 @@ class S3Client:
         # operator escape hatch (ZEROCOPY=off); the bench's baseline
         # uses it to emulate the reference's userspace upload path
         self._zero_copy = zero_copy
+        self._multipart_threshold = multipart_threshold
+        self._part_size = part_size  # None = derive per object
 
     @classmethod
     def from_endpoint_url(
@@ -119,7 +134,9 @@ class S3Client:
         payload_hash: str = sigv4.EMPTY_SHA256,
         content_type: str | None = None,
         token: CancelToken | None = None,
-    ) -> tuple[int, bytes]:
+        query: Mapping[str, str] | None = None,
+    ) -> tuple[int, bytes, dict[str, str]]:
+        query = dict(query or {})
         headers: dict[str, str] = {"Host": self._host}
         if content_type:
             headers["Content-Type"] = content_type
@@ -135,7 +152,7 @@ class S3Client:
             headers["Authorization"] = sigv4.sign(
                 method,
                 path,
-                {},
+                query,
                 headers,
                 payload_hash,
                 self._credentials.access_key,
@@ -146,13 +163,28 @@ class S3Client:
             )
 
         # sign with the raw path (SigV4 canonicalization encodes it once);
-        # percent-encode only for the request line
+        # percent-encode only for the request line. The query string on
+        # the wire must byte-match the signed canonical query, so encode
+        # it the same way sigv4.canonical_request does (sorted, quote
+        # with the RFC 3986 unreserved set — urlencode's '+' for space
+        # would break the signature)
         encoded_path = urllib.parse.quote(path, safe="/-._~")
+        if query:
+            encoded_path += "?" + "&".join(
+                f"{urllib.parse.quote(k, safe='-._~')}"
+                f"={urllib.parse.quote(v, safe='-._~')}"
+                for k, v in sorted(query.items())
+            )
         conn = self._connect()
         remove_hook = (
             token.add_callback(conn.close) if token is not None else lambda: None
         )
         try:
+            conn.connect()
+            # a cancellation callback closes the socket mid-request;
+            # http.client would silently REOPEN it on the next send and
+            # desync the exchange — make the close terminal instead
+            conn.auto_open = 0
             conn.putrequest(
                 method, encoded_path, skip_host=True, skip_accept_encoding=True
             )
@@ -162,7 +194,14 @@ class S3Client:
             if body is not None:
                 self._send_body(conn, body, content_length, token)
             response = conn.getresponse()
-            return response.status, response.read()
+            response_headers = {k.lower(): v for k, v in response.getheaders()}
+            return response.status, response.read(), response_headers
+        except (OSError, http.client.HTTPException):
+            if token is not None:
+                # the failure may BE the cancellation (closed-under-us
+                # socket); report it as such, not as a transport error
+                token.raise_if_cancelled()
+            raise
         finally:
             remove_hook()
             conn.close()
@@ -205,13 +244,18 @@ class S3Client:
                     remaining -= sent
             body.seek(offset)
             return
-        while True:
+        remaining = content_length
+        while remaining > 0:
             if token is not None:
                 token.raise_if_cancelled()
-            chunk = body.read(_STREAM_CHUNK)
+            # bound by Content-Length, not EOF: a multipart part's body is
+            # a window of a larger file, and reading to EOF would stream
+            # the rest of the file into one part
+            chunk = body.read(min(_STREAM_CHUNK, remaining))
             if not chunk:
                 break
             conn.send(chunk)
+            remaining -= len(chunk)
 
     @staticmethod
     def _object_path(bucket: str, key: str) -> str:
@@ -220,7 +264,7 @@ class S3Client:
     # -- API surface -----------------------------------------------------
 
     def bucket_exists(self, bucket: str) -> bool:
-        status, _ = self._request("HEAD", f"/{bucket}")
+        status, _, _ = self._request("HEAD", f"/{bucket}")
         if status in (200,):
             return True
         if status in (404,):
@@ -228,7 +272,7 @@ class S3Client:
         raise S3Error(status, f"HEAD bucket {bucket}")
 
     def make_bucket(self, bucket: str) -> None:
-        status, body = self._request("PUT", f"/{bucket}")
+        status, body, _ = self._request("PUT", f"/{bucket}")
         if status not in (200, 204):
             raise S3Error(status, body.decode(errors="replace")[:200])
 
@@ -245,7 +289,18 @@ class S3Client:
         """Streamed PUT, single pass over the data by default (signed as
         UNSIGNED-PAYLOAD, still SigV4-authenticated). ``sign_payload=True``
         opts into a signed content hash at the cost of reading seekable
-        streams twice — avoid for large media files."""
+        streams twice — avoid for large media files.
+
+        Objects larger than the multipart threshold take the multipart
+        API instead (requires a seekable stream), exactly as minio-go
+        does for the reference (uploader.go:86-89 via PutObjectWithContext
+        → putObjectMultipartStream above 64 MiB); ``sign_payload`` is
+        honored there per part."""
+        if size > self._multipart_threshold and stream.seekable():
+            self._put_multipart(
+                bucket, key, stream, size, content_type, token, sign_payload
+            )
+            return
         payload_hash = "UNSIGNED-PAYLOAD"
         if self._credentials.anonymous:
             payload_hash = sigv4.EMPTY_SHA256  # unused when unsigned
@@ -260,7 +315,7 @@ class S3Client:
             stream.seek(start)
             payload_hash = digest.hexdigest()
 
-        status, body = self._request(
+        status, body, _ = self._request(
             "PUT",
             self._object_path(bucket, key),
             body=stream,
@@ -274,3 +329,121 @@ class S3Client:
 
     def put_bytes(self, bucket: str, key: str, data: bytes, **kwargs) -> None:
         self.put_object(bucket, key, io.BytesIO(data), len(data), **kwargs)
+
+    # -- multipart upload ------------------------------------------------
+
+    def _derived_part_size(self, size: int) -> int:
+        if self._part_size is not None:
+            return self._part_size
+        # ceil(size / 10000), rounded up to a MiB, floored at the single-
+        # PUT threshold — minio-go v6 optimalPartInfo semantics
+        by_count = -(-size // _MAX_PARTS)
+        by_count = -(-by_count // (1024 * 1024)) * (1024 * 1024)
+        return max(self._multipart_threshold, by_count)
+
+    def _part_hash(self, stream: BinaryIO, start: int, length: int) -> str:
+        """sha256 of one part's window, restoring the stream position."""
+        digest = hashlib.sha256()
+        stream.seek(start)
+        remaining = length
+        while remaining > 0:
+            chunk = stream.read(min(_STREAM_CHUNK, remaining))
+            if not chunk:
+                break
+            digest.update(chunk)
+            remaining -= len(chunk)
+        stream.seek(start)
+        return digest.hexdigest()
+
+    def _put_multipart(
+        self,
+        bucket: str,
+        key: str,
+        stream: BinaryIO,
+        size: int,
+        content_type: str,
+        token: CancelToken | None,
+        sign_payload: bool = False,
+    ) -> None:
+        path = self._object_path(bucket, key)
+        status, body, _ = self._request(
+            "POST", path, query={"uploads": ""}, content_type=content_type,
+            token=token,
+        )
+        if status != 200:
+            raise S3Error(status, body.decode(errors="replace")[:200])
+        match = _UPLOAD_ID_RE.search(body)
+        if not match:
+            raise S3Error(status, "initiate multipart: no UploadId in response")
+        upload_id = match.group(1).decode()
+
+        part_size = self._derived_part_size(size)
+        payload_hash = (
+            sigv4.EMPTY_SHA256 if self._credentials.anonymous else "UNSIGNED-PAYLOAD"
+        )
+        base = stream.tell()
+        try:
+            etags: list[tuple[int, str]] = []
+            offset = 0
+            while offset < size:
+                if token is not None:
+                    token.raise_if_cancelled()
+                length = min(part_size, size - offset)
+                number = len(etags) + 1
+                stream.seek(base + offset)
+                if sign_payload and not self._credentials.anonymous:
+                    # honor the caller's opt-in per part: an extra read
+                    # pass over the window, same trade as the single-PUT
+                    # sign_payload path
+                    payload_hash = self._part_hash(stream, base + offset, length)
+                status, body, headers = self._request(
+                    "PUT",
+                    path,
+                    query={"partNumber": str(number), "uploadId": upload_id},
+                    body=stream,
+                    content_length=length,
+                    payload_hash=payload_hash,
+                    token=token,
+                )
+                if status not in (200, 201, 204):
+                    raise S3Error(
+                        status,
+                        f"part {number}: " + body.decode(errors="replace")[:200],
+                    )
+                etag = headers.get("etag", "")
+                if not etag:
+                    raise S3Error(status, f"part {number}: no ETag in response")
+                etags.append((number, etag))
+                offset += length
+
+            manifest = "".join(
+                f"<Part><PartNumber>{number}</PartNumber>"
+                f"<ETag>{etag}</ETag></Part>"
+                for number, etag in etags
+            )
+            complete = (
+                f"<CompleteMultipartUpload>{manifest}"
+                "</CompleteMultipartUpload>"
+            ).encode()
+            status, body, _ = self._request(
+                "POST",
+                path,
+                query={"uploadId": upload_id},
+                body=io.BytesIO(complete),
+                content_length=len(complete),
+                payload_hash=hashlib.sha256(complete).hexdigest(),
+                content_type="application/xml",
+                token=token,
+            )
+            # S3 can answer Complete with 200 + an <Error> document, so
+            # the status alone does not mean success
+            if status != 200 or b"<Error>" in body:
+                raise S3Error(status, body.decode(errors="replace")[:200])
+        except BaseException:
+            # best-effort abort so the store doesn't accrue orphaned
+            # part storage (no token: the abort must run on cancellation)
+            try:
+                self._request("DELETE", path, query={"uploadId": upload_id})
+            except Exception:
+                pass
+            raise

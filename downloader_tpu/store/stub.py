@@ -2,11 +2,11 @@
 
 The reference has no test double for its uploader (SURVEY.md §4 notes zero
 uploader tests); this stub is the rebuild's answer — a real HTTP server
-speaking just enough S3 (HEAD/PUT bucket, PUT/GET object, path-style) to
-exercise the client end-to-end, including SigV4 verification: when
-constructed with credentials it recomputes the signature from the received
-request and rejects mismatches with 403, so canonicalization bugs in the
-client surface as test failures.
+speaking just enough S3 (HEAD/PUT bucket, PUT/GET object, the multipart
+upload API, path-style) to exercise the client end-to-end, including SigV4
+verification: when constructed with credentials it recomputes the signature
+from the received request and rejects mismatches with 403, so
+canonicalization bugs in the client surface as test failures.
 """
 
 from __future__ import annotations
@@ -46,6 +46,12 @@ class S3Stub:
         self.credentials = credentials
         self.retain_objects = retain_objects
         self.buckets: dict[str, dict[str, bytes]] = {}
+        # pending multipart uploads: (bucket, key, upload_id) ->
+        # {part_number: (etag, body)}; completed_multiparts counts
+        # assemblies so tests can assert the multipart path actually ran
+        self.uploads: dict[tuple[str, str, str], dict[int, tuple[str, bytes]]] = {}
+        self.completed_multiparts = 0
+        self._upload_seq = 0
         self.lock = threading.Lock()
         stub = self
 
@@ -170,11 +176,10 @@ class S3Stub:
                     if received != payload_hash:
                         return False
                 parsed = urllib.parse.urlparse(self.path)
-                query = dict(urllib.parse.parse_qsl(parsed.query))
                 expected = sigv4.sign(
                     self.command,
                     urllib.parse.unquote(parsed.path),
-                    query,
+                    self._query(),
                     headers,
                     payload_hash,
                     stub.credentials.access_key,
@@ -192,6 +197,17 @@ class S3Stub:
                 key = parts[1] if len(parts) > 1 else ""
                 return bucket, key
 
+            def _query(self) -> dict[str, str]:
+                # keep_blank_values: '?uploads=' signs as {'uploads': ''}
+                # and dropping it would recompute a different signature
+                # in _verify_auth (and mis-route multipart initiates)
+                return dict(
+                    urllib.parse.parse_qsl(
+                        urllib.parse.urlparse(self.path).query,
+                        keep_blank_values=True,
+                    )
+                )
+
             def do_HEAD(self):
                 bucket, key = self._route()
                 with stub.lock:
@@ -205,13 +221,18 @@ class S3Stub:
                 if stub.retain_objects:
                     body: bytes | bytearray = self._read_body()
                     digest = None
+                    read = len(body)
                 else:
-                    _, digest = self._drain_body()
+                    read, digest = self._drain_body()
                     body = b""
                 if not self._verify_auth(body, digest):
                     self._reject(403, "SignatureDoesNotMatch")
                     return
                 bucket, key = self._route()
+                query = self._query()
+                if "partNumber" in query and "uploadId" in query:
+                    self._put_part(bucket, key, query, bytes(body), read)
+                    return
                 with stub.lock:
                     if not key:
                         stub.buckets.setdefault(bucket, {})
@@ -222,6 +243,113 @@ class S3Stub:
                         return
                     stub.buckets[bucket][key] = body
                 self._reject(200)
+
+            def _put_part(
+                self,
+                bucket: str,
+                key: str,
+                query: dict[str, str],
+                body: bytes,
+                read: int,
+            ) -> None:
+                upload = (bucket, key, query["uploadId"])
+                # real S3 ETags for simple parts are the MD5; in drain
+                # mode there is no body, so tag by length — the client
+                # treats the value as opaque and echoes it on Complete
+                etag = (
+                    '"%s"' % hashlib.md5(body).hexdigest()
+                    if stub.retain_objects
+                    else f'"len-{read}"'
+                )
+                with stub.lock:
+                    parts = stub.uploads.get(upload)
+                    if parts is None:
+                        self._reject(404, "NoSuchUpload")
+                        return
+                    parts[int(query["partNumber"])] = (etag, body)
+                self.send_response(200)
+                self.send_header("ETag", etag)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_POST(self):
+                body = bytes(self._read_body())
+                if not self._verify_auth(body):
+                    self._reject(403, "SignatureDoesNotMatch")
+                    return
+                bucket, key = self._route()
+                query = self._query()
+                if "uploads" in query:
+                    with stub.lock:
+                        if bucket not in stub.buckets:
+                            self._reject(404, "NoSuchBucket")
+                            return
+                        stub._upload_seq += 1
+                        upload_id = f"upload-{stub._upload_seq}"
+                        stub.uploads[(bucket, key, upload_id)] = {}
+                    payload = (
+                        "<InitiateMultipartUploadResult>"
+                        f"<Bucket>{bucket}</Bucket><Key>{key}</Key>"
+                        f"<UploadId>{upload_id}</UploadId>"
+                        "</InitiateMultipartUploadResult>"
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                if "uploadId" in query:
+                    self._complete_multipart(bucket, key, query["uploadId"], body)
+                    return
+                self._reject(400, "unsupported POST")
+
+            def _complete_multipart(
+                self, bucket: str, key: str, upload_id: str, manifest: bytes
+            ) -> None:
+                claimed = re.findall(
+                    rb"<PartNumber>(\d+)</PartNumber>\s*<ETag>([^<]+)</ETag>",
+                    manifest,
+                )
+                with stub.lock:
+                    parts = stub.uploads.pop((bucket, key, upload_id), None)
+                    if parts is None:
+                        self._reject(404, "NoSuchUpload")
+                        return
+                    for number_raw, etag_raw in claimed:
+                        stored = parts.get(int(number_raw))
+                        if stored is None or stored[0] != etag_raw.decode():
+                            self._reject(400, "InvalidPart")
+                            return
+                    if len(claimed) != len(parts):
+                        self._reject(400, "InvalidPartOrder")
+                        return
+                    assembled = b"".join(
+                        parts[number][1] for number in sorted(parts)
+                    )
+                    stub.buckets.setdefault(bucket, {})[key] = assembled
+                    stub.completed_multiparts += 1
+                payload = (
+                    "<CompleteMultipartUploadResult>"
+                    f"<Bucket>{bucket}</Bucket><Key>{key}</Key>"
+                    "</CompleteMultipartUploadResult>"
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_DELETE(self):
+                if not self._verify_auth(b""):
+                    self._reject(403, "SignatureDoesNotMatch")
+                    return
+                bucket, key = self._route()
+                query = self._query()
+                if "uploadId" in query:
+                    with stub.lock:
+                        stub.uploads.pop((bucket, key, query["uploadId"]), None)
+                    self._reject(204)
+                    return
+                self._reject(400, "unsupported DELETE")
 
             def do_GET(self):
                 bucket, key = self._route()

@@ -216,6 +216,158 @@ class TestUploader:
         assert object_key("id1", "/x/y/movie.mkv") == "id1/original/bW92aWUubWt2"
 
 
+class TestMultipart:
+    """The multipart path mirrors what minio-go v6 gives the reference for
+    free (uploader.go:86-89 → putObjectMultipartStream above 64 MiB):
+    initiate / upload parts / complete, abort on failure."""
+
+    def test_large_object_roundtrip(self, stub):
+        client = S3Client(
+            stub.endpoint,
+            CREDS,
+            multipart_threshold=256 * 1024,
+            part_size=100 * 1024,
+        )
+        client.make_bucket("b")
+        data = os.urandom(350 * 1024)  # 100k + 100k + 100k + 50k parts
+        client.put_object("b", "big.mkv", io.BytesIO(data), len(data))
+        assert bytes(stub.buckets["b"]["big.mkv"]) == data
+        assert stub.completed_multiparts == 1
+        assert not stub.uploads  # nothing left pending
+
+    def test_small_object_stays_single_put(self, stub):
+        client = S3Client(stub.endpoint, CREDS, multipart_threshold=256 * 1024)
+        client.make_bucket("b")
+        client.put_bytes("b", "small", b"x" * 1024)
+        assert stub.completed_multiparts == 0
+
+    def test_sendfile_parts_respect_boundaries(self, stub, tmp_path):
+        """A real file takes the zero-copy sendfile path per part; each
+        part must ship exactly its window of the file."""
+        data = os.urandom(300 * 1024 + 123)
+        path = tmp_path / "big.bin"
+        path.write_bytes(data)
+        client = S3Client(
+            stub.endpoint,
+            CREDS,
+            multipart_threshold=128 * 1024,
+            part_size=128 * 1024,
+        )
+        client.make_bucket("b")
+        with open(path, "rb") as stream:
+            client.put_object("b", "k", stream, len(data))
+        assert bytes(stub.buckets["b"]["k"]) == data
+        assert stub.completed_multiparts == 1
+
+    def test_userspace_parts_respect_boundaries(self, stub):
+        """BytesIO bodies take the copy loop, which must stop at the
+        part's Content-Length instead of streaming to EOF."""
+        client = S3Client(
+            stub.endpoint,
+            CREDS,
+            multipart_threshold=64 * 1024,
+            part_size=64 * 1024,
+            zero_copy=False,
+        )
+        client.make_bucket("b")
+        data = os.urandom(200 * 1024)
+        client.put_object("b", "k", io.BytesIO(data), len(data))
+        assert bytes(stub.buckets["b"]["k"]) == data
+
+    def test_cancellation_aborts_pending_upload(self, stub):
+        """Cancelling mid-upload must abort the multipart upload so the
+        store doesn't accrue orphaned part storage."""
+        token = CancelToken()
+
+        class CancelAfterFirstRead(io.BytesIO):
+            def read(self, *args):
+                chunk = super().read(*args)
+                if self.tell() >= 100 * 1024:
+                    token.cancel()
+                return chunk
+
+        client = S3Client(
+            stub.endpoint,
+            CREDS,
+            multipart_threshold=128 * 1024,
+            part_size=100 * 1024,
+        )
+        client.make_bucket("b")
+        from downloader_tpu.utils.cancel import Cancelled
+
+        with pytest.raises(Cancelled):
+            client.put_object(
+                "b",
+                "doomed",
+                CancelAfterFirstRead(os.urandom(500 * 1024)),
+                500 * 1024,
+                token=token,
+            )
+        assert not stub.uploads, "cancelled upload was not aborted"
+        assert "doomed" not in stub.buckets.get("b", {})
+
+    def test_anonymous_multipart(self):
+        with S3Stub() as open_stub:
+            client = S3Client(
+                open_stub.endpoint,
+                Credentials(),
+                multipart_threshold=64 * 1024,
+                part_size=64 * 1024,
+            )
+            client.make_bucket("pub")
+            data = os.urandom(150 * 1024)
+            client.put_object("pub", "k", io.BytesIO(data), len(data))
+            assert bytes(open_stub.buckets["pub"]["k"]) == data
+
+    def test_derived_part_size_matches_minio_semantics(self):
+        from downloader_tpu.store.s3 import MULTIPART_THRESHOLD
+
+        client = S3Client("host", Credentials())
+        # small enough: floor at the 64 MiB threshold
+        assert client._derived_part_size(100 * 1024 * 1024) == MULTIPART_THRESHOLD
+        # huge object: ceil(size/10000) rounded up to a MiB keeps the
+        # part count within S3's 10,000-part limit
+        huge = 10_000 * MULTIPART_THRESHOLD + 1
+        part = client._derived_part_size(huge)
+        assert part > MULTIPART_THRESHOLD
+        assert part % (1024 * 1024) == 0
+        assert -(-huge // part) <= 10_000
+
+    def test_sign_payload_honored_per_part(self, stub):
+        """sign_payload=True must survive the multipart dispatch: each
+        part carries its own signed content hash, which the stub
+        verifies against the received bytes."""
+        client = S3Client(
+            stub.endpoint,
+            CREDS,
+            multipart_threshold=64 * 1024,
+            part_size=64 * 1024,
+        )
+        client.make_bucket("b")
+        data = os.urandom(150 * 1024)
+        client.put_object(
+            "b", "k", io.BytesIO(data), len(data), sign_payload=True
+        )
+        assert bytes(stub.buckets["b"]["k"]) == data
+        assert stub.completed_multiparts == 1
+
+    def test_drain_mode_multipart(self):
+        """The bench's non-retaining stub must handle multipart too:
+        parts drained, ETags by length, object recorded empty."""
+        with S3Stub(credentials=CREDS, retain_objects=False) as drain_stub:
+            client = S3Client(
+                drain_stub.endpoint,
+                CREDS,
+                multipart_threshold=64 * 1024,
+                part_size=64 * 1024,
+            )
+            client.make_bucket("b")
+            data = os.urandom(150 * 1024)
+            client.put_object("b", "k", io.BytesIO(data), len(data))
+            assert drain_stub.completed_multiparts == 1
+            assert drain_stub.buckets["b"]["k"] == b""
+
+
 def test_signed_payload_opt_in(tmp_path):
     with S3Stub(credentials=CREDS) as stub:
         client = S3Client(stub.endpoint, CREDS)
