@@ -1104,13 +1104,13 @@ def _fetch_webseed_piece(
 class _InboundPeer:
     """One accepted connection: handshake, then serve the remote leecher.
 
-    Policy is serve-everyone: INTERESTED is answered with UNCHOKE as
-    soon as a PieceStore is attached (no tit-for-tat slots — a
-    job-scoped swarm has nothing to ration), REQUESTs for completed
-    pieces are answered from the store, and ut_metadata requests are
-    served from the raw info dict so magnet-only peers can bootstrap
-    metadata from us (BEP 9) — all behavior the reference gets from
-    anacrolix's full client (torrent.go:44).
+    INTERESTED is answered with UNCHOKE when the listener grants an
+    upload slot (PeerListener's choker — slot-bounded with an optimistic
+    rotation, the shape anacrolix's choking algorithm gives the
+    reference, torrent.go:44); REQUESTs for completed pieces are
+    answered from the store, and ut_metadata requests are served from
+    the raw info dict so magnet-only peers can bootstrap metadata from
+    us (BEP 9).
     """
 
     def __init__(self, listener: "PeerListener", sock: socket.socket, addr):
@@ -1127,6 +1127,10 @@ class _InboundPeer:
         self.remote_peer_id = b""  # set once the handshake arrives
         self.remote_supports_fast = False  # BEP 6, from the handshake
         self._unchoked = False
+        # total bytes served to this peer; the choker's fairness key.
+        # Written by the serve thread, read by the rechoke thread — a
+        # plain int is fine, a stale read only shifts one ranking round
+        self.bytes_to_peer = 0
         self._remote_ext: dict[bytes, int] = {}
         # nothing may be written before our handshake reply is on the
         # wire: attach()/HAVE broadcasts land mid-handshake otherwise
@@ -1207,12 +1211,25 @@ class _InboundPeer:
         store, _ = self._listener.snapshot()
         if store is None or not self.interested:
             return  # defer: nothing to serve until attach
-        # benign race: two callers can both pass this check and enqueue
-        # a duplicate UNCHOKE, which the protocol tolerates
+        self._listener.request_unchoke(self)
+
+    def grant_unchoke(self) -> None:
+        """Choker decision: this peer holds an upload slot now.
+        Benign race: two callers can both pass the check and enqueue a
+        duplicate UNCHOKE, which the protocol tolerates."""
         if self._unchoked:
             return
         self._unchoked = True
         self._enqueue(_frame(MSG_UNCHOKE))
+
+    def revoke_unchoke(self) -> None:
+        """Choker decision: slot lost; the remote must stop requesting
+        (requests that race the CHOKE are REJECTed/dropped by
+        _serve_request's _unchoked check)."""
+        if not self._unchoked:
+            return
+        self._unchoked = False
+        self._enqueue(_frame(MSG_CHOKE))
 
     def close(self) -> None:
         try:
@@ -1315,6 +1332,8 @@ class _InboundPeer:
                 self._maybe_unchoke()
             elif msg_id == MSG_NOT_INTERESTED:
                 self.interested = False
+                # a finished leecher frees its slot; let a waiting one in
+                self._listener.poke_choker()
             elif msg_id == MSG_REQUEST and len(payload) == 12:
                 self._serve_request(payload)
             elif msg_id == MSG_EXTENDED and payload:
@@ -1339,6 +1358,7 @@ class _InboundPeer:
             return
         # count before the send: a reader that saw the PIECE frame must
         # also see it counted (the reverse order races observers)
+        self.bytes_to_peer += len(block)
         self._listener.count_block(len(block))
         self._send(MSG_PIECE, struct.pack(">II", index, begin) + block)
 
@@ -1426,10 +1446,17 @@ class PeerListener:
         host: str = "0.0.0.0",
         port: int = 0,
         max_inbound: int = 32,
+        max_unchoked: int = 8,
+        rechoke_interval: float = 10.0,
     ):
         self.info_hash = info_hash
         self.peer_id = peer_id
         self._max_inbound = max_inbound
+        # upload-slot choker (see _rechoke): at most this many inbound
+        # leechers are unchoked at once
+        self._max_unchoked = max_unchoked
+        self._rechoke_interval = rechoke_interval
+        self._choker_wake = threading.Event()
         self._store: PieceStore | None = None
         self._info_bytes: bytes | None = None
         self._peer_source = None  # ut_pex gossip source (attach)
@@ -1453,6 +1480,11 @@ class PeerListener:
             daemon=True,
             name=f"peer-listen-{self.port}",
         ).start()
+        threading.Thread(
+            target=self._choker_loop,
+            daemon=True,
+            name=f"peer-choker-{self.port}",
+        ).start()
 
     def _accept_loop(self) -> None:
         while True:
@@ -1474,6 +1506,73 @@ class PeerListener:
                 daemon=True,
                 name=f"peer-inbound-{addr[0]}:{addr[1]}",
             ).start()
+
+    # -- choker ----------------------------------------------------------
+    #
+    # Upload slots are rationed the way anacrolix's choking algorithm
+    # does for the reference (torrent.go:44): at most ``max_unchoked``
+    # inbound leechers hold a slot. Regular slots go to the interested
+    # peers served the LEAST so far (max-min fairness — a swarm's tail
+    # catches up instead of starving), and when oversubscribed one slot
+    # is optimistic: rotated randomly each interval so newcomers get
+    # bandwidth and a chance to prove themselves, per the canonical
+    # BitTorrent choking design.
+
+    def request_unchoke(self, conn: _InboundPeer) -> None:
+        """Immediate grant when a slot is free, so small swarms (and the
+        common single-leecher case) never wait out a rechoke interval;
+        oversubscribed arrivals stay choked until rotation. Decision and
+        flag flip are atomic under the lock — two racing INTERESTED
+        arrivals must not both take the last slot."""
+        with self._lock:
+            if self._closed or self._store is None:
+                return
+            holders = sum(1 for c in self._conns if c._unchoked)
+            if holders >= self._max_unchoked:
+                return
+            conn.grant_unchoke()
+
+    def poke_choker(self) -> None:
+        """Wake the choker now (slot freed: NOT_INTERESTED/disconnect)."""
+        self._choker_wake.set()
+
+    def _choker_loop(self) -> None:
+        while True:
+            self._choker_wake.wait(timeout=self._rechoke_interval)
+            self._choker_wake.clear()
+            with self._lock:
+                if self._closed:
+                    return
+            self._rechoke()
+
+    def _rechoke(self) -> None:
+        # the whole redistribution runs under the lock so the slot count
+        # can never transiently exceed the cap against request_unchoke
+        with self._lock:
+            if self._store is None:
+                return
+            conns = list(self._conns)
+            if self._max_unchoked <= 0:
+                # uploading disabled: the slicing below would invert the
+                # cap (ranked[:-1] + choice = everyone wins)
+                for conn in conns:
+                    if conn._unchoked:
+                        conn.revoke_unchoke()
+                return
+            candidates = [c for c in conns if c.interested]
+            if len(candidates) <= self._max_unchoked:
+                winners = set(candidates)
+            else:
+                ranked = sorted(candidates, key=lambda c: c.bytes_to_peer)
+                winners = set(ranked[: self._max_unchoked - 1])
+                # the optimistic slot: uniform over the rest
+                winners.add(random.choice(ranked[self._max_unchoked - 1 :]))
+            for conn in conns:
+                if conn in winners:
+                    conn.grant_unchoke()
+                elif conn._unchoked:
+                    # lost the slot (or went NOT_INTERESTED while unchoked)
+                    conn.revoke_unchoke()
 
     # -- serving state ---------------------------------------------------
 
@@ -1535,6 +1634,8 @@ class PeerListener:
                 # Keyed by peer_id, not ip: several leechers can sit
                 # behind one NAT/host and must be counted separately.
                 self._finished_leecher_ids.add(conn.remote_peer_id)
+        # a departing peer may have held an upload slot
+        self.poke_choker()
 
     def active_leechers(self) -> int:
         with self._lock:
@@ -1567,6 +1668,7 @@ class PeerListener:
             if self._closed and self._sock.fileno() < 0:
                 return  # idempotent
             self._closed = True
+        self._choker_wake.set()  # let the choker thread observe _closed
         try:
             self._sock.close()
         except OSError:

@@ -119,11 +119,13 @@ class S3Client:
 
     # -- request plumbing ------------------------------------------------
 
-    def _connect(self) -> http.client.HTTPConnection:
+    def _connect(self, timeout: float | None = None) -> http.client.HTTPConnection:
         conn_cls = (
             http.client.HTTPSConnection if self._secure else http.client.HTTPConnection
         )
-        return conn_cls(self._host, timeout=self._timeout)
+        return conn_cls(
+            self._host, timeout=self._timeout if timeout is None else timeout
+        )
 
     def _request(
         self,
@@ -135,6 +137,7 @@ class S3Client:
         content_type: str | None = None,
         token: CancelToken | None = None,
         query: Mapping[str, str] | None = None,
+        timeout: float | None = None,
     ) -> tuple[int, bytes, dict[str, str]]:
         query = dict(query or {})
         headers: dict[str, str] = {"Host": self._host}
@@ -175,7 +178,7 @@ class S3Client:
                 f"={urllib.parse.quote(v, safe='-._~')}"
                 for k, v in sorted(query.items())
             )
-        conn = self._connect()
+        conn = self._connect(timeout)
         remove_hook = (
             token.add_callback(conn.close) if token is not None else lambda: None
         )
@@ -441,9 +444,17 @@ class S3Client:
                 raise S3Error(status, body.decode(errors="replace")[:200])
         except BaseException:
             # best-effort abort so the store doesn't accrue orphaned
-            # part storage (no token: the abort must run on cancellation)
+            # part storage. No token — the abort must run even ON
+            # cancellation — but a short timeout so a black-holed
+            # endpoint can't park a cancelled caller for the full
+            # client timeout (prompt teardown beats a guaranteed abort)
             try:
-                self._request("DELETE", path, query={"uploadId": upload_id})
+                self._request(
+                    "DELETE",
+                    path,
+                    query={"uploadId": upload_id},
+                    timeout=min(self._timeout, 5.0),
+                )
             except Exception:
                 pass
             raise

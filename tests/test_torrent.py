@@ -10,6 +10,7 @@ import os
 import socket
 import struct
 import threading
+import time
 
 import pytest
 
@@ -1358,6 +1359,174 @@ class _StubConn:
 
     def queue_have(self, index: int) -> None:
         pass  # registered conns must take swarm HAVE broadcasts
+
+
+class TestChoker:
+    """Upload-slot choker: at most max_unchoked inbound leechers hold a
+    slot (regular slots by least-served fairness, one optimistic slot
+    rotated when oversubscribed) — the shape anacrolix's choking
+    algorithm gives the reference (torrent.go:44)."""
+
+    PIECE = 32 * 1024
+
+    def _seeded_listener(self, tmp_path, data, **kwargs):
+        info, _, _ = make_torrent("movie.mkv", data, self.PIECE)
+        store = PieceStore(info, str(tmp_path))
+        for i in range(store.num_pieces):
+            store.write_piece(
+                i, data[i * self.PIECE : i * self.PIECE + store.piece_size(i)]
+            )
+        info_bytes = encode(info)
+        info_hash = hashlib.sha1(info_bytes).digest()
+        listener = PeerListener(info_hash, generate_peer_id(), **kwargs)
+        listener.attach(store, info_bytes)
+        return listener, info_hash
+
+    def _interested_conn(self, listener, info_hash):
+        from downloader_tpu.fetch.peer import MSG_INTERESTED, PeerConnection
+
+        conn = PeerConnection(
+            "127.0.0.1",
+            listener.port,
+            info_hash,
+            generate_peer_id(),
+            CancelToken(),
+            timeout=5,
+        )
+        conn.send_message(MSG_INTERESTED)
+        return conn
+
+    def test_slot_cap_enforced(self, tmp_path):
+        """Four interested leechers, two slots: exactly two unchoked;
+        the rest stay choked (no rotation: long interval)."""
+        data = bytes(range(256)) * 300
+        listener, info_hash = self._seeded_listener(
+            tmp_path, data, max_unchoked=2, rechoke_interval=60.0
+        )
+        conns = []
+        try:
+            for _ in range(4):
+                conns.append(self._interested_conn(listener, info_hash))
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                for conn in conns:
+                    conn.poll_messages(0.05)
+                if sum(1 for c in conns if not c.choked) == 2:
+                    break
+            assert sum(1 for c in conns if not c.choked) == 2
+            # and it never exceeds the cap from the listener's own view
+            with listener._lock:
+                assert (
+                    sum(1 for c in listener._conns if c._unchoked) <= 2
+                )
+        finally:
+            for conn in conns:
+                conn.close()
+            listener.close()
+
+    def test_slot_freed_on_disconnect(self, tmp_path):
+        """When an unchoked leecher disconnects, a waiting choked one
+        gets its slot promptly (discard pokes the choker)."""
+        data = bytes(range(256)) * 300
+        listener, info_hash = self._seeded_listener(
+            tmp_path, data, max_unchoked=1, rechoke_interval=60.0
+        )
+        first = self._interested_conn(listener, info_hash)
+        second = None
+        try:
+            deadline = time.monotonic() + 5.0
+            while first.choked and time.monotonic() < deadline:
+                first.poll_messages(0.05)
+            assert not first.choked
+            second = self._interested_conn(listener, info_hash)
+            second.poll_messages(0.3)
+            assert second.choked  # slot taken
+            first.close()
+            deadline = time.monotonic() + 5.0
+            while second.choked and time.monotonic() < deadline:
+                second.poll_messages(0.05)
+            assert not second.choked
+        finally:
+            for conn in (first, second):
+                if conn is not None:
+                    conn.close()
+            listener.close()
+
+    def test_optimistic_rotation_reaches_everyone(self, tmp_path):
+        """One slot, three starving leechers, fast rotation: the
+        optimistic slot must reach more than one of them, and a peer
+        that loses its slot sees a real CHOKE frame."""
+        data = bytes(range(256)) * 300
+        listener, info_hash = self._seeded_listener(
+            tmp_path, data, max_unchoked=1, rechoke_interval=0.1
+        )
+        conns = []
+        try:
+            for _ in range(3):
+                conns.append(self._interested_conn(listener, info_hash))
+            ever_unchoked = [False] * 3
+            choked_after_unchoke = False
+            deadline = time.monotonic() + 8.0
+            while time.monotonic() < deadline:
+                for i, conn in enumerate(conns):
+                    conn.poll_messages(0.05)
+                    if not conn.choked:
+                        ever_unchoked[i] = True
+                    elif ever_unchoked[i]:
+                        choked_after_unchoke = True
+                if sum(ever_unchoked) >= 2 and choked_after_unchoke:
+                    break
+            assert sum(ever_unchoked) >= 2, ever_unchoked
+            assert choked_after_unchoke
+        finally:
+            for conn in conns:
+                conn.close()
+            listener.close()
+
+    def test_zero_slots_means_no_uploads(self, tmp_path):
+        """max_unchoked=0 disables uploading entirely — the rechoke
+        slicing must not invert the cap into unchoke-everyone."""
+        data = bytes(range(256)) * 300
+        listener, info_hash = self._seeded_listener(
+            tmp_path, data, max_unchoked=0, rechoke_interval=0.1
+        )
+        conn = self._interested_conn(listener, info_hash)
+        try:
+            deadline = time.monotonic() + 1.0
+            while time.monotonic() < deadline:
+                conn.poll_messages(0.05)
+                assert conn.choked
+        finally:
+            conn.close()
+            listener.close()
+
+    def test_not_interested_frees_slot(self, tmp_path):
+        from downloader_tpu.fetch.peer import MSG_NOT_INTERESTED
+
+        data = bytes(range(256)) * 300
+        listener, info_hash = self._seeded_listener(
+            tmp_path, data, max_unchoked=1, rechoke_interval=60.0
+        )
+        first = self._interested_conn(listener, info_hash)
+        second = None
+        try:
+            deadline = time.monotonic() + 5.0
+            while first.choked and time.monotonic() < deadline:
+                first.poll_messages(0.05)
+            assert not first.choked
+            second = self._interested_conn(listener, info_hash)
+            second.poll_messages(0.3)
+            assert second.choked
+            first.send_message(MSG_NOT_INTERESTED)
+            deadline = time.monotonic() + 5.0
+            while second.choked and time.monotonic() < deadline:
+                second.poll_messages(0.05)
+            assert not second.choked
+        finally:
+            for conn in (first, second):
+                if conn is not None:
+                    conn.close()
+            listener.close()
 
 
 class TestPieceSelection:
