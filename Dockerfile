@@ -9,11 +9,18 @@ COPY pyproject.toml README.md ./
 COPY downloader_tpu ./downloader_tpu
 RUN pip install --no-cache-dir build && \
     python -m build --wheel --outdir /dist
+# native RC4 core for MSE peer encryption: compile in the builder so
+# the slim runtime (no compiler) doesn't fall back to pure Python
+RUN apk add --no-cache build-base && \
+    gcc -O2 -shared -fPIC -o /dist/_rc4.so downloader_tpu/fetch/_rc4.c
 
 FROM python:3.12-alpine
 RUN adduser -D -u 1000 downloader
 COPY --from=builder /dist/*.whl /tmp/
 RUN pip install --no-cache-dir /tmp/*.whl && rm /tmp/*.whl
+COPY --from=builder /dist/_rc4.so /tmp/_rc4.so
+RUN cp /tmp/_rc4.so "$(python -c 'import downloader_tpu.fetch as f, os; print(os.path.dirname(os.path.abspath(f.__file__)))')/_rc4.so" && \
+    rm /tmp/_rc4.so
 USER downloader
 WORKDIR /home/downloader
 # same operational contract as the reference image (Dockerfile:17-18:

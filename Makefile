@@ -8,9 +8,22 @@ APP         := downloader
 BINDIR      := bin
 DOCKER_IMAGE ?= downloader-tpu
 
-.PHONY: all dep build wheel docker-build fmt fmt-fix test bench clean
+.PHONY: all dep build native wheel docker-build fmt fmt-fix test bench clean
 
-all: dep build
+all: dep native build
+
+# Native RC4 core for MSE peer encryption (fetch/_rc4.c). The loader
+# (fetch/rc4_native.py) also compiles this lazily at first use and
+# falls back to pure Python, so this target is an optimization: build
+# ahead of time (e.g. in the Docker image) so the first encrypted peer
+# connection doesn't pay the compile.
+native:
+	@if command -v cc >/dev/null 2>&1; then \
+	  cc -O2 -shared -fPIC -o downloader_tpu/fetch/_rc4.so downloader_tpu/fetch/_rc4.c && \
+	  echo "built downloader_tpu/fetch/_rc4.so"; \
+	else \
+	  echo "native: no C compiler; MSE RC4 will use the pure-Python fallback"; \
+	fi
 
 # The reference's `make dep` fetches Go modules (Makefile:31-33). Runtime
 # deps here are stdlib-only (jax optional); this just verifies the tree
@@ -25,6 +38,7 @@ build:
 	mkdir -p $(BINDIR)/.staging
 	cp -r downloader_tpu $(BINDIR)/.staging/
 	find $(BINDIR)/.staging -name '__pycache__' -type d -exec rm -rf {} +
+	find $(BINDIR)/.staging -name '*.so' -delete  # ctypes can't load from a zipapp; rc4_native falls back cleanly
 	printf 'from downloader_tpu.cli import main\nimport sys\nsys.exit(main())\n' \
 	  > $(BINDIR)/.staging/__main__.py
 	$(PYTHON) -m zipapp $(BINDIR)/.staging -o $(BINDIR)/$(APP).pyz \

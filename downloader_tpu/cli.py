@@ -117,6 +117,24 @@ def _dht_bootstrap_from_env() -> tuple[tuple[str, int], ...] | None:
     return tuple(nodes)
 
 
+def _encryption_from_env() -> str:
+    """PEER_ENCRYPTION env: MSE policy off|allow|prefer|require
+    (default allow — accept both inbound, plaintext-first outbound
+    with MSE fallback, matching anacrolix's default posture)."""
+    from .fetch.peer import ENCRYPTION_MODES
+
+    raw = os.environ.get("PEER_ENCRYPTION", "").strip().lower()
+    if not raw:
+        return "allow"
+    if raw not in ENCRYPTION_MODES:
+        log.with_fields(value=raw).warning(
+            "unknown PEER_ENCRYPTION (want off|allow|prefer|require); "
+            "using 'allow'"
+        )
+        return "allow"
+    return raw
+
+
 def _default_backends():
     from .fetch.torrent import TorrentBackend
     from .utils import zero_copy_from_env
@@ -124,7 +142,10 @@ def _default_backends():
     # torrent first, then http, matching the reference's registration order
     # (cmd/downloader/downloader.go:87-90)
     return [
-        TorrentBackend(dht_bootstrap=_dht_bootstrap_from_env()),
+        TorrentBackend(
+            dht_bootstrap=_dht_bootstrap_from_env(),
+            encryption=_encryption_from_env(),
+        ),
         HTTPBackend(zero_copy=zero_copy_from_env()),
     ]
 

@@ -44,7 +44,7 @@ from ..parallel import DigestEngine, default_engine
 from ..utils import get_logger
 from ..utils.cancel import Cancelled, CancelToken
 from ..utils.netio import SocketWaiter
-from . import bencode
+from . import bencode, mse
 from .http import TransferError
 from .magnet import TorrentJob
 
@@ -75,6 +75,17 @@ MAX_REQUEST_LENGTH = 128 * 1024
 
 UT_METADATA = 1  # our local extended-message id for ut_metadata
 UT_PEX = 2  # our local extended-message id for ut_pex (BEP 11)
+
+# MSE policy → outbound connection attempts, in order. The reference's
+# anacrolix client accepts and initiates obfuscated connections by
+# default (Config.HeaderObfuscationPolicy); inbound, every policy but
+# "off" auto-detects plaintext vs MSE from the first bytes.
+ENCRYPTION_MODES: dict[str, tuple[str, ...]] = {
+    "off": ("plain",),  # plaintext only, encrypted inbound rejected
+    "allow": ("plain", "mse"),  # default: plaintext first, MSE fallback
+    "prefer": ("mse", "plain"),  # MSE first, plaintext fallback
+    "require": ("mse",),  # MSE only, plaintext inbound rejected
+}
 
 
 def generate_peer_id() -> bytes:
@@ -342,6 +353,7 @@ class PeerConnection:
         peer_id: bytes,
         token: CancelToken,
         timeout: float = 20.0,
+        encryption: str = "allow",
     ):
         self.host, self.port = host, port
         self.info_hash = info_hash
@@ -367,12 +379,42 @@ class PeerConnection:
         self.blocks_served = 0
         self.bytes_served = 0
         self._last_send = time.monotonic()
-        self._sock = socket.create_connection((host, port), timeout=timeout)
-        self._sock.settimeout(timeout)
         self._poll_waiter: SocketWaiter | None = None
+        self._sock: "socket.socket | mse.EncryptedSocket | None" = None
         self._remove_cancel_hook = token.add_callback(self.close)
+        modes = ENCRYPTION_MODES.get(encryption)
+        if modes is None:
+            self._remove_cancel_hook()
+            raise ValueError(f"unknown encryption policy {encryption!r}")
         try:
-            self._handshake(peer_id)
+            for attempt, mode in enumerate(modes):
+                self._sock = socket.create_connection(
+                    (host, port), timeout=timeout
+                )
+                self._sock.settimeout(timeout)
+                try:
+                    if mode == "mse":
+                        # under "require" the offer must not include
+                        # plaintext, or a plaintext-preferring receiver
+                        # could legally downgrade the session
+                        provide = (
+                            mse.CRYPTO_RC4
+                            if encryption == "require"
+                            else mse.CRYPTO_RC4 | mse.CRYPTO_PLAINTEXT
+                        )
+                        self._sock = mse.initiate(
+                            self._sock, info_hash, crypto_provide=provide
+                        )
+                    self._handshake(peer_id)
+                    break
+                except (OSError, mse.MSEError, PeerProtocolError, struct.error):
+                    self.close()
+                    # a cancel-hook close looks like a peer failure from
+                    # here; report it as the cancellation it is instead
+                    # of burning the remaining attempts
+                    token.raise_if_cancelled()
+                    if attempt == len(modes) - 1:
+                        raise
         except Exception:
             self.close()
             raise
@@ -635,10 +677,14 @@ class PeerConnection:
             remain = deadline - time.monotonic()
             if remain <= 0:
                 return
-            try:
-                self._poll_waiter.wait(remain)
-            except TimeoutError:
-                return
+            # an encrypted transport may hold already-decrypted surplus
+            # from the MSE handshake; the fd won't signal for those
+            pending = getattr(self._sock, "pending", None)
+            if pending is None or not pending():
+                try:
+                    self._poll_waiter.wait(remain)
+                except TimeoutError:
+                    return
             # a frame has started arriving; read_message blocks under
             # the normal socket timeout until it completes, keeping
             # framing
@@ -648,10 +694,12 @@ class PeerConnection:
         waiter, self._poll_waiter = self._poll_waiter, None
         if waiter is not None:
             waiter.close()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        sock = self._sock
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def __enter__(self):
         return self
@@ -1140,6 +1188,9 @@ class _InboundPeer:
         # through a sender thread so a stalled remote's full TCP buffer
         # can never block the piece-writer thread that completed a piece
         self._outq: "queue.Queue[bytes | None]" = queue.Queue(maxsize=65536)
+        # bytes already consumed from the wire that the read path must
+        # yield first (the MSE initial-payload hand-off)
+        self._prefix = bytearray()
         # generous: a remote in its WAIT state (all missing pieces
         # claimed elsewhere) legitimately idles without keepalives
         sock.settimeout(120.0)
@@ -1259,13 +1310,41 @@ class _InboundPeer:
             self._listener.discard(self)
 
     def _recv_exact(self, count: int) -> bytes:
-        data = _recv_into(self._sock, count)
-        if data is None:
-            raise OSError("remote closed")
-        return data
+        out = bytearray()
+        if self._prefix:
+            out += self._prefix[:count]
+            del self._prefix[:count]
+        if len(out) < count:
+            data = _recv_into(self._sock, count - len(out))
+            if data is None:
+                raise OSError("remote closed")
+            out += data
+        return bytes(out)
 
     def _serve(self) -> None:
-        hs = self._recv_exact(68)
+        # plaintext vs MSE detection: a plaintext BT handshake begins
+        # with 0x13"BitTorrent protocol"; anything else is an MSE DH
+        # public key (anacrolix's listener does the same detection)
+        head = self._recv_exact(20)
+        if head[0] == len(HANDSHAKE_PSTR) and head[1:20] == HANDSHAKE_PSTR:
+            if self._listener.encryption == "require":
+                return  # policy: obfuscated connections only
+            hs = head + self._recv_exact(48)
+        else:
+            if self._listener.encryption == "off":
+                return
+            try:
+                wrapped, ia = mse.accept(
+                    self._sock,
+                    self._listener.info_hash,
+                    prefix=head,
+                    allow_plaintext=self._listener.encryption != "require",
+                )
+            except mse.MSEError:
+                return  # not MSE either (or wrong torrent): reap
+            self._sock = wrapped
+            self._prefix = bytearray(ia)
+            hs = self._recv_exact(68)
         if hs[1:20] != HANDSHAKE_PSTR or hs[28:48] != self._listener.info_hash:
             return
         self.remote_peer_id = hs[48:68]
@@ -1448,10 +1527,15 @@ class PeerListener:
         max_inbound: int = 32,
         max_unchoked: int = 8,
         rechoke_interval: float = 10.0,
+        encryption: str = "allow",
     ):
         self.info_hash = info_hash
         self.peer_id = peer_id
         self._max_inbound = max_inbound
+        # MSE policy (ENCRYPTION_MODES keys): every policy but "off"
+        # auto-detects and accepts obfuscated inbound connections;
+        # "require" additionally rejects plaintext ones
+        self.encryption = encryption
         # upload-slot choker (see _rechoke): at most this many inbound
         # leechers are unchoked at once
         self._max_unchoked = max_unchoked
@@ -1697,6 +1781,7 @@ class SwarmDownloader:
         listen_port: int = 0,
         seed_drain_timeout: float = 10.0,
         discovery_rounds: int = 4,
+        encryption: str = "allow",
     ):
         self._job = job
         self._base_dir = base_dir
@@ -1708,6 +1793,8 @@ class SwarmDownloader:
         self._max_peer_connections = max(1, max_peer_connections)
         self._listen = listen
         self._listen_port = listen_port
+        # MSE policy for both halves (ENCRYPTION_MODES keys)
+        self._encryption = encryption
         self._seed_drain_timeout = seed_drain_timeout
         self._discovery_rounds = max(1, discovery_rounds)
         # populated by run(): the live announced port and upload stats
@@ -1841,7 +1928,10 @@ class SwarmDownloader:
         if self._listen:
             try:
                 listener = PeerListener(
-                    self._job.info_hash, self._peer_id, port=self._listen_port
+                    self._job.info_hash,
+                    self._peer_id,
+                    port=self._listen_port,
+                    encryption=self._encryption,
                 )
             except OSError as exc:
                 # cannot bind (port taken, exotic sandbox): leech-only
@@ -1896,7 +1986,12 @@ class SwarmDownloader:
                 token.raise_if_cancelled()
                 try:
                     with PeerConnection(
-                        host, peer_port, self._job.info_hash, self._peer_id, token
+                        host,
+                        peer_port,
+                        self._job.info_hash,
+                        self._peer_id,
+                        token,
+                        encryption=self._encryption,
                     ) as conn:
                         info = fetch_metadata(conn, self._job.info_hash, deadline)
                         break
@@ -2162,7 +2257,12 @@ class SwarmDownloader:
             host, port = peer
             try:
                 with PeerConnection(
-                    host, port, self._job.info_hash, self._peer_id, token
+                    host,
+                    port,
+                    self._job.info_hash,
+                    self._peer_id,
+                    token,
+                    encryption=self._encryption,
                 ) as conn:
                     swarm.register(conn)
                     try:

@@ -41,11 +41,15 @@ class TorrentBackend:
         progress_interval: float = 1.0,
         metadata_timeout: float = METADATA_TIMEOUT,
         dht_bootstrap: tuple[tuple[str, int], ...] | None = None,
+        encryption: str = "allow",
     ):
         self._progress_interval = progress_interval
         self._metadata_timeout = metadata_timeout
         # None = BEP 5 defaults; () disables DHT (hermetic tests)
         self._dht_bootstrap = dht_bootstrap
+        # MSE policy: off | allow | prefer | require (peer.py
+        # ENCRYPTION_MODES) — anacrolix speaks MSE by default too
+        self._encryption = encryption
 
     def register(self) -> BackendRegistration:
         return BackendRegistration(
@@ -101,6 +105,7 @@ class TorrentBackend:
             metadata_timeout=self._metadata_timeout,
             progress_interval=self._progress_interval,
             dht_bootstrap=self._dht_bootstrap,
+            encryption=self._encryption,
         )
         downloader.run(token, lambda percent: progress(url, percent))
         progress(url, 100.0)

@@ -1,0 +1,397 @@
+"""MSE (BitTorrent protocol encryption) tests: RC4 against published
+vectors, native/pure cross-check, the DH handshake in both crypto
+selections, policy enforcement on both halves, and an encrypted
+end-to-end block transfer. The reference gets MSE from anacrolix
+(Config.HeaderObfuscationPolicy; torrent.go:44 builds the default
+client, which speaks it)."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from downloader_tpu.fetch import mse
+from downloader_tpu.fetch import rc4_native
+from downloader_tpu.fetch.bencode import encode
+from downloader_tpu.fetch.peer import (
+    MSG_INTERESTED,
+    MSG_PIECE,
+    MSG_REQUEST,
+    PeerConnection,
+    PeerListener,
+    PieceStore,
+    generate_peer_id,
+)
+from downloader_tpu.fetch.seeder import make_torrent
+from downloader_tpu.utils.cancel import CancelToken
+
+INFO_HASH = hashlib.sha1(b"mse-test-torrent").digest()
+
+
+def _pure_rc4(key: bytes, drop: int = 0) -> rc4_native.RC4:
+    cipher = rc4_native.RC4.__new__(rc4_native.RC4)
+    cipher._native = None
+    s = list(range(256))
+    j = 0
+    for i in range(256):
+        j = (j + s[i] + key[i % len(key)]) & 0xFF
+        s[i], s[j] = s[j], s[i]
+    cipher._S, cipher._i, cipher._j = s, 0, 0
+    if drop:
+        cipher.crypt(bytes(drop))
+    return cipher
+
+
+class TestRC4:
+    def test_classic_vector(self):
+        # the universally-published RC4 example
+        assert rc4_native.RC4(b"Key").crypt(b"Plaintext").hex() == (
+            "bbf316e8d940af0ad3"
+        )
+        assert rc4_native.RC4(b"Wiki").crypt(b"pedia").hex() == "1021bf0420"
+
+    def test_rfc6229_40bit_keystream(self):
+        # RFC 6229, key 0x0102030405: first 16 keystream bytes
+        ks = rc4_native.RC4(bytes([1, 2, 3, 4, 5])).crypt(bytes(16))
+        assert ks.hex() == "b2396305f03dc027ccc3524a0a1118a8"
+
+    def test_native_matches_pure_across_chunking(self):
+        """State must carry across irregular crypt() calls identically
+        in both implementations (the native one, if it compiled)."""
+        key = os.urandom(20)
+        data = os.urandom(10_000)
+        native = rc4_native.RC4(key, drop=1024)
+        pure = _pure_rc4(key, drop=1024)
+        out_native, out_pure = b"", b""
+        offset = 0
+        for size in (1, 7, 250, 4096, 13, 5633):
+            chunk = data[offset : offset + size]
+            out_native += native.crypt(chunk)
+            out_pure += pure.crypt(chunk)
+            offset += size
+        assert out_native == out_pure
+
+    def test_decrypt_is_encrypt(self):
+        key = os.urandom(16)
+        data = os.urandom(1000)
+        assert rc4_native.RC4(key).crypt(rc4_native.RC4(key).crypt(data)) == data
+
+    def test_empty_key_rejected(self):
+        with pytest.raises(ValueError):
+            rc4_native.RC4(b"")
+
+    def test_compile_failure_falls_back_to_pure(self, monkeypatch):
+        """A read-only package dir (or broken compiler) must degrade to
+        the pure-Python path, never escape RC4.__init__."""
+        import tempfile
+
+        def deny_mkstemp(*args, **kwargs):
+            raise PermissionError("read-only package dir")
+
+        monkeypatch.setattr(tempfile, "mkstemp", deny_mkstemp)
+        monkeypatch.setattr(rc4_native, "_lib", None)
+        monkeypatch.setattr(rc4_native, "_SO_PATH", "/nonexistent/_rc4.so")
+        cipher = rc4_native.RC4(b"Key")
+        assert cipher._native is None  # pure path engaged
+        assert cipher.crypt(b"Plaintext").hex() == "bbf316e8d940af0ad3"
+
+
+class TestHandshake:
+    def _pair(self):
+        a, b = socket.socketpair()
+        a.settimeout(5)
+        b.settimeout(5)
+        return a, b
+
+    def _run_accept(self, sock, result, **kwargs):
+        def go():
+            try:
+                result["sock"], result["ia"] = mse.accept(
+                    sock, INFO_HASH, **kwargs
+                )
+            except Exception as exc:  # noqa: BLE001 - asserted by caller
+                result["err"] = exc
+                sock.close()  # what the real listener does on MSEError
+
+        thread = threading.Thread(target=go)
+        thread.start()
+        return thread
+
+    def test_rc4_selected_bidirectional(self):
+        a, b = self._pair()
+        result: dict = {}
+        thread = self._run_accept(b, result)
+        sock = mse.initiate(a, INFO_HASH, ia=b"INITIAL")
+        thread.join(timeout=10)
+        assert "err" not in result, result.get("err")
+        assert result["ia"] == b"INITIAL"
+        assert isinstance(sock, mse.EncryptedSocket)
+        sock.sendall(b"ping")
+        assert result["sock"].recv(4) == b"ping"
+        result["sock"].sendall(b"pong")
+        assert sock.recv(4) == b"pong"
+        # the wire carried no plaintext
+        a.close()
+        b.close()
+
+    def test_plaintext_selected_when_initiator_insists(self):
+        a, b = self._pair()
+        result: dict = {}
+        thread = self._run_accept(b, result)
+        sock = mse.initiate(
+            a, INFO_HASH, ia=b"IA", crypto_provide=mse.CRYPTO_PLAINTEXT
+        )
+        thread.join(timeout=10)
+        assert "err" not in result, result.get("err")
+        assert result["ia"] == b"IA"
+        sock.sendall(b"clear")
+        assert result["sock"].recv(5) == b"clear"
+        a.close()
+        b.close()
+
+    def test_receiver_can_refuse_plaintext(self):
+        a, b = self._pair()
+        result: dict = {}
+        thread = self._run_accept(b, result, allow_plaintext=False)
+        with pytest.raises(mse.MSEError):
+            mse.initiate(a, INFO_HASH, crypto_provide=mse.CRYPTO_PLAINTEXT)
+        thread.join(timeout=10)
+        assert isinstance(result.get("err"), mse.MSEError)
+        a.close()
+        b.close()
+
+    def test_wrong_infohash_rejected(self):
+        a, b = self._pair()
+        result: dict = {}
+        thread = self._run_accept(b, result)
+        other = hashlib.sha1(b"some-other-torrent").digest()
+        with pytest.raises(mse.MSEError):
+            mse.initiate(a, other)
+        thread.join(timeout=10)
+        assert isinstance(result.get("err"), mse.MSEError)
+        a.close()
+        b.close()
+
+    def test_degenerate_dh_keys_rejected(self):
+        for bad in (0, 1, mse.DH_PRIME - 1, mse.DH_PRIME):
+            with pytest.raises(mse.MSEError):
+                mse._secret(12345, bad.to_bytes(mse.DH_KEY_BYTES, "big"))
+
+    def test_non_mse_garbage_fails_fast(self):
+        a, b = self._pair()
+        result: dict = {}
+        thread = self._run_accept(b, result)
+        a.sendall(os.urandom(300))
+        a.close()  # EOF inside the sync window
+        thread.join(timeout=10)
+        # MSEError (sync failed) or OSError (our DH reply hit the closed
+        # pipe first) — the listener's serve loop reaps both the same way
+        assert isinstance(result.get("err"), (mse.MSEError, OSError))
+        b.close()
+
+
+def _seeded_listener(tmp_path, data, piece, **kwargs):
+    info, _, _ = make_torrent("movie.mkv", data, piece)
+    store = PieceStore(info, str(tmp_path))
+    for i in range(store.num_pieces):
+        store.write_piece(i, data[i * piece : i * piece + store.piece_size(i)])
+    info_bytes = encode(info)
+    info_hash = hashlib.sha1(info_bytes).digest()
+    listener = PeerListener(info_hash, generate_peer_id(), **kwargs)
+    listener.attach(store, info_bytes)
+    return listener, info_hash
+
+
+class TestEncryptedPeerWire:
+    PIECE = 32 * 1024
+
+    def _download_block(self, listener, info_hash, encryption):
+        with PeerConnection(
+            "127.0.0.1",
+            listener.port,
+            info_hash,
+            generate_peer_id(),
+            CancelToken(),
+            timeout=5,
+            encryption=encryption,
+        ) as conn:
+            transport = conn._sock
+            while not conn.remote_have_all:
+                conn.read_message()
+            conn.send_message(MSG_INTERESTED)
+            while conn.choked:
+                conn.read_message()
+            conn.send_message(MSG_REQUEST, struct.pack(">III", 0, 0, 4096))
+            while True:
+                msg_id, payload = conn.read_message()
+                if msg_id == MSG_PIECE:
+                    return payload[8:], transport
+
+    def test_required_encryption_end_to_end(self, tmp_path):
+        """Outbound 'require' against a default listener: the block
+        arrives intact over an EncryptedSocket transport."""
+        data = bytes(range(256)) * 300
+        listener, info_hash = _seeded_listener(tmp_path, data, self.PIECE)
+        try:
+            block, transport = self._download_block(
+                listener, info_hash, "require"
+            )
+            assert block == data[:4096]
+            assert isinstance(transport, mse.EncryptedSocket)
+        finally:
+            listener.close()
+
+    def test_plaintext_still_served_by_default_listener(self, tmp_path):
+        data = bytes(range(256)) * 300
+        listener, info_hash = _seeded_listener(tmp_path, data, self.PIECE)
+        try:
+            block, transport = self._download_block(listener, info_hash, "off")
+            assert block == data[:4096]
+            assert isinstance(transport, socket.socket)
+        finally:
+            listener.close()
+
+    def test_require_listener_rejects_plaintext(self, tmp_path):
+        data = bytes(range(256)) * 300
+        listener, info_hash = _seeded_listener(
+            tmp_path, data, self.PIECE, encryption="require"
+        )
+        try:
+            with pytest.raises(Exception):
+                with PeerConnection(
+                    "127.0.0.1",
+                    listener.port,
+                    info_hash,
+                    generate_peer_id(),
+                    CancelToken(),
+                    timeout=3,
+                    encryption="off",
+                ):
+                    pass
+        finally:
+            listener.close()
+
+    def test_allow_falls_back_to_mse(self, tmp_path):
+        """Default outbound policy against an encryption-only peer:
+        the plaintext attempt dies, the MSE retry succeeds."""
+        data = bytes(range(256)) * 300
+        listener, info_hash = _seeded_listener(
+            tmp_path, data, self.PIECE, encryption="require"
+        )
+        try:
+            block, transport = self._download_block(
+                listener, info_hash, "allow"
+            )
+            assert block == data[:4096]
+            assert isinstance(transport, mse.EncryptedSocket)
+        finally:
+            listener.close()
+
+    def test_require_outbound_refuses_plaintext_downgrade(
+        self, tmp_path, monkeypatch
+    ):
+        """An outbound 'require' connection must offer RC4 only: a
+        plaintext-preferring MSE receiver could otherwise legally
+        select plaintext and silently downgrade the session."""
+        offered = []
+        real_initiate = mse.initiate
+
+        def spy(sock, info_hash, ia=b"", crypto_provide=None):
+            offered.append(crypto_provide)
+            return real_initiate(
+                sock, info_hash, ia=ia, crypto_provide=crypto_provide
+            )
+
+        monkeypatch.setattr(mse, "initiate", spy)
+        data = bytes(range(256)) * 300
+        listener, info_hash = _seeded_listener(tmp_path, data, self.PIECE)
+        try:
+            block, transport = self._download_block(
+                listener, info_hash, "require"
+            )
+            assert block == data[:4096]
+            assert offered == [mse.CRYPTO_RC4]
+        finally:
+            listener.close()
+
+    def test_off_listener_rejects_encrypted(self, tmp_path):
+        data = bytes(range(256)) * 300
+        listener, info_hash = _seeded_listener(
+            tmp_path, data, self.PIECE, encryption="off"
+        )
+        try:
+            with pytest.raises(Exception):
+                with PeerConnection(
+                    "127.0.0.1",
+                    listener.port,
+                    info_hash,
+                    generate_peer_id(),
+                    CancelToken(),
+                    timeout=3,
+                    encryption="require",
+                ):
+                    pass
+        finally:
+            listener.close()
+
+
+class TestEncryptedSwarm:
+    def test_mutual_leech_fully_encrypted(self, tmp_path):
+        """Two downloaders with encryption='require' complete a torrent
+        from each other — every connection (both directions) is MSE."""
+        from downloader_tpu.fetch.magnet import parse_metainfo
+        from downloader_tpu.fetch.peer import SwarmDownloader
+        from downloader_tpu.fetch.seeder import SwarmTracker
+
+        piece = 32 * 1024
+        data = os.urandom(piece * 7 + 999)
+        with SwarmTracker() as tracker:
+            info, meta, _ = make_torrent(
+                "movie.mkv", data, piece, trackers=(tracker.url,)
+            )
+            job = parse_metainfo(meta)
+            dirs = [tmp_path / "a", tmp_path / "b"]
+            for idx, d in enumerate(dirs):
+                store = PieceStore(info, str(d))
+                for i in range(store.num_pieces):
+                    if i % 2 == idx:
+                        store.write_piece(
+                            i, data[i * piece : i * piece + store.piece_size(i)]
+                        )
+            downloaders = [
+                SwarmDownloader(
+                    job,
+                    str(d),
+                    progress_interval=0.01,
+                    dht_bootstrap=(),
+                    discovery_rounds=10,
+                    encryption="require",
+                )
+                for d in dirs
+            ]
+            errs: dict = {}
+
+            def run(idx):
+                try:
+                    downloaders[idx].run(CancelToken(), lambda p: None)
+                    errs[idx] = None
+                except Exception as exc:  # noqa: BLE001 - asserted below
+                    errs[idx] = exc
+
+            threads = [
+                threading.Thread(target=run, args=(i,)) for i in range(2)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert all(not t.is_alive() for t in threads), "swarm hung"
+            assert errs == {0: None, 1: None}, errs
+            for d in dirs:
+                assert (d / "movie.mkv").read_bytes() == data
