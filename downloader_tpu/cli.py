@@ -135,6 +135,23 @@ def _encryption_from_env() -> str:
     return raw
 
 
+def _transport_from_env() -> str:
+    """PEER_TRANSPORT env: outbound transport policy tcp|utp|both
+    (default both — TCP first with uTP fallback, the posture the
+    reference gets from anacrolix)."""
+    from .fetch.peer import TRANSPORT_MODES
+
+    raw = os.environ.get("PEER_TRANSPORT", "").strip().lower()
+    if not raw:
+        return "both"
+    if raw not in TRANSPORT_MODES:
+        log.with_fields(value=raw).warning(
+            "unknown PEER_TRANSPORT (want tcp|utp|both); using 'both'"
+        )
+        return "both"
+    return raw
+
+
 def _default_backends():
     from .fetch.torrent import TorrentBackend
     from .utils import zero_copy_from_env
@@ -145,6 +162,7 @@ def _default_backends():
         TorrentBackend(
             dht_bootstrap=_dht_bootstrap_from_env(),
             encryption=_encryption_from_env(),
+            transport=_transport_from_env(),
         ),
         HTTPBackend(zero_copy=zero_copy_from_env()),
     ]

@@ -44,7 +44,7 @@ from ..parallel import DigestEngine, default_engine
 from ..utils import get_logger
 from ..utils.cancel import Cancelled, CancelToken
 from ..utils.netio import SocketWaiter
-from . import bencode, mse
+from . import bencode, mse, utp
 from .http import TransferError
 from .magnet import TorrentJob
 
@@ -86,6 +86,17 @@ ENCRYPTION_MODES: dict[str, tuple[str, ...]] = {
     "prefer": ("mse", "plain"),  # MSE first, plaintext fallback
     "require": ("mse",),  # MSE only, plaintext inbound rejected
 }
+
+# transport policy → outbound attempt order. The reference's anacrolix
+# client dials TCP and uTP (BEP 29) both; here TCP is tried first (fast
+# refusal on datacenter networks) with uTP as the fallback that reaches
+# NAT'd peers inbound-TCP can't. The listener accepts both always.
+TRANSPORT_MODES: dict[str, tuple[str, ...]] = {
+    "tcp": ("tcp",),
+    "utp": ("utp",),
+    "both": ("tcp", "utp"),
+}
+UTP_CONNECT_TIMEOUT = 5.0  # a dead UDP port gives no refusal signal
 
 
 def generate_peer_id() -> bytes:
@@ -342,6 +353,15 @@ class PeerProtocolError(TransferError):
     pass
 
 
+class PeerIdentityError(PeerProtocolError):
+    """The transport worked and the remote answered a valid BT
+    handshake that proves no retry can help: it IS us, or it serves a
+    different torrent. Distinct from plain PeerProtocolError because an
+    EOF mid-handshake IS retryable — an MSE-only peer closes plaintext
+    handshakes cleanly, and that close must fall through to the MSE
+    attempt, not abort the whole attempt matrix."""
+
+
 class PeerConnection:
     """One wire connection to a peer: handshake + message framing."""
 
@@ -354,6 +374,8 @@ class PeerConnection:
         token: CancelToken,
         timeout: float = 20.0,
         encryption: str = "allow",
+        transport: str = "tcp",
+        utp_mux: "utp.UTPMultiplexer | None" = None,
     ):
         self.host, self.port = host, port
         self.info_hash = info_hash
@@ -386,13 +408,54 @@ class PeerConnection:
         if modes is None:
             self._remove_cancel_hook()
             raise ValueError(f"unknown encryption policy {encryption!r}")
+        transports = TRANSPORT_MODES.get(transport)
+        if transports is None:
+            self._remove_cancel_hook()
+            raise ValueError(f"unknown transport policy {transport!r}")
+        if utp_mux is None:
+            transports = tuple(t for t in transports if t != "utp")
+            if not transports:
+                self._remove_cancel_hook()
+                raise ValueError("uTP transport requires a utp_mux")
         try:
-            for attempt, mode in enumerate(modes):
-                self._sock = socket.create_connection(
-                    (host, port), timeout=timeout
-                )
-                self._sock.settimeout(timeout)
+            self._dial(
+                host, port, peer_id, info_hash, token, timeout,
+                encryption, transports, modes, utp_mux,
+            )
+        except Exception:
+            self.close()
+            raise
+
+    def _dial(
+        self, host, port, peer_id, info_hash, token, timeout,
+        encryption, transports, modes, utp_mux,
+    ) -> None:
+        """Attempt matrix: transports outer, crypto modes inner. A
+        CONNECT failure skips the transport's remaining crypto modes (a
+        socket that never established cannot depend on the crypto), so
+        a dead peer costs one dial per transport, not per (transport,
+        mode) pair; a HANDSHAKE failure retries the next crypto mode
+        over a fresh dial of the same transport."""
+        last_exc: Exception | None = None
+        for t_index, trans in enumerate(transports):
+            last_transport = t_index == len(transports) - 1
+            for m_index, mode in enumerate(modes):
                 try:
+                    if trans == "utp":
+                        self._sock = utp_mux.connect(
+                            (host, port),
+                            timeout=min(timeout, UTP_CONNECT_TIMEOUT),
+                        )
+                    else:
+                        self._sock = socket.create_connection(
+                            (host, port), timeout=timeout
+                        )
+                except OSError as exc:
+                    token.raise_if_cancelled()
+                    last_exc = exc
+                    break  # next transport: redialing can't succeed now
+                try:
+                    self._sock.settimeout(timeout)
                     if mode == "mse":
                         # under "require" the offer must not include
                         # plaintext, or a plaintext-preferring receiver
@@ -406,18 +469,24 @@ class PeerConnection:
                             self._sock, info_hash, crypto_provide=provide
                         )
                     self._handshake(peer_id)
-                    break
-                except (OSError, mse.MSEError, PeerProtocolError, struct.error):
+                    return
+                except PeerIdentityError:
+                    # the remote proved its identity wrong for this job
+                    # (ourselves / foreign info-hash): no other attempt
+                    # can change that — fail now, but still report a
+                    # cancel-hook close as the cancellation it is
                     self.close()
-                    # a cancel-hook close looks like a peer failure from
-                    # here; report it as the cancellation it is instead
-                    # of burning the remaining attempts
                     token.raise_if_cancelled()
-                    if attempt == len(modes) - 1:
-                        raise
-        except Exception:
-            self.close()
-            raise
+                    raise
+                except (
+                    OSError, mse.MSEError, PeerProtocolError, struct.error
+                ) as exc:
+                    self.close()
+                    self._sock = None
+                    token.raise_if_cancelled()
+                    last_exc = exc
+        assert last_exc is not None
+        raise last_exc
 
     def _handshake(self, peer_id: bytes) -> None:
         reserved = bytearray(8)
@@ -434,12 +503,12 @@ class PeerConnection:
         if reply[1:20] != HANDSHAKE_PSTR:
             raise PeerProtocolError("bad handshake protocol string")
         if reply[28:48] != self.info_hash:
-            raise PeerProtocolError("peer served a different info-hash")
+            raise PeerIdentityError("peer served a different info-hash")
         self.remote_peer_id = reply[48:68]
         if self.remote_peer_id == peer_id:
             # trackers echo our own announce back; a connection to our
             # own listener would idle-loop (we have nothing we need)
-            raise PeerProtocolError("connected to ourselves")
+            raise PeerIdentityError("connected to ourselves")
         self.remote_supports_extended = bool(reply[25] & 0x10)
         self.remote_supports_fast = bool(reply[27] & 0x04)
         if self.remote_supports_fast:
@@ -1559,6 +1628,16 @@ class PeerListener:
             self._sock.close()
             raise
         self.port = self._sock.getsockname()[1]
+        # uTP (BEP 29) rides UDP on the SAME number as the announced
+        # TCP port — that is where remotes will try it. Bind failure
+        # (port race) degrades to TCP-only, quietly.
+        self.utp_mux: "utp.UTPMultiplexer | None" = None
+        try:
+            self.utp_mux = utp.UTPMultiplexer(
+                host=host, port=self.port, on_accept=self._accept_utp
+            )
+        except OSError:
+            pass
         threading.Thread(
             target=self._accept_loop,
             daemon=True,
@@ -1576,20 +1655,29 @@ class PeerListener:
                 sock, addr = self._sock.accept()
             except OSError:
                 return  # listener closed
-            with self._lock:
-                if self._closed or len(self._conns) >= self._max_inbound:
-                    try:
-                        sock.close()
-                    except OSError:
-                        pass
-                    continue
-                conn = _InboundPeer(self, sock, addr)
-                self._conns.add(conn)
-            threading.Thread(
-                target=conn.run,
-                daemon=True,
-                name=f"peer-inbound-{addr[0]}:{addr[1]}",
-            ).start()
+            self._admit(sock, addr)
+
+    def _accept_utp(self, stream: "utp.UTPSocket") -> None:
+        # uTP streams enter the exact same serving path as TCP ones:
+        # _InboundPeer only needs the socket duck-type, so plaintext
+        # detection, MSE, the choker, and block serving all just work
+        self._admit(stream, stream.addr)
+
+    def _admit(self, sock, addr) -> None:
+        with self._lock:
+            if self._closed or len(self._conns) >= self._max_inbound:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+            conn = _InboundPeer(self, sock, addr)
+            self._conns.add(conn)
+        threading.Thread(
+            target=conn.run,
+            daemon=True,
+            name=f"peer-inbound-{addr[0]}:{addr[1]}",
+        ).start()
 
     # -- choker ----------------------------------------------------------
     #
@@ -1757,6 +1845,8 @@ class PeerListener:
             self._sock.close()
         except OSError:
             pass
+        if self.utp_mux is not None:
+            self.utp_mux.close()
         with self._lock:
             conns = list(self._conns)
         for conn in conns:
@@ -1782,6 +1872,7 @@ class SwarmDownloader:
         seed_drain_timeout: float = 10.0,
         discovery_rounds: int = 4,
         encryption: str = "allow",
+        transport: str = "both",
     ):
         self._job = job
         self._base_dir = base_dir
@@ -1795,6 +1886,10 @@ class SwarmDownloader:
         self._listen_port = listen_port
         # MSE policy for both halves (ENCRYPTION_MODES keys)
         self._encryption = encryption
+        # outbound transport policy (TRANSPORT_MODES keys); the
+        # listener accepts both TCP and uTP regardless
+        self._transport = transport
+        self._utp_mux: "utp.UTPMultiplexer | None" = None
         self._seed_drain_timeout = seed_drain_timeout
         self._discovery_rounds = max(1, discovery_rounds)
         # populated by run(): the live announced port and upload stats
@@ -1940,10 +2035,24 @@ class SwarmDownloader:
         self._observed_leecher_ids: set[bytes] = set()
         self.blocks_served = 0  # per-run totals: listener + outbound conns
         self.bytes_served = 0
+        # outbound uTP rides the listener's mux (so our source port is
+        # the announced one, as uTP peers expect); listener-less runs
+        # get a private outbound-only mux when the policy wants uTP
+        owns_mux = False
+        if listener is not None and listener.utp_mux is not None:
+            self._utp_mux = listener.utp_mux
+        elif "utp" in TRANSPORT_MODES.get(self._transport, ()):
+            try:
+                self._utp_mux = utp.UTPMultiplexer()
+                owns_mux = True
+            except OSError as exc:
+                log.warning(f"outbound uTP disabled: {exc}")
         try:
             self._run(token, progress, listener)
             completed = True
         finally:
+            if owns_mux and self._utp_mux is not None:
+                self._utp_mux.close()
             if listener is not None:
                 # drain only after a successful download: a completed
                 # job lingers briefly so remote leechers (peers seen
@@ -1992,6 +2101,8 @@ class SwarmDownloader:
                         self._peer_id,
                         token,
                         encryption=self._encryption,
+                        transport=self._transport,
+                        utp_mux=self._utp_mux,
                     ) as conn:
                         info = fetch_metadata(conn, self._job.info_hash, deadline)
                         break
@@ -2263,6 +2374,8 @@ class SwarmDownloader:
                     self._peer_id,
                     token,
                     encryption=self._encryption,
+                    transport=self._transport,
+                    utp_mux=self._utp_mux,
                 ) as conn:
                     swarm.register(conn)
                     try:

@@ -42,6 +42,7 @@ class TorrentBackend:
         metadata_timeout: float = METADATA_TIMEOUT,
         dht_bootstrap: tuple[tuple[str, int], ...] | None = None,
         encryption: str = "allow",
+        transport: str = "both",
     ):
         self._progress_interval = progress_interval
         self._metadata_timeout = metadata_timeout
@@ -50,6 +51,9 @@ class TorrentBackend:
         # MSE policy: off | allow | prefer | require (peer.py
         # ENCRYPTION_MODES) — anacrolix speaks MSE by default too
         self._encryption = encryption
+        # outbound transport policy: tcp | utp | both (peer.py
+        # TRANSPORT_MODES) — anacrolix dials both by default too
+        self._transport = transport
 
     def register(self) -> BackendRegistration:
         return BackendRegistration(
@@ -106,6 +110,7 @@ class TorrentBackend:
             progress_interval=self._progress_interval,
             dht_bootstrap=self._dht_bootstrap,
             encryption=self._encryption,
+            transport=self._transport,
         )
         downloader.run(token, lambda percent: progress(url, percent))
         progress(url, 100.0)

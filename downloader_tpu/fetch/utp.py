@@ -1,0 +1,629 @@
+"""uTP — the micro transport protocol (BEP 29) over UDP.
+
+The reference's anacrolix client speaks uTP alongside TCP by default
+(torrent.go:44 builds the default client; NAT'd swarm peers are often
+reachable ONLY over uTP because UDP hole-punching works where inbound
+TCP does not). This module implements the protocol from scratch on a
+stdlib UDP socket:
+
+- the 20-byte header (type/ver, connection ids, microsecond timestamps,
+  advertised window, seq/ack numbers),
+- three-way-ish setup (ST_SYN → ST_STATE), ordered reliable delivery
+  with out-of-order reassembly, ST_FIN teardown, ST_RESET on unknown
+  connections,
+- retransmission with exponential backoff and AIMD windowing (halve on
+  loss, grow per clean round-trip).
+
+Deliberate divergence from the full BEP 29 congestion controller: the
+LEDBAT delay-based gating (target 100 ms one-way delay, scaled gain) is
+replaced by plain AIMD. LEDBAT's goal is *yielding to foreground
+traffic on consumer uplinks*; this service runs in datacenters where
+loss-signalled AIMD is the norm, and AIMD is strictly more aggressive,
+never slower. The timestamp/timestamp_diff fields are still filled per
+spec so LEDBAT-speaking remotes can run their controller against us.
+The selective-ack extension is parsed (skipped) but not emitted.
+
+A ``UTPSocket`` duck-types the blocking ``socket.socket`` surface the
+peer wire uses (``sendall``/``recv``/``settimeout``/``close``/
+``fileno``/``pending``), so the BT handshake, MSE encryption (mse.py),
+and the message framing run over uTP unchanged. ``fileno`` returns a
+self-pipe armed whenever ordered bytes are ready, so SocketWaiter
+readiness polls work even though a background thread drains the UDP
+socket itself.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import socket
+import struct
+import threading
+import time
+
+ST_DATA = 0
+ST_FIN = 1
+ST_STATE = 2
+ST_RESET = 3
+ST_SYN = 4
+
+VERSION = 1
+HEADER = struct.Struct(">BBHIIIHH")  # type/ver, ext, conn_id, ts, ts_diff, wnd, seq, ack
+HEADER_LEN = HEADER.size
+
+# conservative payload size: fits every real-world MTU incl. tunnels
+MSS = 1400
+# advertised receive window (bytes) — also the reassembly buffer cap
+RECV_WINDOW = 1 << 20
+# AIMD congestion window bounds, in packets
+CWND_INIT = 16
+CWND_MIN = 2
+CWND_MAX = 256
+RTO_INIT = 0.5
+RTO_MAX = 8.0
+CONNECT_TIMEOUT = 10.0
+ACK_EVERY = 4  # delayed-ack stride; the mux tick flushes stragglers
+
+
+class UTPError(OSError):
+    """Transport-level failure (reset, timeout, teardown)."""
+
+
+def _now_us() -> int:
+    return time.monotonic_ns() // 1000 & 0xFFFFFFFF
+
+
+def _pack(
+    ptype: int,
+    conn_id: int,
+    ts_diff: int,
+    wnd: int,
+    seq: int,
+    ack: int,
+    payload: bytes = b"",
+) -> bytes:
+    return (
+        HEADER.pack(
+            (ptype << 4) | VERSION,
+            0,
+            conn_id,
+            _now_us(),
+            ts_diff & 0xFFFFFFFF,
+            wnd,
+            seq,
+            ack,
+        )
+        + payload
+    )
+
+
+def _seq_lt(a: int, b: int) -> bool:
+    """a < b in mod-65536 sequence space."""
+    return 0 < (b - a) & 0xFFFF < 0x8000
+
+
+class UTPSocket:
+    """One uTP stream. Created via ``connect()`` (initiator) or handed
+    to the listener's accept callback (receiver). Thread-safe like a
+    socket: one reader and one writer may run concurrently."""
+
+    def __init__(self, mux: "UTPMultiplexer", addr, send_id: int, recv_id: int):
+        self._mux = mux
+        self.addr = addr
+        self._send_id = send_id
+        self._recv_id = recv_id
+        self._lock = threading.Lock()
+        self._readable = threading.Condition(self._lock)
+        self._writable = threading.Condition(self._lock)
+        self._timeout: float | None = None
+        # tx state
+        self._seq = secrets.randbelow(0xFFFF) + 1
+        self._inflight: dict[int, tuple[bytes, float, int]] = {}  # seq -> (pkt, sent_at, tries)
+        self._cwnd = CWND_INIT
+        self._rtt = RTO_INIT
+        self._peer_wnd = RECV_WINDOW
+        self._dup_acks = 0
+        self._last_ack_seen = -1
+        # rx state
+        self._ack = 0  # last in-order seq received
+        self._ooo: dict[int, bytes] = {}  # out-of-order reassembly
+        self._stream = bytearray()  # ordered bytes ready for recv()
+        self._last_ts_diff = 0
+        self._fin_seq: int | None = None
+        self._unacked = 0  # in-order packets since the last ack sent
+        self._eof = False
+        self._error: Exception | None = None
+        self._connected = threading.Event()
+        self._closed = False
+        self._torn_down = False
+        # self-pipe: armed while _stream/_eof/_error would let recv()
+        # return, so selector-based waits (SocketWaiter) see readiness
+        # even though the mux thread drains the UDP fd itself
+        self._pipe_r, self._pipe_w = os.pipe()
+        os.set_blocking(self._pipe_r, False)
+        os.set_blocking(self._pipe_w, False)
+        self._pipe_armed = False
+
+    # -- plumbing --------------------------------------------------------
+
+    def _arm_pipe_locked(self) -> None:
+        if not self._pipe_armed:
+            self._pipe_armed = True
+            try:
+                os.write(self._pipe_w, b"x")
+            except OSError:
+                pass
+
+    def _disarm_pipe_locked(self) -> None:
+        if self._pipe_armed and not (self._stream or self._eof or self._error):
+            self._pipe_armed = False
+            try:
+                while os.read(self._pipe_r, 64):
+                    pass
+            except OSError:
+                pass
+
+    def _send_raw(self, data: bytes) -> None:
+        try:
+            self._mux.sock.sendto(data, self.addr)
+        except OSError:
+            pass  # transient; retransmit machinery covers loss
+
+    def _send_ack_locked(self) -> None:
+        self._send_raw(
+            _pack(
+                ST_STATE,
+                self._send_id,
+                self._last_ts_diff,
+                max(0, RECV_WINDOW - len(self._stream)),
+                self._seq,
+                self._ack,
+            )
+        )
+
+    # -- mux-thread entry points ----------------------------------------
+
+    def _on_packet(self, ptype: int, seq: int, ack: int, ts: int, wnd: int, payload: bytes) -> None:
+        with self._lock:
+            self._on_packet_locked(ptype, seq, ack, ts, wnd, payload)
+            teardown = self._closed and (
+                not self._inflight or self._error is not None
+            )
+        if teardown:
+            self._maybe_teardown()
+
+    def _on_packet_locked(self, ptype, seq, ack, ts, wnd, payload) -> None:
+        self._last_ts_diff = (_now_us() - ts) & 0xFFFFFFFF
+        self._peer_wnd = wnd
+        if ptype == ST_RESET:
+            self._error = UTPError("connection reset by peer")
+            self._readable.notify_all()
+            self._writable.notify_all()
+            self._arm_pipe_locked()
+            return
+        # ack processing (every packet type carries ack_nr)
+        acked = [s for s in self._inflight if not _seq_lt(ack, s)]
+        if acked:
+            self._dup_acks = 0
+            for s in acked:
+                pkt, sent_at, tries = self._inflight.pop(s)
+                if tries == 1 and s == ack:
+                    # Karn's rule: only first-transmission samples
+                    sample = time.monotonic() - sent_at
+                    self._rtt = 0.8 * self._rtt + 0.2 * sample
+            # clean ack: additive increase, one packet per window
+            self._cwnd = min(
+                CWND_MAX,
+                self._cwnd + max(1, len(acked)) / max(1, self._cwnd),
+            )
+            self._writable.notify_all()
+        elif self._inflight:
+            # an ack that acks nothing while data is in flight: the
+            # remote is missing our head-of-line packet (it acks
+            # immediately on every gap arrival — delayed acks mean the
+            # value itself may differ from the last one we saw, so no
+            # equality test). Two in a row = fast retransmit without
+            # waiting out the RTO: AIMD keeps the window small after a
+            # loss, so TCP's classic 3 may never accumulate, and a
+            # spurious head retransmit costs one packet.
+            self._dup_acks += 1
+            if self._dup_acks >= 2:
+                self._dup_acks = 0
+                self._retransmit_head_locked(time.monotonic())
+        self._last_ack_seen = ack
+        if ptype == ST_STATE:
+            if not self._connected.is_set():
+                # the SYN-ACK's seq is the remote's initial seq; its
+                # first DATA will carry this same number (libutp
+                # semantics: the SYN-ACK does not consume a seq)
+                self._ack = (seq - 1) & 0xFFFF
+                self._connected.set()
+            return
+        if ptype == ST_DATA:
+            self._on_data_locked(seq, payload)
+        elif ptype == ST_FIN:
+            # EOF only once everything before the FIN's seq has been
+            # delivered — DATA still being retransmitted must not be
+            # truncated by an early FIN arrival
+            self._fin_seq = seq
+            self._on_data_locked(seq, b"")
+
+    def _on_data_locked(self, seq: int, payload: bytes) -> None:
+        gap = payload and (seq != (self._ack + 1) & 0xFFFF)
+        if payload:
+            if _seq_lt(self._ack, seq) and len(self._ooo) * MSS < RECV_WINDOW:
+                self._ooo.setdefault(seq, payload)
+        # drain everything now in order
+        while (self._ack + 1) & 0xFFFF in self._ooo:
+            self._ack = (self._ack + 1) & 0xFFFF
+            self._stream += self._ooo.pop(self._ack)
+            self._unacked += 1
+        if self._fin_seq is not None and (self._ack + 1) & 0xFFFF == self._fin_seq:
+            self._ack = self._fin_seq  # consume the FIN's slot
+            self._eof = True
+        # delayed ack: per-packet acks dominate CPU at loopback rates;
+        # ack on a gap (the sender's loss signal), every ACK_EVERY
+        # in-order packets, at EOF, and from the mux tick otherwise
+        if gap or self._unacked >= ACK_EVERY or self._eof:
+            self._send_ack_locked()
+            self._unacked = 0
+        if self._stream or self._eof:
+            self._readable.notify_all()
+            self._arm_pipe_locked()
+
+    def _on_tick(self) -> None:
+        """Mux timer: flush a straggling delayed ack; retransmit
+        expired in-flight packets."""
+        with self._lock:
+            if self._unacked:
+                self._send_ack_locked()
+                self._unacked = 0
+            now = time.monotonic()
+            if self._error is None and self._inflight:
+                # retransmit ONLY the head-of-line packet: everything
+                # behind it is (with high probability) sitting in the
+                # remote's reassembly buffer, and resending the whole
+                # window both wastes bandwidth and can phase-lock with
+                # a periodic loss pattern, starving one packet forever
+                rto = min(RTO_MAX, max(RTO_INIT, self._rtt * 3))
+                head = min(
+                    self._inflight,
+                    key=lambda s: (s - self._last_ack_seen) & 0xFFFF,
+                )
+                pkt, sent_at, tries = self._inflight[head]
+                if now - sent_at >= rto * (2 ** (tries - 1)):
+                    if tries >= 6:
+                        self._error = UTPError(
+                            "uTP retransmission limit reached"
+                        )
+                        self._readable.notify_all()
+                        self._writable.notify_all()
+                        self._arm_pipe_locked()
+                    else:
+                        self._retransmit_head_locked(now)
+            teardown = self._closed and (
+                not self._inflight or self._error is not None
+            )
+        if teardown:
+            self._maybe_teardown()
+
+    def _retransmit_head_locked(self, now: float) -> None:
+        if not self._inflight:
+            return
+        head = min(
+            self._inflight, key=lambda s: (s - self._last_ack_seen) & 0xFFFF
+        )
+        pkt, sent_at, tries = self._inflight[head]
+        # loss signal: multiplicative decrease
+        self._cwnd = max(CWND_MIN, self._cwnd / 2)
+        self._send_raw(pkt)
+        self._inflight[head] = (pkt, now, tries + 1)
+
+    # -- initiator handshake --------------------------------------------
+
+    def _connect(self, timeout: float) -> None:
+        syn_seq = self._seq
+        pkt = _pack(ST_SYN, self._recv_id, 0, RECV_WINDOW, syn_seq, 0)
+        with self._lock:
+            self._inflight[syn_seq] = (pkt, time.monotonic(), 1)
+            self._seq = (self._seq + 1) & 0xFFFF
+        self._send_raw(pkt)
+        if not self._connected.wait(timeout):
+            self.close()
+            raise UTPError(f"uTP connect to {self.addr} timed out")
+        with self._lock:
+            self._inflight.pop(syn_seq, None)
+
+    def _accept(self, syn_seq: int) -> None:
+        """Receiver side: our ack starts at the remote's SYN seq."""
+        with self._lock:
+            self._ack = syn_seq
+            self._send_ack_locked()
+
+    # -- socket surface --------------------------------------------------
+
+    def settimeout(self, value: float | None) -> None:
+        self._timeout = value
+
+    def fileno(self) -> int:
+        return self._pipe_r
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._stream)
+
+    def sendall(self, data: bytes) -> None:
+        view = memoryview(bytes(data))
+        offset = 0
+        deadline = (
+            time.monotonic() + self._timeout if self._timeout is not None else None
+        )
+        while offset < len(view):
+            with self._lock:
+                if self._error is not None:
+                    raise UTPError(str(self._error))
+                if self._closed:
+                    raise UTPError("socket closed")
+                window = min(
+                    int(self._cwnd), max(1, self._peer_wnd // MSS)
+                )
+                if len(self._inflight) >= window:
+                    wait = 1.0  # bounded so retransmit ticks re-check
+                    if deadline is not None:
+                        remain = deadline - time.monotonic()
+                        if remain <= 0:
+                            raise UTPError("uTP send timed out")
+                        wait = min(wait, remain)
+                    self._writable.wait(timeout=wait)
+                    continue
+                chunk = bytes(view[offset : offset + MSS])
+                seq = self._seq
+                self._seq = (self._seq + 1) & 0xFFFF
+                pkt = _pack(
+                    ST_DATA,
+                    self._send_id,
+                    self._last_ts_diff,
+                    max(0, RECV_WINDOW - len(self._stream)),
+                    seq,
+                    self._ack,
+                    chunk,
+                )
+                self._inflight[seq] = (pkt, time.monotonic(), 1)
+            self._send_raw(pkt)
+            offset += len(chunk)
+
+    def recv(self, count: int) -> bytes:
+        deadline = (
+            time.monotonic() + self._timeout if self._timeout is not None else None
+        )
+        with self._lock:
+            while not self._stream:
+                # clean EOF beats a late error: a RESET that raced in
+                # after the remote's FIN (e.g. its teardown answered our
+                # final ack) must not turn a complete stream into a
+                # failure
+                if self._eof or self._closed:
+                    return b""
+                if self._error is not None:
+                    raise UTPError(str(self._error))
+                remain = None
+                if deadline is not None:
+                    remain = deadline - time.monotonic()
+                    if remain <= 0:
+                        raise TimeoutError("timed out")
+                self._readable.wait(timeout=remain)
+            take = bytes(self._stream[:count])
+            del self._stream[:count]
+            self._disarm_pipe_locked()
+            return take
+
+    def close(self) -> None:
+        """Send FIN and tear down. The FIN rides the normal retransmit
+        machinery (a dropped FIN would otherwise leave the remote
+        blocked forever), so deregistration from the mux happens when
+        the FIN is acked — or when its retries are exhausted."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            fin_seq = self._seq
+            self._seq = (self._seq + 1) & 0xFFFF
+            fin = _pack(
+                ST_FIN,
+                self._send_id,
+                self._last_ts_diff,
+                0,
+                fin_seq,
+                self._ack,
+            )
+            if self._error is None:
+                self._inflight[fin_seq] = (fin, time.monotonic(), 1)
+            self._readable.notify_all()
+            self._writable.notify_all()
+            self._arm_pipe_locked()
+        self._send_raw(fin)
+        self._maybe_teardown()
+
+    def _maybe_teardown(self) -> None:
+        """Final deregistration once closed and nothing awaits an ack."""
+        with self._lock:
+            if not self._closed:
+                return
+            if self._inflight and self._error is None:
+                return  # FIN (or tail data) still awaiting ack
+            if self._torn_down:
+                return
+            self._torn_down = True
+        self._mux._discard(self)
+        for fd in (self._pipe_r, self._pipe_w):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+
+class UTPMultiplexer:
+    """Owns one UDP socket and demultiplexes datagrams to streams by
+    (address, connection id). The listener shares its port number with
+    the TCP listener — BEP 29 peers expect uTP on the announced port —
+    and outbound connections can ride an ephemeral-port multiplexer.
+
+    ``on_accept(utp_socket)`` is invoked (on the mux thread) for each
+    inbound SYN when accepting is enabled."""
+
+    def __init__(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        on_accept=None,
+        sock: socket.socket | None = None,
+    ):
+        self.on_accept = on_accept
+        if sock is not None:
+            self.sock = sock
+        else:
+            self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                self.sock.bind((host, port))
+            except OSError:
+                self.sock.close()
+                raise
+        self.sock.settimeout(0.1)  # tick granularity for retransmits
+        self.port = self.sock.getsockname()[1]
+        self._lock = threading.Lock()
+        self._conns: dict[tuple, UTPSocket] = {}  # (addr, recv_id) -> conn
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._pump, daemon=True, name=f"utp-mux-{self.port}"
+        )
+        self._thread.start()
+
+    def connect(self, addr, timeout: float = CONNECT_TIMEOUT) -> UTPSocket:
+        """Initiate a stream to ``addr``; blocks until the SYN is acked.
+
+        IPv4 only (the mux socket is AF_INET): an IPv6 peer raises
+        gaierror immediately, which the caller's transport fallback
+        treats as this transport failing — v6 peers are reached over
+        TCP (PeerConnection dials them fine). Dual-stack uTP would
+        need an AF_INET6 mux socket; deliberate scope cut, documented
+        here."""
+        addr = (socket.gethostbyname(addr[0]), addr[1])
+        with self._lock:
+            if self._closed:
+                raise UTPError("multiplexer closed")
+            while True:
+                recv_id = secrets.randbelow(0xFFFE)
+                if (addr, recv_id) not in self._conns:
+                    break
+            # spec: the SYN carries our RECEIVE id; we send data with
+            # recv_id + 1 and the remote replies labeled recv_id
+            conn = UTPSocket(
+                self, addr, send_id=(recv_id + 1) & 0xFFFF, recv_id=recv_id
+            )
+            self._conns[(addr, recv_id)] = conn
+        conn._connect(timeout)
+        return conn
+
+    def _discard(self, conn: UTPSocket) -> None:
+        with self._lock:
+            for key, value in list(self._conns.items()):
+                if value is conn:
+                    del self._conns[key]
+
+    def _pump(self) -> None:
+        while True:
+            try:
+                data, addr = self.sock.recvfrom(65536)
+            except socket.timeout:
+                data = None
+            except OSError:
+                return  # closed
+            with self._lock:
+                if self._closed:
+                    return
+                conns = list(self._conns.values())
+            if data is None:
+                for conn in conns:
+                    conn._on_tick()
+                continue
+            if len(data) < HEADER_LEN:
+                continue
+            type_ver, ext, conn_id, ts, ts_diff, wnd, seq, ack = HEADER.unpack_from(
+                data
+            )
+            ptype, version = type_ver >> 4, type_ver & 0x0F
+            if version != VERSION or ptype > ST_SYN:
+                continue
+            payload = data[HEADER_LEN:]
+            if ext:
+                # skip extension chain (we never negotiate any, but a
+                # remote may still attach selective acks)
+                offset = HEADER_LEN
+                next_ext = ext
+                try:
+                    while next_ext:
+                        next_ext, ext_len = data[offset], data[offset + 1]
+                        offset += 2 + ext_len
+                    payload = data[offset:]
+                except IndexError:
+                    continue  # malformed extension chain
+            if ptype == ST_SYN:
+                self._on_syn(addr, conn_id, seq)
+                continue
+            with self._lock:
+                conn = self._conns.get((addr, conn_id))
+            if conn is not None:
+                conn._on_packet(ptype, seq, ack, ts, wnd, payload)
+            elif ptype != ST_RESET:
+                # unknown stream: tell the remote to stop retrying
+                try:
+                    self.sock.sendto(
+                        _pack(ST_RESET, conn_id, 0, 0, 0, seq), addr
+                    )
+                except OSError:
+                    pass
+
+    def _on_syn(self, addr, conn_id: int, seq: int) -> None:
+        if self.on_accept is None:
+            try:
+                self.sock.sendto(_pack(ST_RESET, conn_id, 0, 0, 0, seq), addr)
+            except OSError:
+                pass
+            return
+        key = (addr, (conn_id + 1) & 0xFFFF)
+        with self._lock:
+            if self._closed:
+                return
+            existing = self._conns.get(key)
+            if existing is not None:
+                # duplicate/delayed SYN (our SYN-ACK was lost, or UDP
+                # duplicated it): re-ack, but NEVER rewind _ack — DATA
+                # may already have advanced it, and a rewind would make
+                # every in-order packet look out-of-order forever
+                with existing._lock:
+                    existing._send_ack_locked()
+                return
+            # per spec: receiver sends on the SYN's conn_id, receives
+            # on conn_id + 1
+            conn = UTPSocket(
+                self, addr, send_id=conn_id, recv_id=(conn_id + 1) & 0xFFFF
+            )
+            self._conns[key] = conn
+        conn._accept(seq)
+        conn._connected.set()
+        try:
+            self.on_accept(conn)
+        except Exception:  # pragma: no cover - accept callback owns errors
+            conn.close()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for conn in conns:
+            conn.close()
+        try:
+            self.sock.close()
+        except OSError:
+            pass

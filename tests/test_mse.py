@@ -277,6 +277,141 @@ class TestEncryptedPeerWire:
         finally:
             listener.close()
 
+    def test_allow_falls_back_after_clean_eof(self, tmp_path):
+        """A remote that reads the plaintext handshake FULLY and then
+        closes cleanly (EOF, not RST) must still fall through to the
+        MSE attempt — EOF mid-handshake raises PeerProtocolError, which
+        has to stay retryable (round-4 review finding: only identity
+        proofs may abort the attempt matrix)."""
+        import socket as socket_mod
+        import threading
+
+        data = bytes(range(256)) * 300
+        listener, info_hash = _seeded_listener(tmp_path, data, self.PIECE)
+
+        # a gate in front: first connection gets a clean read-all-EOF,
+        # later ones are tunneled to the real (MSE-capable) listener
+        gate = socket_mod.socket()
+        gate.bind(("127.0.0.1", 0))
+        gate.listen(4)
+        gate_port = gate.getsockname()[1]
+        seen = []
+
+        def gatekeeper():
+            while True:
+                try:
+                    sock, _ = gate.accept()
+                except OSError:
+                    return
+                seen.append(sock)
+                if len(seen) == 1:
+                    sock.settimeout(5)
+                    try:
+                        got = b""
+                        while len(got) < 68:  # read the FULL handshake
+                            chunk = sock.recv(68 - len(got))
+                            if not chunk:
+                                break
+                            got += chunk
+                    except OSError:
+                        pass
+                    sock.close()  # clean FIN: client sees EOF
+                    continue
+                upstream = socket_mod.create_connection(
+                    ("127.0.0.1", listener.port), 5
+                )
+
+                def pump(a, b):
+                    try:
+                        while True:
+                            chunk = a.recv(65536)
+                            if not chunk:
+                                break
+                            b.sendall(chunk)
+                    except OSError:
+                        pass
+                    for s in (a, b):
+                        try:
+                            s.close()
+                        except OSError:
+                            pass
+
+                threading.Thread(
+                    target=pump, args=(sock, upstream), daemon=True
+                ).start()
+                threading.Thread(
+                    target=pump, args=(upstream, sock), daemon=True
+                ).start()
+
+        threading.Thread(target=gatekeeper, daemon=True).start()
+        try:
+            block, transport = self._download_block(
+                type("L", (), {"port": gate_port})(), info_hash, "allow"
+            )
+            assert block == data[:4096]
+            assert isinstance(transport, mse.EncryptedSocket)
+            assert len(seen) >= 2, "never retried after the clean EOF"
+        finally:
+            gate.close()
+            listener.close()
+
+    def test_identity_failure_aborts_attempt_matrix(self):
+        """A peer that validly answers the handshake with a DIFFERENT
+        info-hash proves no retry can help: exactly one connection is
+        made and PeerIdentityError surfaces."""
+        import socket as socket_mod
+        import threading
+
+        from downloader_tpu.fetch.peer import (
+            HANDSHAKE_PSTR,
+            PeerIdentityError,
+        )
+
+        accepts = []
+        server = socket_mod.socket()
+        server.bind(("127.0.0.1", 0))
+        server.listen(4)
+        wrong_hash = hashlib.sha1(b"some other torrent").digest()
+
+        def serve():
+            while True:
+                try:
+                    sock, _ = server.accept()
+                except OSError:
+                    return
+                accepts.append(sock)
+                try:
+                    sock.settimeout(5)
+                    got = b""
+                    while len(got) < 68:
+                        chunk = sock.recv(68 - len(got))
+                        if not chunk:
+                            break
+                        got += chunk
+                    sock.sendall(
+                        bytes([len(HANDSHAKE_PSTR)]) + HANDSHAKE_PSTR
+                        + bytes(8) + wrong_hash
+                        + generate_peer_id()
+                    )
+                except OSError:
+                    pass
+
+        threading.Thread(target=serve, daemon=True).start()
+        try:
+            with pytest.raises(PeerIdentityError):
+                PeerConnection(
+                    "127.0.0.1",
+                    server.getsockname()[1],
+                    INFO_HASH,
+                    generate_peer_id(),
+                    CancelToken(),
+                    timeout=5,
+                    encryption="allow",
+                )
+            assert len(accepts) == 1, "identity failure was retried"
+        finally:
+            server.close()
+
     def test_allow_falls_back_to_mse(self, tmp_path):
         """Default outbound policy against an encryption-only peer:
         the plaintext attempt dies, the MSE retry succeeds."""
