@@ -1972,6 +1972,9 @@ class SwarmDownloader:
                         errors.append(f"{futures[future]}: {exc}")
                         continue
                     tracker_responded = True
+                    # a tracker now lists us: the teardown "stopped"
+                    # announce has someone to inform
+                    self._tracker_contacted = True
                     # any non-empty announce counts, even if it only
                     # repeats the x.pe hints — a tracker-confirmed peer
                     # is no reason to fall through to a DHT lookup
@@ -2035,6 +2038,11 @@ class SwarmDownloader:
         self._observed_leecher_ids: set[bytes] = set()
         self.blocks_served = 0  # per-run totals: listener + outbound conns
         self.bytes_served = 0
+        self._tracker_contacted = False
+        # set by _run once metadata/store exist; the teardown announce
+        # computes real downloaded/left counters from them
+        self._store_ref: "PieceStore | None" = None
+        self._session_start_bytes = 0
         # outbound uTP rides the listener's mux (so our source port is
         # the announced one, as uTP peers expect); listener-less runs
         # get a private outbound-only mux when the policy wants uTP
@@ -2070,6 +2078,42 @@ class SwarmDownloader:
                     log.with_fields(
                         blocks=self.blocks_served, bytes=self.bytes_served
                     ).info("served peers while downloading")
+            # lifecycle announces, fire-and-forget (teardown must not
+            # wait on trackers) but SEQUENCED in one thread: "completed"
+            # first (anacrolix announces completion too), then BEP 3
+            # "stopped" so trackers stop handing out our dead port —
+            # a "completed" landing after "stopped" would re-register
+            # it. Sent whenever a tracker may list us: a discovery-time
+            # response proved it, and a completed job's own announce
+            # can register us even when discovery never got through.
+            if self._job.trackers and (self._tracker_contacted or completed):
+                store = self._store_ref
+                downloaded = left = 0
+                if store is not None:
+                    done = store.bytes_completed()
+                    downloaded = done - self._session_start_bytes
+                    left = store.total_length - done
+                elif not completed:
+                    left = 1  # no metadata: true remainder unknowable
+                threading.Thread(
+                    target=self._announce_teardown,
+                    args=(
+                        completed,
+                        self.listen_port or 6881,
+                        self.bytes_served,
+                        downloaded,
+                        left,
+                    ),
+                    daemon=True,
+                    name="announce-teardown",
+                ).start()
+
+    def _announce_teardown(
+        self, completed: bool, port: int, uploaded: int, downloaded: int, left: int
+    ) -> None:
+        if completed:
+            self._announce_event("completed", port, uploaded, downloaded, 0)
+        self._announce_event("stopped", port, uploaded, downloaded, left)
 
     def _run(
         self, token: CancelToken, progress, listener: "PeerListener | None"
@@ -2128,6 +2172,9 @@ class SwarmDownloader:
         # off disk by the resume scan were not served by anyone this
         # session and must not inflate tracker ratio accounting
         session_start_bytes = store.bytes_completed()
+        # the teardown announce derives its counters from the store
+        self._store_ref = store
+        self._session_start_bytes = session_start_bytes
 
         swarm = _SwarmState(store, progress, self._progress_interval)
         # outbound reciprocation: completed pieces are announced (HAVE)
@@ -2241,27 +2288,20 @@ class SwarmDownloader:
                 f"pieces missing (recent errors: {swarm.error_summary()})"
             )
 
-        if self._job.trackers:
-            # fire-and-forget "completed" announce (anacrolix announces
-            # completion too); a slow tracker must not add tail latency
-            # to a finished job, hence the daemon thread + short timeout
-            uploaded = (
-                listener.bytes_served if listener else 0
-            ) + self.bytes_served
-            threading.Thread(
-                target=self._announce_completed,
-                args=(
-                    port,
-                    uploaded,
-                    store.total_length - session_start_bytes,
-                ),
-                daemon=True,
-                name="announce-completed",
-            ).start()
+        # the "completed" announce fires from run()'s teardown thread,
+        # sequenced BEFORE the "stopped" announce — racing them lets a
+        # late "completed" re-register the just-deregistered dead port
 
-    def _announce_completed(
-        self, port: int, uploaded: int, downloaded: int
+    def _announce_event(
+        self,
+        event: str,
+        port: int,
+        uploaded: int,
+        downloaded: int,
+        left: int = 0,
     ) -> None:
+        """Best-effort lifecycle announce ("completed"/"stopped") to
+        every tracker; short timeouts, errors swallowed — stats only."""
         for tracker in self._job.trackers:
             try:
                 if tracker.startswith(("http://", "https://")):
@@ -2269,10 +2309,10 @@ class SwarmDownloader:
                         tracker,
                         self._job.info_hash,
                         self._peer_id,
-                        left=0,
+                        left=left,
                         port=port,
                         timeout=5.0,
-                        event="completed",
+                        event=event,
                         uploaded=uploaded,
                         downloaded=downloaded,
                     )
@@ -2281,16 +2321,16 @@ class SwarmDownloader:
                         tracker,
                         self._job.info_hash,
                         self._peer_id,
-                        left=0,
+                        left=left,
                         port=port,
                         timeout=2.0,
                         retries=0,
-                        event="completed",
+                        event=event,
                         uploaded=uploaded,
                         downloaded=downloaded,
                     )
             except TransferError:
-                pass  # best-effort: completion stats only
+                pass  # best-effort: lifecycle stats only
 
     def _web_seed_worker(
         self, url: str, swarm: "_SwarmState", token: CancelToken

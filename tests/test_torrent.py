@@ -1277,6 +1277,65 @@ class TestInboundPeer:
         assert int(completed[0]["downloaded"]) == len(payload)
         assert completed[0]["left"] == "0"
 
+    def test_stopped_event_announced_on_teardown(self, tmp_path):
+        """BEP 3 lifecycle: a finished job tells the tracker "stopped"
+        on teardown so it stops handing out our dead port; a FAILED job
+        (tracker contacted, no usable peers) does too."""
+        import time as time_mod
+
+        payload = bytes(range(256)) * 600
+        with Seeder("movie.mkv", payload) as s:
+            job = parse_magnet(s.magnet_uri)
+            SwarmDownloader(
+                job, str(tmp_path / "ok"), progress_interval=0.01,
+                dht_bootstrap=(),
+            ).run(CancelToken(), lambda p: None)
+            deadline = time_mod.monotonic() + 5
+            stopped = []
+            while time_mod.monotonic() < deadline and not stopped:
+                stopped = [
+                    a for a in s.announces if a.get("event") == "stopped"
+                ]
+                time_mod.sleep(0.02)
+        assert stopped, "no stopped announce after a completed job"
+        assert stopped[0]["left"] == "0"
+        assert int(stopped[0]["downloaded"]) == len(payload)
+
+        # failure path: a tracker whose swarm only contains a dead peer
+        with SwarmTracker() as tracker:
+            info, meta, _ = make_torrent(
+                "movie.mkv", payload, 32 * 1024, trackers=(tracker.url,)
+            )
+            dead = socket.socket()
+            dead.bind(("127.0.0.1", 0))
+            dead.listen(1)  # accepts nothing: connections hang/fail
+            dead_port = dead.getsockname()[1]
+            dead.close()  # now refused outright
+            tracker.peers[("127.0.0.1", dead_port)] = True  # dead peer
+            job = parse_metainfo(meta)
+            downloader = SwarmDownloader(
+                job,
+                str(tmp_path / "fail"),
+                progress_interval=0.01,
+                dht_bootstrap=(),
+                discovery_rounds=1,
+                transport="tcp",
+            )
+            with pytest.raises(TransferError):
+                downloader.run(CancelToken(), lambda p: None)
+            deadline = time_mod.monotonic() + 5
+            stopped = []
+            while time_mod.monotonic() < deadline and not stopped:
+                stopped = [
+                    a
+                    for a in tracker.announces
+                    if a.get("event") == "stopped"
+                ]
+                time_mod.sleep(0.02)
+            assert stopped, "no stopped announce after a failed job"
+            # metadata was known, so left is the REAL remaining bytes
+            assert int(stopped[0]["left"]) == len(payload)
+
     def test_two_downloaders_complete_from_each_other(self, tmp_path):
         """Verdict #1 done-criterion (a): two SwarmDownloaders, no
         Seeder. Each starts with half the pieces on disk; each can only
@@ -1332,8 +1391,11 @@ class TestInboundPeer:
             for events in by_port.values():
                 assert events[0] == "started"
                 # later announces: regular (no event) or the final
-                # fire-and-forget "completed" — never "started" again
-                assert all(e in (None, "completed") for e in events[1:])
+                # fire-and-forget "completed"/"stopped" lifecycle pair
+                # — never "started" again
+                assert all(
+                    e in (None, "completed", "stopped") for e in events[1:]
+                )
         for d in dirs:
             assert (d / "movie.mkv").read_bytes() == data
         # both sides actually served (mutual leeching, not one seeder)
