@@ -419,16 +419,14 @@ class PeerConnection:
                 raise ValueError("uTP transport requires a utp_mux")
         try:
             self._dial(
-                host, port, peer_id, info_hash, token, timeout,
-                encryption, transports, modes, utp_mux,
+                peer_id, token, timeout, encryption, transports, modes, utp_mux
             )
         except Exception:
             self.close()
             raise
 
     def _dial(
-        self, host, port, peer_id, info_hash, token, timeout,
-        encryption, transports, modes, utp_mux,
+        self, peer_id, token, timeout, encryption, transports, modes, utp_mux
     ) -> None:
         """Attempt matrix: transports outer, crypto modes inner. A
         CONNECT failure skips the transport's remaining crypto modes (a
@@ -437,18 +435,17 @@ class PeerConnection:
         mode) pair; a HANDSHAKE failure retries the next crypto mode
         over a fresh dial of the same transport."""
         last_exc: Exception | None = None
-        for t_index, trans in enumerate(transports):
-            last_transport = t_index == len(transports) - 1
-            for m_index, mode in enumerate(modes):
+        for trans in transports:
+            for mode in modes:
                 try:
                     if trans == "utp":
                         self._sock = utp_mux.connect(
-                            (host, port),
+                            (self.host, self.port),
                             timeout=min(timeout, UTP_CONNECT_TIMEOUT),
                         )
                     else:
                         self._sock = socket.create_connection(
-                            (host, port), timeout=timeout
+                            (self.host, self.port), timeout=timeout
                         )
                 except OSError as exc:
                     token.raise_if_cancelled()
@@ -466,7 +463,7 @@ class PeerConnection:
                             else mse.CRYPTO_RC4 | mse.CRYPTO_PLAINTEXT
                         )
                         self._sock = mse.initiate(
-                            self._sock, info_hash, crypto_provide=provide
+                            self._sock, self.info_hash, crypto_provide=provide
                         )
                     self._handshake(peer_id)
                     return

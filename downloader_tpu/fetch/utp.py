@@ -353,7 +353,7 @@ class UTPSocket:
             return len(self._stream)
 
     def sendall(self, data: bytes) -> None:
-        view = memoryview(bytes(data))
+        view = memoryview(data)  # no copy; sliced per MSS chunk below
         offset = 0
         deadline = (
             time.monotonic() + self._timeout if self._timeout is not None else None
@@ -535,17 +535,17 @@ class UTPMultiplexer:
             try:
                 data, addr = self.sock.recvfrom(65536)
             except socket.timeout:
-                data = None
-            except OSError:
-                return  # closed
-            with self._lock:
-                if self._closed:
-                    return
-                conns = list(self._conns.values())
-            if data is None:
+                # idle tick: snapshot the conns only here — the hot
+                # per-datagram path below looks up exactly one conn
+                with self._lock:
+                    if self._closed:
+                        return
+                    conns = list(self._conns.values())
                 for conn in conns:
                     conn._on_tick()
                 continue
+            except OSError:
+                return  # closed
             if len(data) < HEADER_LEN:
                 continue
             type_ver, ext, conn_id, ts, ts_diff, wnd, seq, ack = HEADER.unpack_from(

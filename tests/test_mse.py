@@ -35,17 +35,14 @@ INFO_HASH = hashlib.sha1(b"mse-test-torrent").digest()
 
 
 def _pure_rc4(key: bytes, drop: int = 0) -> rc4_native.RC4:
-    cipher = rc4_native.RC4.__new__(rc4_native.RC4)
-    cipher._native = None
-    s = list(range(256))
-    j = 0
-    for i in range(256):
-        j = (j + s[i] + key[i % len(key)]) & 0xFF
-        s[i], s[j] = s[j], s[i]
-    cipher._S, cipher._i, cipher._j = s, 0, 0
-    if drop:
-        cipher.crypt(bytes(drop))
-    return cipher
+    """An RC4 forced onto the pure-Python path (so native vs pure can
+    be cross-checked even when the .so loaded)."""
+    saved = rc4_native._lib
+    rc4_native._lib = False
+    try:
+        return rc4_native.RC4(key, drop=drop)
+    finally:
+        rc4_native._lib = saved
 
 
 class TestRC4:
