@@ -154,7 +154,7 @@ def _transport_from_env() -> str:
 
 def _default_backends():
     from .fetch.torrent import TorrentBackend
-    from .utils import zero_copy_from_env
+    from .utils import flag_from_env, zero_copy_from_env
 
     # torrent first, then http, matching the reference's registration order
     # (cmd/downloader/downloader.go:87-90)
@@ -163,6 +163,8 @@ def _default_backends():
             dht_bootstrap=_dht_bootstrap_from_env(),
             encryption=_encryption_from_env(),
             transport=_transport_from_env(),
+            # LSD env: "off" disables BEP 14 multicast discovery
+            lsd=flag_from_env("LSD"),
         ),
         HTTPBackend(zero_copy=zero_copy_from_env()),
     ]

@@ -376,9 +376,13 @@ class PeerConnection:
         encryption: str = "allow",
         transport: str = "tcp",
         utp_mux: "utp.UTPMultiplexer | None" = None,
+        listen_port: int | None = None,
     ):
         self.host, self.port = host, port
         self.info_hash = info_hash
+        # our OWN listener port, advertised via BEP 10 "p" so the
+        # remote can dial us back
+        self.listen_port = listen_port
         self.choked = True
         self.bitfield = b""
         self.remote_have_all = False  # BEP 6 HAVE_ALL received
@@ -518,10 +522,15 @@ class PeerConnection:
             self.send_extended_handshake()
 
     def send_extended_handshake(self) -> None:
-        payload = bencode.encode(
-            {b"m": {b"ut_metadata": UT_METADATA, b"ut_pex": UT_PEX}}
-        )
-        self.send_message(MSG_EXTENDED, bytes([0]) + payload)
+        ext: dict = {b"m": {b"ut_metadata": UT_METADATA, b"ut_pex": UT_PEX}}
+        if self.listen_port:
+            # BEP 10 "p": our listening port. This is how a peer we
+            # DIALED learns a dialable address for us — inbound
+            # connections are serve-only, so without it a peer that
+            # discovered us asymmetrically (LSD, PEX) could never
+            # leech back (anacrolix advertises it the same way)
+            ext[b"p"] = self.listen_port
+        self.send_message(MSG_EXTENDED, bytes([0]) + bencode.encode(ext))
 
     def attach_store(self, store: "PieceStore") -> None:
         """Arm reciprocation: the remote's INTERESTED is answered with
@@ -1522,6 +1531,14 @@ class _InboundPeer:
                     for k, v in info[b"m"].items()
                     if isinstance(v, int) and 0 < v < 256
                 }
+            if isinstance(info, dict):
+                # BEP 10 "p": the remote's own listening port — the
+                # only dialable address an inbound (serve-only)
+                # connection yields, and what lets us leech BACK from
+                # a peer that discovered us first (LSD/PEX asymmetry)
+                p = info.get(b"p")
+                if isinstance(p, int) and 0 < p < 65536:
+                    self._listener.peer_heard((self.addr[0], p))
             self._maybe_send_pex()
             return
         if ext_id != UT_METADATA:
@@ -1610,6 +1627,8 @@ class PeerListener:
         self._store: PieceStore | None = None
         self._info_bytes: bytes | None = None
         self._peer_source = None  # ut_pex gossip source (attach)
+        self._peer_sink = None  # inbound-learned peers flow here (attach)
+        self._pending_heard: list[tuple[str, int]] = []  # pre-attach buffer
         self._lock = threading.Lock()
         self._conns: set[_InboundPeer] = set()
         self._finished_leecher_ids: set[bytes] = set()
@@ -1765,21 +1784,48 @@ class PeerListener:
         store: PieceStore,
         info_bytes: bytes | None,
         peer_source=None,
+        peer_sink=None,
     ) -> None:
         """Arm serving once metadata + store exist. Connections accepted
         during the metadata/resume phase are caught up (HAVE frames +
         deferred UNCHOKE); the store observer keeps every connection
         fed with HAVE as new pieces complete. ``peer_source`` feeds
-        outgoing ut_pex gossip."""
+        outgoing ut_pex gossip; ``peer_sink(peer)`` receives dialable
+        addresses learned FROM inbound connections (BEP 10 "p")."""
         store.add_observer(self.notify_have)
         with self._lock:
             self._store = store
             self._info_bytes = info_bytes
             self._peer_source = peer_source
+            self._peer_sink = peer_sink
+            heard, self._pending_heard = self._pending_heard, []
             conns = list(self._conns)
+        if peer_sink is not None:
+            for peer in heard:  # replay addresses heard before attach
+                try:
+                    peer_sink(peer)
+                except Exception:  # pragma: no cover - sink owns errors
+                    pass
         have = [i for i, done in enumerate(store.have) if done]
         for conn in conns:
             conn.arm(have)
+
+    def peer_heard(self, peer: tuple[str, int]) -> None:
+        """A dialable address learned from an inbound connection's
+        extended handshake; best-effort hand-off to the swarm. Heard
+        before attach() (metadata/resume still running) it is buffered
+        — the handshake is sent once per connection, so dropping it
+        would lose that peer's only dialable address."""
+        with self._lock:
+            sink = self._peer_sink
+            if sink is None:
+                if len(self._pending_heard) < 64:
+                    self._pending_heard.append(peer)
+                return
+        try:
+            sink(peer)
+        except Exception:  # pragma: no cover - sink owns its errors
+            pass
 
     def notify_have(self, index: int) -> None:
         with self._lock:
@@ -1870,6 +1916,7 @@ class SwarmDownloader:
         discovery_rounds: int = 4,
         encryption: str = "allow",
         transport: str = "both",
+        lsd: bool = False,
     ):
         self._job = job
         self._base_dir = base_dir
@@ -1887,6 +1934,12 @@ class SwarmDownloader:
         # listener accepts both TCP and uTP regardless
         self._transport = transport
         self._utp_mux: "utp.UTPMultiplexer | None" = None
+        # BEP 14 local discovery (needs a listener). Library default
+        # OFF: real multicast on the well-known group would let
+        # unrelated processes/tests with identical info-hashes
+        # cross-dial into each other's swarms; the daemon/CLI turns it
+        # on (TorrentBackend default) for production jobs.
+        self._lsd = lsd
         self._seed_drain_timeout = seed_drain_timeout
         self._discovery_rounds = max(1, discovery_rounds)
         # populated by run(): the live announced port and upload stats
@@ -2040,6 +2093,17 @@ class SwarmDownloader:
         # computes real downloaded/left counters from them
         self._store_ref: "PieceStore | None" = None
         self._session_start_bytes = 0
+        self._lsd_client = None  # set by _run when BEP 14 is live
+        # LSD-heard peers before the swarm exists (metadata phase)
+        self._lsd_heard: "collections.deque[tuple[str, int]]" = (
+            collections.deque(maxlen=64)
+        )
+        self._lsd_swarm_sink = None  # set once the swarm exists
+        # our live listener port, advertised on outbound connections
+        # via BEP 10 "p" so dialed peers can dial us back
+        self._advertise_port = (
+            listener.port if listener is not None else None
+        )
         # outbound uTP rides the listener's mux (so our source port is
         # the announced one, as uTP peers expect); listener-less runs
         # get a private outbound-only mux when the policy wants uTP
@@ -2058,6 +2122,8 @@ class SwarmDownloader:
         finally:
             if owns_mux and self._utp_mux is not None:
                 self._utp_mux.close()
+            if self._lsd_client is not None:
+                self._lsd_client.close()
             if listener is not None:
                 # drain only after a successful download: a completed
                 # job lingers briefly so remote leechers (peers seen
@@ -2126,31 +2192,88 @@ class SwarmDownloader:
         # regular re-announce (event="") per tracker semantics
         announce_event = "started"
         dht_port = listener.port if listener is not None else None
+
+        # BEP 14 local discovery starts NOW — before the metadata
+        # phase — so a magnet whose only peer is on the LAN can
+        # bootstrap its metadata from it. Heard peers buffer in
+        # _lsd_heard until the swarm exists, then flow into its queue.
+        # Needs a real listener (the announce carries a port someone
+        # must be able to dial); degrades silently without multicast.
+        if listener is not None and self._lsd:
+            try:
+                from .lsd import LSD
+
+                def lsd_sink(peer):
+                    sink = self._lsd_swarm_sink
+                    if sink is not None:
+                        sink(peer)
+                    else:
+                        self._lsd_heard.append(peer)
+
+                # closed by run()'s teardown, which wraps this method
+                self._lsd_client = LSD(
+                    self._job.info_hash, listener.port, lsd_sink
+                )
+            except OSError as exc:
+                log.with_fields(error=str(exc)).info("lsd unavailable")
+
         if info is None:
-            peers = self._discover_peers(
-                left=1, token=token, port=port, dht_announce_port=dht_port
-            )
-            announce_event = ""
+            discovery_error: Exception | None = None
+            try:
+                peers = self._discover_peers(
+                    left=1, token=token, port=port, dht_announce_port=dht_port
+                )
+                announce_event = ""
+            except TransferError as exc:
+                if self._lsd_client is None:
+                    raise  # fail-fast: every peer source is dead
+                discovery_error = exc
+                peers = []
             log.info("fetching torrent metadata")
-            for host, peer_port in peers:
+            # bounded BEP 14 grace: when the classic sources are dead
+            # or dry, the LAN gets a short window to answer before the
+            # job fails — without LSD the single pass below preserves
+            # the original fail-fast behavior. Peers are retried on
+            # every pass (dedup within a pass only): a LAN peer dialed
+            # a beat too early legitimately has no metadata YET (its
+            # own resume/attach may still be running)
+            lsd_grace = time.monotonic() + (
+                5.0 if self._lsd_client is not None else 0.0
+            )
+            while info is None:
+                tried: set[tuple[str, int]] = set()
+                for host, peer_port in list(peers) + list(self._lsd_heard):
+                    if (host, peer_port) in tried:
+                        continue
+                    tried.add((host, peer_port))
+                    token.raise_if_cancelled()
+                    try:
+                        with PeerConnection(
+                            host,
+                            peer_port,
+                            self._job.info_hash,
+                            self._peer_id,
+                            token,
+                            encryption=self._encryption,
+                            transport=self._transport,
+                            utp_mux=self._utp_mux,
+                            listen_port=self._advertise_port,
+                        ) as conn:
+                            info = fetch_metadata(
+                                conn, self._job.info_hash, deadline
+                            )
+                            break
+                    except (TransferError, OSError) as exc:
+                        last_error = exc
+                if info is not None:
+                    break
+                now = time.monotonic()
+                if now >= lsd_grace or now >= deadline:
+                    raise TransferError(
+                        f"failed to get metadata: {last_error or discovery_error}"
+                    )
                 token.raise_if_cancelled()
-                try:
-                    with PeerConnection(
-                        host,
-                        peer_port,
-                        self._job.info_hash,
-                        self._peer_id,
-                        token,
-                        encryption=self._encryption,
-                        transport=self._transport,
-                        utp_mux=self._utp_mux,
-                    ) as conn:
-                        info = fetch_metadata(conn, self._job.info_hash, deadline)
-                        break
-                except (TransferError, OSError) as exc:
-                    last_error = exc
-            if info is None:
-                raise TransferError(f"failed to get metadata: {last_error}")
+                time.sleep(0.1)
             log.info("fetched torrent metadata")
 
         store = PieceStore(info, self._base_dir)
@@ -2189,8 +2312,17 @@ class SwarmDownloader:
             if hashlib.sha1(info_bytes).digest() != self._job.info_hash:
                 info_bytes = None
             listener.attach(
-                store, info_bytes, peer_source=swarm.known_peers
+                store,
+                info_bytes,
+                peer_source=swarm.known_peers,
+                peer_sink=lambda peer: swarm.enqueue_discovered([peer]),
             )
+
+        # LSD peers now flow straight into the swarm queue; drain
+        # whatever the LAN answered during the metadata phase
+        self._lsd_swarm_sink = lambda peer: swarm.enqueue_discovered([peer])
+        while self._lsd_heard:
+            swarm.enqueue_discovered([self._lsd_heard.popleft()])
 
         log.with_fields(
             pieces=store.num_pieces,
@@ -2241,7 +2373,12 @@ class SwarmDownloader:
                     announce_event = ""
                 except TransferError as exc:
                     swarm.last_error = exc
-                    break  # every PEER source is dead (webseeds below)
+                    if self._lsd_client is None:
+                        break  # every PEER source is dead (webseeds below)
+                    # BEP 14 may still feed the queue even with every
+                    # classic source dead: spend a (budgeted) round on
+                    # whatever the LAN announces
+                    peers = []
             swarm.enqueue_discovered(peers)
             workers = [
                 threading.Thread(
@@ -2413,6 +2550,7 @@ class SwarmDownloader:
                     encryption=self._encryption,
                     transport=self._transport,
                     utp_mux=self._utp_mux,
+                    listen_port=self._advertise_port,
                 ) as conn:
                     swarm.register(conn)
                     try:

@@ -43,6 +43,7 @@ class TorrentBackend:
         dht_bootstrap: tuple[tuple[str, int], ...] | None = None,
         encryption: str = "allow",
         transport: str = "both",
+        lsd: bool = False,
     ):
         self._progress_interval = progress_interval
         self._metadata_timeout = metadata_timeout
@@ -54,6 +55,11 @@ class TorrentBackend:
         # outbound transport policy: tcp | utp | both (peer.py
         # TRANSPORT_MODES) — anacrolix dials both by default too
         self._transport = transport
+        # BEP 14 LAN multicast discovery (exceeds the reference).
+        # Library default OFF — real multicast from library consumers
+        # and tests would cross-talk on the shared well-known group;
+        # the daemon/CLI enables it via the LSD env flag (default on)
+        self._lsd = lsd
 
     def register(self) -> BackendRegistration:
         return BackendRegistration(
@@ -111,6 +117,7 @@ class TorrentBackend:
             dht_bootstrap=self._dht_bootstrap,
             encryption=self._encryption,
             transport=self._transport,
+            lsd=self._lsd,
         )
         downloader.run(token, lambda percent: progress(url, percent))
         progress(url, 100.0)

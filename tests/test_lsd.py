@@ -1,0 +1,273 @@
+"""BEP 14 Local Service Discovery tests: message codec, discovery
+between two instances on the loopback multicast group, self-echo
+filtering, and a swarm that can ONLY find its peer via LSD (each
+downloader's tracker knows nobody else). Exceeds the reference:
+anacrolix has no BEP 14."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from downloader_tpu.fetch import lsd
+
+INFO_HASH = hashlib.sha1(b"lsd-test-torrent").digest()
+
+
+def _multicast_available() -> bool:
+    try:
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        probe.bind(("", 0))
+        probe.setsockopt(
+            socket.IPPROTO_IP,
+            socket.IP_ADD_MEMBERSHIP,
+            struct.pack(
+                "4sl", socket.inet_aton(lsd.GROUP_V4), socket.INADDR_ANY
+            ),
+        )
+        probe.close()
+        return True
+    except OSError:
+        return False
+
+
+needs_multicast = pytest.mark.skipif(
+    not _multicast_available(), reason="multicast unavailable"
+)
+
+
+class TestCodec:
+    def test_announce_roundtrip(self):
+        msg = lsd.build_announce("239.192.152.143", 6771, 51413, INFO_HASH, "c00kie")
+        assert msg.startswith(b"BT-SEARCH * HTTP/1.1\r\n")
+        parsed = lsd.parse_announce(msg)
+        assert parsed == (51413, [INFO_HASH], "c00kie")
+
+    def test_multiple_infohash_headers(self):
+        other = hashlib.sha1(b"other").digest()
+        msg = (
+            b"BT-SEARCH * HTTP/1.1\r\n"
+            b"Host: 239.192.152.143:6771\r\n"
+            b"Port: 7000\r\n"
+            b"Infohash: " + INFO_HASH.hex().encode() + b"\r\n"
+            b"Infohash: " + other.hex().encode() + b"\r\n"
+            b"\r\n\r\n"
+        )
+        port, hashes, cookie = lsd.parse_announce(msg)
+        assert port == 7000 and hashes == [INFO_HASH, other] and cookie == ""
+
+    def test_garbage_rejected(self):
+        assert lsd.parse_announce(b"GET / HTTP/1.1\r\n\r\n") is None
+        assert lsd.parse_announce(b"") is None
+        assert lsd.parse_announce(os.urandom(100)) is None
+        # BT-SEARCH but no usable headers
+        assert lsd.parse_announce(b"BT-SEARCH * HTTP/1.1\r\n\r\n") is None
+        # bad port
+        assert (
+            lsd.parse_announce(
+                b"BT-SEARCH * HTTP/1.1\r\nPort: nope\r\nInfohash: "
+                + INFO_HASH.hex().encode()
+                + b"\r\n\r\n"
+            )
+            is None
+        )
+        # truncated / odd-length infohash is skipped
+        assert (
+            lsd.parse_announce(
+                b"BT-SEARCH * HTTP/1.1\r\nPort: 7000\r\nInfohash: abc\r\n\r\n"
+            )
+            is None
+        )
+
+    def test_header_names_case_insensitive(self):
+        msg = (
+            b"BT-SEARCH * HTTP/1.1\r\n"
+            b"pOrT: 7001\r\n"
+            b"INFOHASH: " + INFO_HASH.hex().encode() + b"\r\n"
+            b"Cookie: x\r\n\r\n"
+        )
+        assert lsd.parse_announce(msg) == (7001, [INFO_HASH], "x")
+
+
+@needs_multicast
+class TestDiscovery:
+    def test_two_instances_discover_each_other(self):
+        found_a: list = []
+        found_b: list = []
+        a = lsd.LSD(INFO_HASH, 41001, found_a.append, announce_gap=0.0)
+        b = lsd.LSD(INFO_HASH, 41002, found_b.append, announce_gap=0.0)
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not (found_a and found_b):
+                time.sleep(0.05)
+            assert any(p[1] == 41002 for p in found_a), found_a
+            assert any(p[1] == 41001 for p in found_b), found_b
+        finally:
+            a.close()
+            b.close()
+
+    def test_close_reaps_listen_thread(self):
+        """close() on a QUIET group must still end the listen thread
+        (a blocked recvfrom isn't interrupted by socket.close; the rx
+        timeout bounds the exit) — a job-per-torrent daemon must not
+        accumulate stuck threads."""
+        before = set(threading.enumerate())  # other tests' threads
+        client = lsd.LSD(INFO_HASH, 41005, lambda p: None)
+        mine = [
+            t
+            for t in threading.enumerate()
+            if t not in before and t.name == "lsd-listen"
+        ]
+        assert mine, "listen thread never started"
+        client.close()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and any(
+            t.is_alive() for t in mine
+        ):
+            time.sleep(0.1)
+        assert not any(
+            t.is_alive() for t in mine
+        ), "lsd-listen thread survived close()"
+
+    def test_own_echo_and_foreign_hash_filtered(self):
+        found: list = []
+        other_hash = hashlib.sha1(b"unrelated").digest()
+        mine = lsd.LSD(INFO_HASH, 41003, found.append, announce_gap=0.0)
+        foreign = lsd.LSD(other_hash, 41004, lambda p: None, announce_gap=0.0)
+        try:
+            time.sleep(1.0)  # both announced at least once
+            assert not found, f"self-echo or foreign hash leaked: {found}"
+        finally:
+            mine.close()
+            foreign.close()
+
+
+@needs_multicast
+class TestSwarmViaLSD:
+    def test_mutual_leech_discovered_by_lsd_only(self, tmp_path):
+        """Each downloader announces to its own PRIVATE tracker (which
+        therefore never knows the other peer) and DHT is off: the only
+        way they can find each other is the BEP 14 multicast group."""
+        from downloader_tpu.fetch.bencode import encode
+        from downloader_tpu.fetch.magnet import parse_metainfo
+        from downloader_tpu.fetch.peer import PieceStore, SwarmDownloader
+        from downloader_tpu.fetch.seeder import SwarmTracker, make_torrent
+        from downloader_tpu.utils.cancel import CancelToken
+
+        piece = 32 * 1024
+        data = os.urandom(piece * 5 + 321)
+        trackers = [SwarmTracker().__enter__(), SwarmTracker().__enter__()]
+        try:
+            info, _, _ = make_torrent("movie.mkv", data, piece)
+            metas = [
+                make_torrent("movie.mkv", data, piece, trackers=(t.url,))[1]
+                for t in trackers
+            ]
+            dirs = [tmp_path / "a", tmp_path / "b"]
+            for idx, d in enumerate(dirs):
+                store = PieceStore(info, str(d))
+                for i in range(store.num_pieces):
+                    if i % 2 == idx:
+                        store.write_piece(
+                            i,
+                            data[i * piece : i * piece + store.piece_size(i)],
+                        )
+            downloaders = [
+                SwarmDownloader(
+                    parse_metainfo(metas[idx]),
+                    str(dirs[idx]),
+                    progress_interval=0.01,
+                    dht_bootstrap=(),
+                    discovery_rounds=30,
+                    lsd=True,  # library default is off; opt in
+                )
+                for idx in range(2)
+            ]
+            errs: dict = {}
+
+            def run(idx):
+                try:
+                    downloaders[idx].run(CancelToken(), lambda p: None)
+                    errs[idx] = None
+                except Exception as exc:  # noqa: BLE001 - asserted below
+                    errs[idx] = exc
+
+            threads = [
+                threading.Thread(target=run, args=(i,)) for i in range(2)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=90)
+            assert all(not t.is_alive() for t in threads), "swarm hung"
+            assert errs == {0: None, 1: None}, errs
+            for d in dirs:
+                assert (d / "movie.mkv").read_bytes() == data
+        finally:
+            for t in trackers:
+                t.__exit__(None, None, None)
+
+    def test_magnet_bootstraps_metadata_from_lan_peer(self, tmp_path):
+        """The headline trackerless case: a MAGNET job with zero
+        trackers and DHT off bootstraps its metadata (BEP 9) from a
+        LAN peer found via BEP 14, then completes mutually."""
+        from downloader_tpu.fetch.bencode import encode
+        from downloader_tpu.fetch.magnet import parse_magnet, parse_metainfo
+        from downloader_tpu.fetch.peer import PieceStore, SwarmDownloader
+        from downloader_tpu.fetch.seeder import make_torrent
+        from downloader_tpu.utils.cancel import CancelToken
+
+        piece = 32 * 1024
+        data = os.urandom(piece * 5 + 222)
+        info, meta, _ = make_torrent("movie.mkv", data, piece)
+        info_hash = hashlib.sha1(encode(info)).digest()
+        dirs = [tmp_path / "meta-side", tmp_path / "magnet-side"]
+        for idx, d in enumerate(dirs):
+            store = PieceStore(info, str(d))
+            for i in range(store.num_pieces):
+                if i % 2 == idx:
+                    store.write_piece(
+                        i, data[i * piece : i * piece + store.piece_size(i)]
+                    )
+        jobs = [
+            parse_metainfo(meta),  # has metadata, but NO trackers
+            parse_magnet(
+                "magnet:?xt=urn:btih:" + info_hash.hex() + "&dn=movie.mkv"
+            ),
+        ]
+        downloaders = [
+            SwarmDownloader(
+                jobs[idx],
+                str(dirs[idx]),
+                progress_interval=0.01,
+                dht_bootstrap=(),
+                discovery_rounds=30,
+                lsd=True,
+            )
+            for idx in range(2)
+        ]
+        errs: dict = {}
+
+        def run(idx):
+            try:
+                downloaders[idx].run(CancelToken(), lambda p: None)
+                errs[idx] = None
+            except Exception as exc:  # noqa: BLE001 - asserted below
+                errs[idx] = exc
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=90)
+        assert all(not t.is_alive() for t in threads), "swarm hung"
+        assert errs == {0: None, 1: None}, errs
+        for d in dirs:
+            assert (d / "movie.mkv").read_bytes() == data

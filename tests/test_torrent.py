@@ -1277,6 +1277,47 @@ class TestInboundPeer:
         assert int(completed[0]["downloaded"]) == len(payload)
         assert completed[0]["left"] == "0"
 
+    def test_inbound_extended_handshake_p_feeds_peer_sink(self, tmp_path):
+        """BEP 10 "p": a dialing peer advertises its own listen port;
+        the listener hands (ip, p) to the swarm so we can dial BACK a
+        peer that discovered us asymmetrically (LSD/PEX)."""
+        from downloader_tpu.fetch.peer import PeerConnection
+
+        data = bytes(range(256)) * 300
+        listener, store, info_hash, info_bytes = self._seeded_listener(
+            tmp_path, data
+        )
+        heard: list = []
+        listener.attach(store, info_bytes, peer_sink=heard.append)
+        try:
+            with PeerConnection(
+                "127.0.0.1",
+                listener.port,
+                info_hash,
+                generate_peer_id(),
+                CancelToken(),
+                timeout=5,
+                listen_port=45678,
+            ) as conn:
+                deadline = time.monotonic() + 5
+                while not heard and time.monotonic() < deadline:
+                    conn.poll_messages(0.05)
+            assert heard and heard[0][1] == 45678, heard
+            # without listen_port, no "p" is sent and nothing is heard
+            heard.clear()
+            with PeerConnection(
+                "127.0.0.1",
+                listener.port,
+                info_hash,
+                generate_peer_id(),
+                CancelToken(),
+                timeout=5,
+            ) as conn:
+                conn.poll_messages(0.3)
+            assert not heard
+        finally:
+            listener.close()
+
     def test_stopped_event_announced_on_teardown(self, tmp_path):
         """BEP 3 lifecycle: a finished job tells the tracker "stopped"
         on teardown so it stops handing out our dead port; a FAILED job
@@ -1684,8 +1725,8 @@ class TestPieceSelection:
         # generous bound (loaded single-core box): the real regression
         # signal is the overlap assert above — without endgame no piece
         # is ever requested from both peers; the time bound only guards
-        # against gross serial grinding through the slow peer
-        assert elapsed < 3.0, f"tail stalled: {elapsed:.1f}s"
+        # against gross serial grinding through the slow peer (~8 s)
+        assert elapsed < 5.0, f"tail stalled: {elapsed:.1f}s"
 
 
 class TestOutboundReciprocation:
