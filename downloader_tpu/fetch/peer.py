@@ -66,7 +66,37 @@ MSG_CANCEL = 8
 MSG_HAVE_ALL = 14
 MSG_HAVE_NONE = 15
 MSG_REJECT = 16
+MSG_ALLOWED_FAST = 17
 MSG_EXTENDED = 20
+
+# BEP 6 allowed-fast set size; also the cap on how many ALLOWED_FAST
+# grants we accept from a remote (a hostile flood must not grow state)
+ALLOWED_FAST_K = 10
+
+
+def allowed_fast_set(
+    ip: str, info_hash: bytes, num_pieces: int, k: int = ALLOWED_FAST_K
+) -> set[int]:
+    """BEP 6 canonical allowed-fast generation: pieces a choked peer at
+    ``ip`` may download anyway, derived from SHA-1 over the /24-masked
+    address + info-hash so both ends can compute the same set."""
+    if num_pieces <= 0:
+        return set()
+    try:
+        packed = socket.inet_aton(ip)
+    except OSError:
+        return set()  # v6/hostname: the spec defines the v4 derivation
+    x = bytes(a & b for a, b in zip(packed, b"\xff\xff\xff\x00")) + info_hash
+    allowed: set[int] = set()
+    k = min(k, num_pieces)
+    while len(allowed) < k:
+        x = hashlib.sha1(x).digest()
+        for offset in range(0, 20, 4):
+            if len(allowed) >= k:
+                break
+            index = int.from_bytes(x[offset : offset + 4], "big") % num_pieces
+            allowed.add(index)
+    return allowed
 
 # largest block an inbound REQUEST may ask for; the de-facto norm is
 # 16 KiB but mainstream clients tolerate up to 128 KiB before dropping
@@ -386,6 +416,7 @@ class PeerConnection:
         self.choked = True
         self.bitfield = b""
         self.remote_have_all = False  # BEP 6 HAVE_ALL received
+        self.allowed_fast: set[int] = set()  # BEP 6 grants received
         self.remote_extensions: dict[bytes, int] = {}
         self.metadata_size = 0
         # BEP 11 gossip: peers this peer told us about; the swarm
@@ -638,6 +669,15 @@ class PeerConnection:
                 # later HAVE frames grow it via _mark_have
                 self.bitfield = b"\x00"
                 self.remote_have_all = False
+            elif msg_id == MSG_ALLOWED_FAST and len(payload) >= 4:
+                # BEP 6: pieces we may request even while choked. Cap
+                # so a hostile grant-flood can't grow state; trusting
+                # the grants (vs recomputing the canonical set) is
+                # safe — a peer over-granting only helps us
+                if len(self.allowed_fast) < 4 * ALLOWED_FAST_K:
+                    self.allowed_fast.add(
+                        struct.unpack(">I", payload[:4])[0]
+                    )
             elif msg_id == MSG_INTERESTED:
                 self._remote_interested = True
                 if self._serve_store is not None and not self._remote_unchoked:
@@ -1250,6 +1290,9 @@ class _InboundPeer:
         self.remote_peer_id = b""  # set once the handshake arrives
         self.remote_supports_fast = False  # BEP 6, from the handshake
         self._unchoked = False
+        # BEP 6 allowed-fast pieces granted to this peer: requests for
+        # them are served even while choked
+        self._fast_grants: set[int] = set()
         # total bytes served to this peer; the choker's fairness key.
         # Written by the serve thread, read by the rechoke thread — a
         # plain int is fine, a stale read only shifts one ranking round
@@ -1326,12 +1369,35 @@ class _InboundPeer:
         pieces that existed before attach (resume) go out as HAVE
         frames — a late BITFIELD is not spec-legal — and a remote that
         declared INTERESTED while we had nothing to serve gets its
-        deferred UNCHOKE. Connections still mid-handshake are skipped
-        (_enqueue no-ops pre-ready); their post-handshake catch-up
-        re-snapshots the store and covers the same ground."""
+        deferred UNCHOKE plus its allowed-fast grants. Connections
+        still mid-handshake are skipped (_enqueue no-ops pre-ready);
+        their post-handshake catch-up re-snapshots the store and
+        covers the same ground."""
         for index in have_indices:
             self.notify_have(index)
+        store, _ = self._listener.snapshot()
+        if store is not None and self._ready.is_set():
+            # pre-ready, _enqueue silently drops frames — granting here
+            # would mark the set sent without it ever reaching the
+            # wire; the post-handshake catch-up covers that window
+            self._grant_allowed_fast(store.num_pieces, enqueue=True)
         self._maybe_unchoke()
+
+    def _grant_allowed_fast(self, num_pieces: int, enqueue: bool) -> None:
+        """Send the BEP 6 allowed-fast set once (idempotent): pieces
+        this remote may request even while choked — tit-for-tat
+        bootstrapping for peers the choker keeps waiting."""
+        if not self.remote_supports_fast or self._fast_grants:
+            return
+        self._fast_grants = allowed_fast_set(
+            self.addr[0], self._listener.info_hash, num_pieces
+        )
+        for index in sorted(self._fast_grants):
+            payload = struct.pack(">I", index)
+            if enqueue:
+                self._enqueue(_frame(MSG_ALLOWED_FAST, payload))
+            else:
+                self._send(MSG_ALLOWED_FAST, payload)
 
     def _maybe_unchoke(self) -> None:
         store, _ = self._listener.snapshot()
@@ -1450,6 +1516,7 @@ class _InboundPeer:
                 self._send(MSG_HAVE_NONE)
             else:
                 self._send(MSG_BITFIELD, pack_bitfield(sent_have))
+            self._grant_allowed_fast(store.num_pieces, enqueue=False)
         elif self.remote_supports_fast:
             # pre-attach (metadata/resume still running): BEP 6 demands
             # an availability message first; HAVE_NONE is the truthful
@@ -1471,6 +1538,9 @@ class _InboundPeer:
             for index, done in enumerate(store.have):
                 if done and (index >= len(sent_have) or not sent_have[index]):
                     self.notify_have(index)
+            # an attach that landed mid-handshake could not grant yet
+            # (arm() skips pre-ready connections); idempotent
+            self._grant_allowed_fast(store.num_pieces, enqueue=True)
 
         while True:
             length = struct.unpack(">I", self._recv_exact(4))[0]
@@ -1501,7 +1571,9 @@ class _InboundPeer:
         if length > MAX_REQUEST_LENGTH:
             raise PeerProtocolError(f"oversized block request: {length}")
         block = None
-        if self._unchoked:  # spec: requests while choked are dropped
+        # spec: requests while choked are dropped — EXCEPT the BEP 6
+        # allowed-fast grants, which exist to be served while choked
+        if self._unchoked or index in self._fast_grants:
             store, _ = self._listener.snapshot()
             block = store.read_block(index, begin, length) if store else None
         if block is None:
@@ -2240,9 +2312,16 @@ class SwarmDownloader:
             lsd_grace = time.monotonic() + (
                 5.0 if self._lsd_client is not None else 0.0
             )
+            # LAN peers drained out of the LSD deque (popleft is safe
+            # against the listen thread's concurrent appends; iterating
+            # the live deque is not) — accumulated so passes retry them,
+            # and handed to the swarm with the tracker peers afterwards
+            lan_peers: list[tuple[str, int]] = []
             while info is None:
+                while self._lsd_heard:
+                    lan_peers.append(self._lsd_heard.popleft())
                 tried: set[tuple[str, int]] = set()
-                for host, peer_port in list(peers) + list(self._lsd_heard):
+                for host, peer_port in list(peers) + lan_peers:
                     if (host, peer_port) in tried:
                         continue
                     tried.add((host, peer_port))
@@ -2274,6 +2353,10 @@ class SwarmDownloader:
                     )
                 token.raise_if_cancelled()
                 time.sleep(0.1)
+            # metadata-phase LAN peers must reach the swarm queue too
+            for peer in lan_peers:
+                if peer not in peers:
+                    peers.append(peer)
             log.info("fetched torrent metadata")
 
         store = PieceStore(info, self._base_dir)
@@ -2619,7 +2702,8 @@ class SwarmDownloader:
                         )
                 return None
             msg_id, payload = conn.read_message()
-            if msg_id == MSG_CHOKE:
+            if msg_id == MSG_CHOKE and index not in conn.allowed_fast:
+                # a CHOKE does not void allowed-fast transfers (BEP 6)
                 raise PeerProtocolError("peer choked mid-piece")
             if (
                 msg_id == MSG_REJECT
@@ -2656,7 +2740,9 @@ class SwarmDownloader:
                 conn.pex_peers = []
 
         conn.flush_haves()
-        while conn.choked:
+        # BEP 6: allowed-fast grants let a still-choked peer start on
+        # those pieces immediately — tit-for-tat bootstrapping
+        while conn.choked and not conn.allowed_fast:
             msg_id, _ = conn.read_message()
             conn.flush_haves()
             drain_gossip()
@@ -2666,7 +2752,25 @@ class SwarmDownloader:
                 token.raise_if_cancelled()
                 conn.flush_haves()
                 drain_gossip()
-                index = swarm.claim(conn)
+                index = swarm.claim(
+                    conn, only=conn.allowed_fast if conn.choked else None
+                )
+                if index is None and conn.choked:
+                    # settle our own batch FIRST: the claims this conn
+                    # holds may be the very pieces completing the
+                    # torrent (claim() returns None for self-claimed
+                    # pieces), and polling with them unflushed would
+                    # spin forever waiting for a done() that can't come
+                    batch.flush()
+                    if swarm.done():
+                        break  # complete: don't wait out an unchoke
+                    # allowed-fast exhausted while still choked: the
+                    # peer may yet unchoke us. Poll (not block) so a
+                    # completion by another worker releases us promptly
+                    conn.poll_messages(0.05)
+                    conn.flush_haves()
+                    drain_gossip()
+                    continue
                 if index is swarm.WAIT:
                     # every missing piece is claimed by another worker;
                     # one may come back via release() if that worker's
@@ -2679,9 +2783,11 @@ class SwarmDownloader:
                 if index is None:
                     break  # done, or nothing left this peer can provide
                 try:
-                    if conn.choked:  # choked while we idled in WAIT
-                        while conn.choked:
-                            conn.read_message()
+                    if conn.choked and index not in conn.allowed_fast:
+                        # choked while we idled in WAIT; poll so an
+                        # endgame win on this piece frees us promptly
+                        while conn.choked and not store.have[index]:
+                            conn.poll_messages(0.05)
                     data = self._download_piece(conn, store, index)
                     if data is not None:
                         batch.add(index, data)
@@ -2887,7 +2993,7 @@ class _SwarmState:
                 if peer not in self.peer_queue:
                     self.peer_queue.append(peer)
 
-    def claim(self, conn: PeerConnection):
+    def claim(self, conn: PeerConnection, only=None):
         """The RAREST unclaimed missing piece this peer advertises
         (availability ranked across registered connections' live
         bitfields, ties broken randomly — anacrolix's selection order
@@ -2901,6 +3007,10 @@ class _SwarmState:
         loop. This is what keeps the tail from stalling behind one slow
         peer. Returns WAIT when the peer could help later but not now;
         None when the torrent is done or this peer has nothing useful.
+
+        With ``only`` (a set of indices), claims are restricted to it —
+        the BEP 6 allowed-fast case, where a still-choked peer may be
+        asked for exactly those pieces.
 
         O(pieces × conns) per claim; fine for the handful of
         connections a job runs (reference effective concurrency is 1)."""
@@ -2917,6 +3027,8 @@ class _SwarmState:
             for index in range(self._scan_start, store.num_pieces):
                 if store.have[index]:
                     self._dup_claims.pop(index, None)
+                    continue
+                if only is not None and index not in only:
                     continue
                 peer_has = not conn.bitfield or conn.has_piece(index)
                 if index in self._claimed:

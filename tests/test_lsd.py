@@ -17,7 +17,10 @@ import pytest
 
 from downloader_tpu.fetch import lsd
 
-INFO_HASH = hashlib.sha1(b"lsd-test-torrent").digest()
+# per-run random hash: these tests announce on the REAL well-known
+# multicast group, and a fixed value would cross-talk with another
+# test run on the same host/LAN
+INFO_HASH = hashlib.sha1(os.urandom(20)).digest()
 
 
 def _multicast_available() -> bool:

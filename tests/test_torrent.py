@@ -1211,8 +1211,14 @@ class TestInboundPeer:
             PeerConnection,
         )
 
-        data = bytes(range(256)) * 300
+        from downloader_tpu.fetch.peer import allowed_fast_set
+
+        # > k pieces so some piece is NOT an allowed-fast grant (tiny
+        # torrents are fully granted and legitimately served choked)
+        data = bytes(range(256)) * 4096  # 1 MiB => 32 pieces
         listener, _, info_hash, _ = self._seeded_listener(tmp_path, data)
+        granted = allowed_fast_set("127.0.0.1", info_hash, 32)
+        target = next(i for i in range(32) if i not in granted)
         try:
             with PeerConnection(
                 "127.0.0.1",
@@ -1222,8 +1228,11 @@ class TestInboundPeer:
                 CancelToken(),
                 timeout=5,
             ) as conn:
-                # REQUEST without INTERESTED/UNCHOKE: must yield nothing
-                conn.send_message(MSG_REQUEST, struct.pack(">III", 0, 0, 1024))
+                # non-granted REQUEST without INTERESTED/UNCHOKE:
+                # must yield nothing
+                conn.send_message(
+                    MSG_REQUEST, struct.pack(">III", target, 0, 1024)
+                )
                 conn._sock.settimeout(0.5)
                 got_piece = False
                 try:
@@ -1632,6 +1641,120 @@ class TestChoker:
             listener.close()
 
 
+class TestAllowedFast:
+    """BEP 6 allowed-fast: the listener grants a canonical per-peer
+    piece set that may be requested while CHOKED — tit-for-tat
+    bootstrapping for peers the choker keeps waiting."""
+
+    PIECE = 32 * 1024
+
+    def test_canonical_set_properties(self):
+        from downloader_tpu.fetch.peer import allowed_fast_set
+
+        info_hash = hashlib.sha1(b"af-test").digest()
+        got = allowed_fast_set("80.4.4.200", info_hash, 1313, k=7)
+        assert len(got) == 7 and all(0 <= i < 1313 for i in got)
+        # deterministic, and /24-scoped: the last octet must not matter
+        assert got == allowed_fast_set("80.4.4.200", info_hash, 1313, k=7)
+        assert got == allowed_fast_set("80.4.4.7", info_hash, 1313, k=7)
+        assert got != allowed_fast_set("80.4.5.200", info_hash, 1313, k=7)
+        # small torrents: every piece is allowed
+        assert allowed_fast_set("10.0.0.1", info_hash, 3) == {0, 1, 2}
+        # non-v4 addresses: no set (the spec defines the v4 derivation)
+        assert allowed_fast_set("2001:db8::1", info_hash, 100) == set()
+
+    def _seeded_listener(self, tmp_path, data, **kwargs):
+        info, _, _ = make_torrent("movie.mkv", data, self.PIECE)
+        store = PieceStore(info, str(tmp_path))
+        for i in range(store.num_pieces):
+            store.write_piece(
+                i, data[i * self.PIECE : i * self.PIECE + store.piece_size(i)]
+            )
+        info_bytes = encode(info)
+        info_hash = hashlib.sha1(info_bytes).digest()
+        listener = PeerListener(info_hash, generate_peer_id(), **kwargs)
+        listener.attach(store, info_bytes)
+        return listener, info_hash
+
+    def test_choked_requests_served_only_for_grants(self, tmp_path):
+        """Without ever being unchoked (max_unchoked=0): a granted
+        piece is served, a non-granted one is REJECTed."""
+        from downloader_tpu.fetch.peer import (
+            MSG_PIECE,
+            MSG_REJECT,
+            MSG_REQUEST,
+            PeerConnection,
+        )
+
+        data = bytes(range(256)) * 1024  # 8 pieces
+        listener, info_hash = self._seeded_listener(
+            tmp_path, data, max_unchoked=0
+        )
+        try:
+            with PeerConnection(
+                "127.0.0.1",
+                listener.port,
+                info_hash,
+                generate_peer_id(),
+                CancelToken(),
+                timeout=5,
+            ) as conn:
+                deadline = time.monotonic() + 5
+                while (
+                    len(conn.allowed_fast) < 8
+                    and time.monotonic() < deadline
+                ):
+                    conn.read_message()
+                # 8 pieces <= k: everything is granted
+                assert conn.allowed_fast == set(range(8))
+                assert conn.choked
+                granted = next(iter(conn.allowed_fast))
+                conn.send_message(
+                    MSG_REQUEST, struct.pack(">III", granted, 0, 4096)
+                )
+                while True:
+                    msg_id, payload = conn.read_message()
+                    if msg_id == MSG_PIECE:
+                        index, _ = struct.unpack(">II", payload[:8])
+                        assert index == granted
+                        break
+        finally:
+            listener.close()
+
+    def test_full_leech_while_always_choked(self, tmp_path):
+        """A listener that NEVER unchokes (max_unchoked=0) serving a
+        small torrent: the downloader completes purely over
+        allowed-fast grants."""
+        data = os.urandom(self.PIECE * 7 + 99)  # 8 pieces, all granted
+        listener, info_hash = self._seeded_listener(
+            tmp_path / "seed", data, max_unchoked=0
+        )
+        with SwarmTracker() as tracker:
+            tracker.peers[("127.0.0.1", listener.port)] = True
+            info, meta, _ = make_torrent(
+                "movie.mkv", data, self.PIECE, trackers=(tracker.url,)
+            )
+            start = time.monotonic()
+            try:
+                downloader = SwarmDownloader(
+                    parse_metainfo(meta),
+                    str(tmp_path / "leech"),
+                    progress_interval=0.01,
+                    dht_bootstrap=(),
+                    discovery_rounds=6,
+                )
+                downloader.run(CancelToken(), lambda p: None)
+            finally:
+                listener.close()
+            elapsed = time.monotonic() - start
+            got = (tmp_path / "leech" / "movie.mkv").read_bytes()
+            assert got == data
+            # regression guard: a choked worker whose own unflushed
+            # batch holds the completing pieces once spun for ~75 s
+            # before the socket timeout rescued it
+            assert elapsed < 20, f"choked leech stalled: {elapsed:.1f}s"
+
+
 class TestPieceSelection:
     """Rarest-first + endgame (round-4 verdict #2): claim order follows
     availability across connected peers' bitfields, and the tail never
@@ -2017,7 +2140,11 @@ class TestFastExtension:
             PeerConnection,
         )
 
-        data = bytes(range(256)) * 300
+        from downloader_tpu.fetch.peer import allowed_fast_set
+
+        # > k pieces so a non-granted piece exists (allowed-fast
+        # grants are legitimately served while choked)
+        data = bytes(range(256)) * 4096  # 32 pieces
         info, _, _ = make_torrent("movie.mkv", data, 32 * 1024)
         store = PieceStore(info, str(tmp_path))
         for i in range(store.num_pieces):
@@ -2029,6 +2156,8 @@ class TestFastExtension:
             hashlib.sha1(info_bytes).digest(), generate_peer_id()
         )
         listener.attach(store, info_bytes)
+        granted = allowed_fast_set("127.0.0.1", listener.info_hash, 32)
+        target = next(i for i in range(32) if i not in granted)
         try:
             with PeerConnection(
                 "127.0.0.1",
@@ -2038,9 +2167,9 @@ class TestFastExtension:
                 CancelToken(),
                 timeout=5,
             ) as conn:
-                # REQUEST while still choked (no INTERESTED sent): a
-                # BEP 6 server answers with REJECT echoing the request
-                request = struct.pack(">III", 0, 0, 1024)
+                # non-granted REQUEST while still choked (no INTERESTED
+                # sent): a BEP 6 server answers with REJECT echoing it
+                request = struct.pack(">III", target, 0, 1024)
                 conn.send_message(MSG_REQUEST, request)
                 while True:
                     msg_id, payload = conn.read_message()
