@@ -1,10 +1,11 @@
-"""Mainline DHT client (BEP 5): trackerless peer discovery.
+"""Mainline DHT (BEP 5): trackerless peer discovery, both halves.
 
 The reference's anacrolix/torrent ships a full DHT node (server +
-routing table); a download job only needs the *client* half — an
-iterative ``get_peers`` lookup over KRPC/UDP — so that is what this
-implements, mirroring the reference's fresh-state-per-job design
-(torrent.go:43-44): one lookup, no long-lived routing table.
+routing table). Here ``DHTClient`` is the lookup/announce half (an
+iterative ``get_peers`` over KRPC/UDP) and ``DHTNode`` is the serving
+half (answers ping/find_node/get_peers/announce_peer), each created
+fresh per job, mirroring the reference's per-job client design
+(torrent.go:43-44).
 
 Lookup algorithm (Kademlia): keep a shortlist of nodes sorted by XOR
 distance to the info-hash, query the closest unqueried ones in rounds of
@@ -15,11 +16,13 @@ and stop when a round yields nothing new or enough peers are in hand.
 
 from __future__ import annotations
 
+import hashlib
 import ipaddress
 import secrets
 import selectors
 import socket
 import struct
+import threading
 import time
 
 from ..utils import get_logger
@@ -239,9 +242,9 @@ class DHTClient:
         announce_peer to the closest responding nodes (using the write
         token each returned), registering this client's live listener
         in the DHT so other leechers can find it — the reciprocating
-        half of what anacrolix's full node does (torrent.go:44). We
-        still don't SERVE get_peers queries (no long-lived routing
-        table, by design: fresh state per job, torrent.go:43-44)."""
+        half of what anacrolix's full node does (torrent.go:44). The
+        SERVING half (answering queries) is DHTNode below; a job runs
+        one of each, fresh per job (torrent.go:43-44)."""
         if len(info_hash) != 20:
             raise DHTError("info-hash must be 20 bytes")
 
@@ -333,3 +336,315 @@ class DHTClient:
                 "dht lookup found peers"
             )
         return peers
+
+
+# ---------------------------------------------------------------------------
+# serving node
+
+
+def _compact_nodes(entries) -> bytes:
+    """BEP 5 compact node info: 26 bytes per (node_id, ip, port)."""
+    blob = bytearray()
+    for node_id, host, port in entries:
+        try:
+            blob += node_id + socket.inet_aton(host) + struct.pack(">H", port)
+        except (OSError, struct.error):
+            continue  # non-v4 addr: not representable in compact form
+    return bytes(blob)
+
+
+PEER_TTL = 30 * 60.0  # announce_peer registrations expire after 30 min
+TOKEN_ROTATE = 300.0  # BEP 5: tokens stay valid up to ~10 min (2 epochs)
+
+
+class DHTNode:
+    """The serving half of a mainline DHT citizen (BEP 5): answers
+    ping / find_node / get_peers / announce_peer over KRPC, so peers
+    can discover THIS host through the DHT — the role anacrolix's
+    long-running node plays for the reference (torrent.go:44), scoped
+    to a job here like everything else.
+
+    Documented simplifications vs a full Kademlia implementation:
+    the routing table is a bounded cache of the nodes XOR-closest to
+    our id (no K-bucket splitting/replacement lists), queriers are
+    admitted tentatively without a verification ping, and it is
+    IPv4-only like the compact wire format the client half speaks.
+    """
+
+    def __init__(
+        self,
+        node_id: bytes | None = None,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        bootstrap: tuple[tuple[str, int], ...] = (),
+        max_nodes: int = 256,
+        max_peers_per_hash: int = 64,
+        max_hashes: int = 64,
+    ):
+        self.node_id = node_id or secrets.token_bytes(20)
+        self._max_nodes = max_nodes
+        self._max_peers_per_hash = max_peers_per_hash
+        # tokens bind the announcer's IP, not the info-hash, so one
+        # token holder could otherwise register unbounded distinct
+        # hashes — cap the registry breadth too
+        self._max_hashes = max_hashes
+        self._lock = threading.Lock()
+        # node_id -> (host, port); bounded, XOR-closest to our id win
+        self._table: dict[bytes, tuple[str, int]] = {}
+        # info_hash -> {(host, port): registered_at}
+        self._peers: dict[bytes, dict[tuple[str, int], float]] = {}
+        # two-epoch write-token secrets (current, previous)
+        self._secrets = [secrets.token_bytes(8), secrets.token_bytes(8)]
+        self._rotated = time.monotonic()
+        self._closed = False
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            self.sock.bind((host, port))
+        except OSError:
+            self.sock.close()
+            raise
+        self.sock.settimeout(1.0)  # close() can't interrupt recvfrom
+        self.port = self.sock.getsockname()[1]
+        threading.Thread(
+            target=self._serve, daemon=True, name=f"dht-node-{self.port}"
+        ).start()
+        if bootstrap:
+            # off the constructor: hostname routers mean synchronous
+            # DNS, and __init__ runs on the job's startup path
+            threading.Thread(
+                target=lambda: [self._send_ping(a) for a in bootstrap],
+                daemon=True,
+                name=f"dht-bootstrap-{self.port}",
+            ).start()
+
+    # -- token + table ---------------------------------------------------
+
+    def _token_for(self, ip: str, secret: bytes) -> bytes:
+        return hashlib.sha1(secret + ip.encode()).digest()[:8]
+
+    def _check_token(self, ip: str, token: bytes) -> bool:
+        return any(token == self._token_for(ip, s) for s in self._secrets)
+
+    def _distance(self, node_id: bytes) -> int:
+        return int.from_bytes(node_id, "big") ^ int.from_bytes(
+            self.node_id, "big"
+        )
+
+    def _learn(self, node_id, addr) -> None:
+        """Admit a node (querier or ping respondent) into the table;
+        when full, only nodes closer than the current farthest get in."""
+        if (
+            not isinstance(node_id, bytes)
+            or len(node_id) != 20
+            or node_id == self.node_id
+        ):
+            return
+        with self._lock:
+            if node_id in self._table:
+                self._table[node_id] = addr
+                return
+            if len(self._table) >= self._max_nodes:
+                farthest = max(self._table, key=self._distance)
+                if self._distance(node_id) >= self._distance(farthest):
+                    return
+                del self._table[farthest]
+            self._table[node_id] = addr
+
+    def _closest(self, target: bytes, k: int = K) -> list:
+        t = int.from_bytes(target, "big")
+        with self._lock:
+            entries = [
+                (int.from_bytes(nid, "big") ^ t, nid, host, port)
+                for nid, (host, port) in self._table.items()
+            ]
+        entries.sort()
+        return [(nid, host, port) for _, nid, host, port in entries[:k]]
+
+    # -- serving ---------------------------------------------------------
+
+    def _send_ping(self, addr) -> None:
+        try:
+            self.sock.sendto(
+                bencode.encode(
+                    {
+                        b"t": secrets.token_bytes(2),
+                        b"y": b"q",
+                        b"q": b"ping",
+                        b"a": {b"id": self.node_id},
+                    }
+                ),
+                addr,
+            )
+        except OSError:
+            pass  # bootstrap is best-effort
+
+    def _reply(self, addr, tid: bytes, args: dict) -> None:
+        try:
+            self.sock.sendto(
+                bencode.encode(
+                    {b"t": tid, b"y": b"r", b"r": {b"id": self.node_id, **args}}
+                ),
+                addr,
+            )
+        except OSError:
+            pass
+
+    def _error(self, addr, tid: bytes, code: int, text: bytes) -> None:
+        try:
+            self.sock.sendto(
+                bencode.encode({b"t": tid, b"y": b"e", b"e": [code, text]}),
+                addr,
+            )
+        except OSError:
+            pass
+
+    def _serve(self) -> None:
+        while not self._closed:
+            # every iteration, not just idle ones: a node fed at least
+            # one datagram per second would otherwise never rotate and
+            # its write tokens would stay valid forever
+            self._maybe_rotate()
+            try:
+                datagram, addr = self.sock.recvfrom(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # closed
+            try:
+                msg = bencode.decode(datagram)
+            except bencode.BencodeError:
+                continue
+            if not isinstance(msg, dict):
+                continue
+            tid = msg.get(b"t")
+            if not isinstance(tid, bytes):
+                continue
+            kind = msg.get(b"y")
+            if kind == b"r":
+                # a reply to one of our bootstrap pings: learn the node
+                reply = msg.get(b"r")
+                if isinstance(reply, dict):
+                    self._learn(reply.get(b"id"), addr)
+                continue
+            if kind != b"q":
+                continue
+            args = msg.get(b"a")
+            if not isinstance(args, dict):
+                self._error(addr, tid, 203, b"missing arguments")
+                continue
+            self._learn(args.get(b"id"), addr)
+            method = msg.get(b"q")
+            try:
+                if method == b"ping":
+                    self._reply(addr, tid, {})
+                elif method == b"find_node":
+                    self._on_find_node(addr, tid, args)
+                elif method == b"get_peers":
+                    self._on_get_peers(addr, tid, args)
+                elif method == b"announce_peer":
+                    self._on_announce(addr, tid, args)
+                else:
+                    self._error(addr, tid, 204, b"method unknown")
+            except Exception:  # pragma: no cover - hostile input guard
+                self._error(addr, tid, 202, b"server error")
+
+    def _on_find_node(self, addr, tid, args) -> None:
+        target = args.get(b"target")
+        if not isinstance(target, bytes) or len(target) != 20:
+            self._error(addr, tid, 203, b"bad target")
+            return
+        self._reply(addr, tid, {b"nodes": _compact_nodes(self._closest(target))})
+
+    def _on_get_peers(self, addr, tid, args) -> None:
+        info_hash = args.get(b"info_hash")
+        if not isinstance(info_hash, bytes) or len(info_hash) != 20:
+            self._error(addr, tid, 203, b"bad info_hash")
+            return
+        token = self._token_for(addr[0], self._secrets[0])
+        now = time.monotonic()
+        with self._lock:
+            registry = self._peers.get(info_hash, {})
+            live = [
+                peer
+                for peer, seen in registry.items()
+                if now - seen < PEER_TTL
+            ]
+        if live:
+            values = []
+            for host, port in live[:50]:
+                try:
+                    values.append(
+                        socket.inet_aton(host) + struct.pack(">H", port)
+                    )
+                except (OSError, struct.error):
+                    continue
+            self._reply(addr, tid, {b"token": token, b"values": values})
+        else:
+            self._reply(
+                addr,
+                tid,
+                {
+                    b"token": token,
+                    b"nodes": _compact_nodes(self._closest(info_hash)),
+                },
+            )
+
+    def _on_announce(self, addr, tid, args) -> None:
+        info_hash = args.get(b"info_hash")
+        token = args.get(b"token")
+        port = args.get(b"port")
+        if not isinstance(info_hash, bytes) or len(info_hash) != 20:
+            self._error(addr, tid, 203, b"bad info_hash")
+            return
+        if not isinstance(token, bytes) or not self._check_token(
+            addr[0], token
+        ):
+            # BEP 5: announces must present a token from a recent
+            # get_peers, or anyone could register arbitrary victims
+            self._error(addr, tid, 203, b"bad token")
+            return
+        if args.get(b"implied_port"):
+            port = addr[1]
+        if not isinstance(port, int) or not 0 < port < 65536:
+            self._error(addr, tid, 203, b"bad port")
+            return
+        now = time.monotonic()
+        with self._lock:
+            # purge expired registrations/registries so memory shrinks
+            # (get_peers only filters at read time)
+            for known_hash in list(self._peers):
+                registry = self._peers[known_hash]
+                for peer, seen in list(registry.items()):
+                    if now - seen >= PEER_TTL:
+                        del registry[peer]
+                if not registry:
+                    del self._peers[known_hash]
+            if (
+                info_hash not in self._peers
+                and len(self._peers) >= self._max_hashes
+            ):
+                # evict the registry whose freshest entry is stalest
+                victim = min(
+                    self._peers, key=lambda h: max(self._peers[h].values())
+                )
+                del self._peers[victim]
+            registry = self._peers.setdefault(info_hash, {})
+            registry[(addr[0], port)] = now
+            if len(registry) > self._max_peers_per_hash:
+                # evict the stalest registration
+                oldest = min(registry, key=registry.get)
+                del registry[oldest]
+        self._reply(addr, tid, {})
+
+    def _maybe_rotate(self) -> None:
+        now = time.monotonic()
+        if now - self._rotated >= TOKEN_ROTATE:
+            self._secrets = [secrets.token_bytes(8), self._secrets[0]]
+            self._rotated = now
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass

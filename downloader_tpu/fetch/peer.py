@@ -2038,8 +2038,9 @@ class SwarmDownloader:
         ``port`` is the live listener port to advertise. With
         ``allow_empty`` an empty swarm is returned as [] so the caller
         can re-announce later — but only when at least one tracker
-        actually responded; a job whose every peer source is dead still
-        raises, keeping failure prompt and diagnosable."""
+        responded or a DHT lookup completed; a job whose every peer
+        source is dead still raises, keeping failure prompt and
+        diagnosable."""
         peers: list[tuple[str, int]] = list(self._job.peer_hints)
         tracker_answered = False  # some tracker returned a non-empty swarm
         tracker_responded = False  # some tracker answered at all
@@ -2107,6 +2108,7 @@ class SwarmDownloader:
             if token is not None:
                 token.raise_if_cancelled()
 
+        dht_responded = False
         if not tracker_answered and self._dht_bootstrap != ():
             from .dht import DHTClient, DHTError
 
@@ -2114,6 +2116,14 @@ class SwarmDownloader:
                 info_hash=self._job.info_hash.hex()
             ).info("no peers from trackers; trying dht")
             try:
+                # NOTE: our own serving node is deliberately NOT in the
+                # client's bootstrap — announcing to it over loopback
+                # would register 127.0.0.1 (useless to remote queriers)
+                # and our own lookups would read back our own listener,
+                # bypassing the empty-swarm retry. Remote nodes learn
+                # our node via its bootstrap pings and return it in
+                # their `nodes` answers, so announces reach it with a
+                # real source address.
                 client = (
                     DHTClient(bootstrap=self._dht_bootstrap)
                     if self._dht_bootstrap is not None
@@ -2130,12 +2140,15 @@ class SwarmDownloader:
                 ):
                     if peer not in peers:
                         peers.append(peer)
+                dht_responded = True
             except DHTError as exc:
                 errors.append(str(exc))
 
         if not peers:
-            if allow_empty and tracker_responded:
-                return []  # live tracker, swarm just hasn't formed yet
+            if allow_empty and (tracker_responded or dht_responded):
+                # a live tracker (or a completed DHT lookup) answered;
+                # the swarm just hasn't formed yet — retry next round
+                return []
             raise TransferError(
                 f"no peers from {len(self._job.trackers)} tracker(s), "
                 f"{len(self._job.peer_hints)} hint(s), or dht: "
@@ -2171,6 +2184,20 @@ class SwarmDownloader:
             collections.deque(maxlen=64)
         )
         self._lsd_swarm_sink = None  # set once the swarm exists
+        # our serving DHT node (BEP 5), when DHT + listener are live:
+        # this host answers ping/find_node/get_peers/announce_peer so
+        # other leechers can route through and register with us — the
+        # full-citizen role anacrolix's node plays (torrent.go:44)
+        self._dht_node = None
+        if listener is not None and self._dht_bootstrap != ():
+            try:
+                from .dht import DEFAULT_BOOTSTRAP, DHTNode
+
+                self._dht_node = DHTNode(
+                    bootstrap=self._dht_bootstrap or DEFAULT_BOOTSTRAP
+                )
+            except OSError as exc:
+                log.with_fields(error=str(exc)).info("dht node unavailable")
         # our live listener port, advertised on outbound connections
         # via BEP 10 "p" so dialed peers can dial us back
         self._advertise_port = (
@@ -2196,6 +2223,8 @@ class SwarmDownloader:
                 self._utp_mux.close()
             if self._lsd_client is not None:
                 self._lsd_client.close()
+            if self._dht_node is not None:
+                self._dht_node.close()
             if listener is not None:
                 # drain only after a successful download: a completed
                 # job lingers briefly so remote leechers (peers seen

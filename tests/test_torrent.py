@@ -335,7 +335,12 @@ class TestSwarmDownload:
                 ).download(
                     CancelToken(), str(tmp_path), lambda u, p: None, magnet
                 )
-                assert not router.queries, "DHT queried despite tracker answer"
+                # the serving node's bootstrap PING is expected (we
+                # join the DHT regardless); the LOOKUP must not run
+                lookups = [
+                    q for q in router.queries if q[b"q"] == b"get_peers"
+                ]
+                assert not lookups, "DHT queried despite tracker answer"
         assert (tmp_path / "movie.mkv").read_bytes() == PAYLOAD
 
     def test_dead_x_pe_hint_falls_back_to_dht(self, seeder, tmp_path):
@@ -2817,3 +2822,221 @@ class TestMidDownloadCancellation:
             p: p.stat().st_size for p in tmp_path.rglob("*") if p.is_file()
         }
         assert snapshot == after, "files changed after cancellation"
+
+
+class TestDHTNode:
+    """The serving DHT half (BEP 5): this host answers KRPC queries —
+    ping/find_node/get_peers/announce_peer — making it a full DHT
+    citizen like the reference's anacrolix node (torrent.go:44)."""
+
+    def _krpc(self, sock, addr, method, args, tid=b"aa"):
+        from downloader_tpu.fetch.bencode import decode, encode
+
+        sock.sendto(
+            encode({b"t": tid, b"y": b"q", b"q": method, b"a": args}), addr
+        )
+        reply = decode(sock.recvfrom(65536)[0])
+        assert reply[b"t"] == tid
+        return reply
+
+    def test_ping_find_node_learns_queriers(self):
+        from downloader_tpu.fetch.dht import DHTNode
+
+        node = DHTNode()
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.settimeout(5)
+        try:
+            my_id = bytes(20)
+            reply = self._krpc(
+                sock, ("127.0.0.1", node.port), b"ping", {b"id": my_id}
+            )
+            assert reply[b"y"] == b"r"
+            assert reply[b"r"][b"id"] == node.node_id
+            # the querier was learned: find_node for our own id
+            # returns us in compact form
+            reply = self._krpc(
+                sock,
+                ("127.0.0.1", node.port),
+                b"find_node",
+                {b"id": my_id, b"target": my_id},
+            )
+            nodes = reply[b"r"][b"nodes"]
+            assert my_id in nodes  # 26-byte records; our id is in there
+        finally:
+            sock.close()
+            node.close()
+
+    def test_get_peers_announce_roundtrip_and_token_gate(self):
+        from downloader_tpu.fetch.dht import DHTNode
+
+        node = DHTNode()
+        info_hash = hashlib.sha1(b"dht-node-test").digest()
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.settimeout(5)
+        try:
+            addr = ("127.0.0.1", node.port)
+            reply = self._krpc(
+                sock,
+                addr,
+                b"get_peers",
+                {b"id": bytes(20), b"info_hash": info_hash},
+            )
+            token = reply[b"r"][b"token"]
+            assert b"values" not in reply[b"r"]  # nothing announced yet
+
+            # bad token refused with a KRPC error
+            bad = self._krpc(
+                sock,
+                addr,
+                b"announce_peer",
+                {
+                    b"id": bytes(20),
+                    b"info_hash": info_hash,
+                    b"port": 7001,
+                    b"token": b"wrong",
+                },
+            )
+            assert bad[b"y"] == b"e" and bad[b"e"][0] == 203
+
+            ok = self._krpc(
+                sock,
+                addr,
+                b"announce_peer",
+                {
+                    b"id": bytes(20),
+                    b"info_hash": info_hash,
+                    b"port": 7001,
+                    b"token": token,
+                },
+            )
+            assert ok[b"y"] == b"r"
+            reply = self._krpc(
+                sock,
+                addr,
+                b"get_peers",
+                {b"id": bytes(20), b"info_hash": info_hash},
+            )
+            values = reply[b"r"][b"values"]
+            assert struct.unpack(">H", values[0][4:6])[0] == 7001
+
+            # implied_port: the announce's SOURCE port wins
+            implied = self._krpc(
+                sock,
+                addr,
+                b"announce_peer",
+                {
+                    b"id": b"\x01" * 20,
+                    b"info_hash": info_hash,
+                    b"port": 1,
+                    b"implied_port": 1,
+                    b"token": token,
+                },
+            )
+            assert implied[b"y"] == b"r"
+            reply = self._krpc(
+                sock,
+                addr,
+                b"get_peers",
+                {b"id": bytes(20), b"info_hash": info_hash},
+            )
+            ports = {
+                struct.unpack(">H", v[4:6])[0] for v in reply[b"r"][b"values"]
+            }
+            assert sock.getsockname()[1] in ports
+        finally:
+            sock.close()
+            node.close()
+
+    def test_client_announce_discoverable_by_second_client(self):
+        from downloader_tpu.fetch.dht import DHTClient, DHTNode
+
+        node = DHTNode()
+        info_hash = hashlib.sha1(b"dht-rendezvous").digest()
+        try:
+            first = DHTClient(
+                bootstrap=(("127.0.0.1", node.port),), query_timeout=1.0
+            )
+            assert first.get_peers(info_hash, announce_port=7777) == []
+            second = DHTClient(
+                bootstrap=(("127.0.0.1", node.port),), query_timeout=1.0
+            )
+            assert second.get_peers(info_hash) == [("127.0.0.1", 7777)]
+        finally:
+            node.close()
+
+    def test_nodes_bootstrap_each_other(self):
+        from downloader_tpu.fetch.dht import DHTNode
+
+        a = DHTNode()
+        b = DHTNode(bootstrap=(("127.0.0.1", a.port),))
+        try:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                with a._lock, b._lock:
+                    if (
+                        b.node_id in a._table
+                        and a.node_id in b._table
+                    ):
+                        break
+                time.sleep(0.05)
+            with a._lock:
+                assert b.node_id in a._table  # learned from the ping
+            with b._lock:
+                assert a.node_id in b._table  # learned from the reply
+        finally:
+            a.close()
+            b.close()
+
+    def test_swarm_rendezvous_via_dht_only(self, tmp_path):
+        """Two downloaders, NO trackers, no LSD: they meet purely
+        through the DHT — each runs a serving node bootstrapped at a
+        hub node, announces its listener, and finds the other's
+        announce on a later round."""
+        from downloader_tpu.fetch.dht import DHTNode
+
+        hub = DHTNode()
+        piece = 32 * 1024
+        data = os.urandom(piece * 5 + 444)
+        info, meta, _ = make_torrent("movie.mkv", data, piece)
+        try:
+            dirs = [tmp_path / "a", tmp_path / "b"]
+            for idx, d in enumerate(dirs):
+                store = PieceStore(info, str(d))
+                for i in range(store.num_pieces):
+                    if i % 2 == idx:
+                        store.write_piece(
+                            i,
+                            data[i * piece : i * piece + store.piece_size(i)],
+                        )
+            downloaders = [
+                SwarmDownloader(
+                    parse_metainfo(meta),
+                    str(d),
+                    progress_interval=0.01,
+                    dht_bootstrap=(("127.0.0.1", hub.port),),
+                    discovery_rounds=20,
+                )
+                for d in dirs
+            ]
+            errs: dict = {}
+
+            def run(idx):
+                try:
+                    downloaders[idx].run(CancelToken(), lambda p: None)
+                    errs[idx] = None
+                except Exception as exc:  # noqa: BLE001 - asserted below
+                    errs[idx] = exc
+
+            threads = [
+                threading.Thread(target=run, args=(i,)) for i in range(2)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert all(not t.is_alive() for t in threads), "swarm hung"
+            assert errs == {0: None, 1: None}, errs
+            for d in dirs:
+                assert (d / "movie.mkv").read_bytes() == data
+        finally:
+            hub.close()
