@@ -113,6 +113,10 @@ class DHTClient:
         self._bootstrap = bootstrap
         self._node_id = node_id or secrets.token_bytes(20)
         self._query_timeout = query_timeout
+        # did the LAST get_peers lookup hear from any node at all?
+        # Distinguishes "lookup completed, swarm just empty" (worth
+        # retrying) from "nobody answered" (every source dead)
+        self.responded = False
 
     # -- KRPC ------------------------------------------------------------
 
@@ -247,6 +251,7 @@ class DHTClient:
         one of each, fresh per job (torrent.go:43-44)."""
         if len(info_hash) != 20:
             raise DHTError("info-hash must be 20 bytes")
+        self.responded = False
 
         def distance(node_id: bytes) -> int:
             return int.from_bytes(node_id, "big") ^ int.from_bytes(
@@ -278,6 +283,8 @@ class DHTClient:
                 replies = self._query_round(
                     pool, candidates, b"get_peers", {b"info_hash": info_hash}
                 )
+                if replies:
+                    self.responded = True
                 progressed = False
                 for reply_addr, reply in replies.items():
                     reply_token = reply.get(b"token")
@@ -562,12 +569,20 @@ class DHTNode:
             return
         token = self._token_for(addr[0], self._secrets[0])
         now = time.monotonic()
+        # loopback registrations (same-host announcers, e.g. this very
+        # job's client) are meaningless to a remote querier — scope
+        # them to requesters that are themselves loopback
+        requester_local = ipaddress.ip_address(addr[0]).is_loopback
         with self._lock:
             registry = self._peers.get(info_hash, {})
             live = [
                 peer
                 for peer, seen in registry.items()
                 if now - seen < PEER_TTL
+                and (
+                    requester_local
+                    or not ipaddress.ip_address(peer[0]).is_loopback
+                )
             ]
         if live:
             values = []

@@ -2138,9 +2138,19 @@ class SwarmDownloader:
                     token,
                     announce_port=dht_announce_port,
                 ):
+                    if (
+                        peer[1] == dht_announce_port
+                        and ipaddress.ip_address(peer[0]).is_loopback
+                    ):
+                        # our own announce read back through our own
+                        # serving node — not a swarm member
+                        continue
                     if peer not in peers:
                         peers.append(peer)
-                dht_responded = True
+                # responded = some node actually answered; a lookup
+                # into a dead network returns [] WITHOUT error and must
+                # not count as "the swarm is just empty, retry"
+                dht_responded = client.responded
             except DHTError as exc:
                 errors.append(str(exc))
 

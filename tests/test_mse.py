@@ -180,6 +180,40 @@ class TestHandshake:
             with pytest.raises(mse.MSEError):
                 mse._secret(12345, bad.to_bytes(mse.DH_KEY_BYTES, "big"))
 
+    def test_byte_dribbled_handshake(self):
+        """The whole MSE negotiation arriving one byte per write (worst
+        TCP segmentation): the sync scans and length-prefixed reads
+        must hold up."""
+        a, b = self._pair()
+        result: dict = {}
+        thread = self._run_accept(b, result)
+
+        class Dribbler:
+            """Socket proxy whose sendall emits one byte per write —
+            the worst-case TCP segmentation for the receiver."""
+
+            def __init__(self, sock):
+                self._sock = sock
+
+            def sendall(self, data: bytes) -> None:
+                for i in range(len(data)):
+                    self._sock.sendall(data[i : i + 1])
+
+            def __getattr__(self, name):
+                return getattr(self._sock, name)
+
+        sock = mse.initiate(Dribbler(a), INFO_HASH, ia=b"DRIBBLE")
+        thread.join(timeout=20)
+        assert "err" not in result, result.get("err")
+        assert result["ia"] == b"DRIBBLE"
+        sock.sendall(b"after")
+        got = b""
+        while len(got) < 5:
+            got += result["sock"].recv(5 - len(got))
+        assert got == b"after"
+        a.close()
+        b.close()
+
     def test_non_mse_garbage_fails_fast(self):
         a, b = self._pair()
         result: dict = {}

@@ -2987,6 +2987,94 @@ class TestDHTNode:
             a.close()
             b.close()
 
+    def test_dead_dht_does_not_count_as_responsive(self):
+        """get_peers into a silent network returns [] WITHOUT error;
+        client.responded must stay False so _discover_peers still
+        fails fast instead of burning empty retry rounds."""
+        from downloader_tpu.fetch.dht import DHTClient, DHTNode
+
+        mute = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        mute.bind(("127.0.0.1", 0))
+        client = DHTClient(
+            bootstrap=(("127.0.0.1", mute.getsockname()[1]),),
+            query_timeout=0.3,
+        )
+        try:
+            assert client.get_peers(hashlib.sha1(b"x").digest()) == []
+            assert client.responded is False
+        finally:
+            mute.close()
+        live = DHTNode()
+        try:
+            client = DHTClient(
+                bootstrap=(("127.0.0.1", live.port),), query_timeout=1.0
+            )
+            assert client.get_peers(hashlib.sha1(b"x").digest()) == []
+            assert client.responded is True
+        finally:
+            live.close()
+
+    def test_survives_malformed_datagram_storm(self):
+        """Hostile/garbage KRPC input must never kill the serve thread:
+        after the storm the node still answers honest queries."""
+        from downloader_tpu.fetch.bencode import encode
+        from downloader_tpu.fetch.dht import DHTNode
+
+        node = DHTNode()
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        probe.settimeout(5)
+        addr = ("127.0.0.1", node.port)
+        try:
+            storm = [
+                b"",
+                b"junk",
+                os.urandom(300),
+                encode([1, 2, 3]),  # non-dict
+                encode({b"y": b"q"}),  # no tid
+                encode({b"t": [1], b"y": b"q"}),  # unhashable tid
+                encode({b"t": b"xx", b"y": b"q", b"q": b"ping"}),  # no args
+                encode(
+                    {  # bad lengths everywhere
+                        b"t": b"xx",
+                        b"y": b"q",
+                        b"q": b"get_peers",
+                        b"a": {b"id": b"short", b"info_hash": b"tiny"},
+                    }
+                ),
+                encode(
+                    {  # unknown method
+                        b"t": b"xx",
+                        b"y": b"q",
+                        b"q": b"frobnicate",
+                        b"a": {b"id": bytes(20)},
+                    }
+                ),
+            ]
+            for datagram in storm:
+                probe.sendto(datagram, addr)
+            from downloader_tpu.fetch.bencode import decode
+
+            probe.sendto(
+                encode(
+                    {
+                        b"t": b"ok",
+                        b"y": b"q",
+                        b"q": b"ping",
+                        b"a": {b"id": bytes(20)},
+                    }
+                ),
+                addr,
+            )
+            # the storm legitimately drew KRPC error replies; skip them
+            while True:
+                reply = decode(probe.recvfrom(65536)[0])
+                if reply.get(b"t") == b"ok":
+                    break
+            assert reply[b"y"] == b"r" and reply[b"r"][b"id"] == node.node_id
+        finally:
+            probe.close()
+            node.close()
+
     def test_swarm_rendezvous_via_dht_only(self, tmp_path):
         """Two downloaders, NO trackers, no LSD: they meet purely
         through the DHT — each runs a serving node bootstrapped at a

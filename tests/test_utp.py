@@ -102,6 +102,39 @@ class TestStream:
         assert len(got) == len(blob)
         assert hashlib.sha1(got).hexdigest() == hashlib.sha1(blob).hexdigest()
 
+    def test_reordered_delivery(self, pair):
+        """Datagram reordering (not loss): hold every 5th packet back
+        and deliver it AFTER the next few — the reassembly buffer must
+        restore byte order exactly."""
+        conn, peer = pair
+        real_send = conn._send_raw
+        counter = [0]
+        held: list = []
+
+        def reordering(data: bytes) -> None:
+            counter[0] += 1
+            if counter[0] % 5 == 0:
+                held.append(data)
+                return
+            real_send(data)
+            if len(held) >= 2:  # release out of order, oldest last
+                for delayed in reversed(held):
+                    real_send(delayed)
+                held.clear()
+
+        conn._send_raw = reordering
+        blob = os.urandom(512 * 1024)
+
+        def sender():
+            conn.sendall(blob)
+            for delayed in held:  # flush any stragglers before FIN
+                real_send(delayed)
+            conn.close()
+
+        threading.Thread(target=sender, daemon=True).start()
+        got = _drain_to_eof(peer)
+        assert hashlib.sha1(got).hexdigest() == hashlib.sha1(blob).hexdigest()
+
     def test_recv_timeout(self, pair):
         conn, _ = pair
         conn.settimeout(0.2)
