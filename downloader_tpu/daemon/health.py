@@ -22,7 +22,7 @@ import http.server
 import json
 import threading
 
-from ..utils import get_logger
+from ..utils import get_logger, metrics
 
 log = get_logger("daemon.health")
 
@@ -86,6 +86,9 @@ class HealthServer:
             "queue_publish_retries": queue_stats.publish_retries,
             "queue_reconnects": queue_stats.reconnects,
             "queue_consumer_errors": queue_stats.consumer_errors,
+            # transfer-layer totals (http/torrent/dht/s3) accrue in the
+            # process-wide registry — per-job objects are ephemeral
+            **dict(sorted(metrics.GLOBAL.snapshot().items())),
         }
 
     def _healthz(self) -> tuple[int, bytes, str]:

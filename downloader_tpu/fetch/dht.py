@@ -25,7 +25,7 @@ import struct
 import threading
 import time
 
-from ..utils import get_logger
+from ..utils import get_logger, metrics
 from ..utils.cancel import CancelToken
 from . import bencode
 from .http import TransferError
@@ -541,6 +541,10 @@ class DHTNode:
                 continue
             self._learn(args.get(b"id"), addr)
             method = msg.get(b"q")
+            # counted pre-validation, so named "received" not "served":
+            # garbage that only draws an error reply must not read as
+            # legitimate DHT load
+            metrics.GLOBAL.add("dht_queries_received")
             try:
                 if method == b"ping":
                     self._reply(addr, tid, {})

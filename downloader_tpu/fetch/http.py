@@ -32,7 +32,7 @@ try:
 except ImportError:  # non-Unix: the splice path is gated off with it
     fcntl = None  # type: ignore[assignment]
 
-from ..utils import get_logger
+from ..utils import get_logger, metrics
 from ..utils.netio import SocketWaiter
 from ..utils.cancel import Cancelled, CancelToken
 from .dispatch import BackendRegistration, ProgressFn
@@ -410,6 +410,8 @@ class HTTPBackend:
             break
 
         os.replace(part_path, final_path)
+        metrics.GLOBAL.add("http_bytes_fetched", offset)
+        metrics.GLOBAL.add("http_files_fetched")
         progress(url, 100.0)
 
 

@@ -41,7 +41,7 @@ import urllib.parse
 import urllib.request
 
 from ..parallel import DigestEngine, default_engine
-from ..utils import get_logger
+from ..utils import get_logger, metrics
 from ..utils.cancel import Cancelled, CancelToken
 from ..utils.netio import SocketWaiter
 from . import bencode, mse, utp
@@ -1114,6 +1114,8 @@ class PieceStore:
                         break
                 file_start = file_end
             self.have[index] = True
+        metrics.GLOBAL.add("torrent_pieces_verified")
+        metrics.GLOBAL.add("torrent_bytes_downloaded", len(data))
         # notify outside the write lock: observers hit the network (HAVE
         # broadcasts) and must not serialize piece writes behind a slow
         # remote's socket
@@ -2252,6 +2254,8 @@ class SwarmDownloader:
                     log.with_fields(
                         blocks=self.blocks_served, bytes=self.bytes_served
                     ).info("served peers while downloading")
+            metrics.GLOBAL.add("torrent_bytes_served", self.bytes_served)
+            metrics.GLOBAL.add("torrent_blocks_served", self.blocks_served)
             # lifecycle announces, fire-and-forget (teardown must not
             # wait on trackers) but SEQUENCED in one thread: "completed"
             # first (anacrolix announces completion too), then BEP 3

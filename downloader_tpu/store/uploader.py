@@ -22,7 +22,7 @@ import base64
 import os
 from dataclasses import dataclass, field
 
-from ..utils import get_logger
+from ..utils import get_logger, metrics
 from ..utils.cancel import CancelToken
 from .credentials import from_env
 from .s3 import S3Client, S3Error
@@ -92,6 +92,8 @@ class Uploader:
                         self._bucket, key, stream, size, token=token
                     )
                 log.info("finished upload")
+                metrics.GLOBAL.add("s3_bytes_uploaded", size)
+                metrics.GLOBAL.add("s3_objects_uploaded")
                 result.uploaded.append((file_path, key))
             except (OSError, S3Error) as exc:
                 log.error(f"failed to upload file '{file_path}'", exc=exc)

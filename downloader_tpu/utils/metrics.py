@@ -1,0 +1,40 @@
+"""Process-wide metric counters.
+
+The daemon/queue layers keep their own structured stats objects; the
+transfer layers (fetch backends, DHT node, uploader) are per-job and
+ephemeral, so their totals accrue here instead — a tiny thread-safe
+registry the health endpoint folds into ``/metrics``. The reference
+has no metrics at all (SURVEY.md §5); this is part of the rebuild's
+observability additions (SURVEY.md §7 step 9).
+
+Counters only (monotonic); callers pick snake_case names that read as
+Prometheus metrics once prefixed, e.g. ``torrent_bytes_served`` →
+``downloader_torrent_bytes_served``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+
+class Counters:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._values: "defaultdict[str, int]" = defaultdict(int)
+
+    def add(self, name: str, value: int = 1) -> None:
+        with self._lock:
+            self._values[name] += value
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._values)
+
+    def reset(self) -> None:
+        """Test isolation only; production counters are monotonic."""
+        with self._lock:
+            self._values.clear()
+
+
+GLOBAL = Counters()

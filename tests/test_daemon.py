@@ -321,6 +321,10 @@ def test_health_endpoint(harness):
             body = resp.read().decode()
         assert "downloader_jobs_processed 1" in body
         assert "downloader_broker_connected 1" in body
+        # transfer-layer totals (process-wide registry) ride along:
+        # this job fetched one file over HTTP and uploaded it to S3
+        assert "downloader_http_files_fetched" in body
+        assert "downloader_s3_objects_uploaded" in body
 
         try:
             with urllib.request.urlopen(f"http://127.0.0.1:{server.port}/nope"):
