@@ -106,6 +106,11 @@ MAX_REQUEST_LENGTH = 128 * 1024
 UT_METADATA = 1  # our local extended-message id for ut_metadata
 UT_PEX = 2  # our local extended-message id for ut_pex (BEP 11)
 
+
+def _is_private(info) -> bool:
+    """BEP 27: the info dict's private flag (trackers-only swarm)."""
+    return isinstance(info, dict) and info.get(b"private") == 1
+
 # MSE policy → outbound connection attempts, in order. The reference's
 # anacrolix client accepts and initiates obfuscated connections by
 # default (Config.HeaderObfuscationPolicy); inbound, every policy but
@@ -2004,6 +2009,8 @@ class SwarmDownloader:
         self._listen_port = listen_port
         # MSE policy for both halves (ENCRYPTION_MODES keys)
         self._encryption = encryption
+        # BEP 27 private flag; set properly once the info dict is known
+        self._private = False
         # outbound transport policy (TRANSPORT_MODES keys); the
         # listener accepts both TCP and uTP regardless
         self._transport = transport
@@ -2111,7 +2118,12 @@ class SwarmDownloader:
                 token.raise_if_cancelled()
 
         dht_responded = False
-        if not tracker_answered and self._dht_bootstrap != ():
+        if (
+            not tracker_answered
+            and self._dht_bootstrap != ()
+            # BEP 27: private torrents never touch the DHT
+            and not self._private
+        ):
             from .dht import DHTClient, DHTError
 
             log.with_fields(
@@ -2201,7 +2213,14 @@ class SwarmDownloader:
         # other leechers can route through and register with us — the
         # full-citizen role anacrolix's node plays (torrent.go:44)
         self._dht_node = None
-        if listener is not None and self._dht_bootstrap != ():
+        self._private = False  # re-derived per run by _run
+        if (
+            listener is not None
+            and self._dht_bootstrap != ()
+            # a metainfo job already known private (BEP 27) has no use
+            # for a serving node; magnets learn too late to gate here
+            and not _is_private(self._job.info)
+        ):
             try:
                 from .dht import DEFAULT_BOOTSTRAP, DHTNode
 
@@ -2308,13 +2327,20 @@ class SwarmDownloader:
         announce_event = "started"
         dht_port = listener.port if listener is not None else None
 
+        # BEP 27: a private torrent must use its trackers ONLY — no
+        # DHT, no LSD, no PEX. Known up front for metainfo jobs; magnet
+        # jobs learn it with the metadata (the bootstrap lookup that
+        # fetched the metadata is the unavoidable exception, noted
+        # below where it lands).
+        self._private = _is_private(info)
+
         # BEP 14 local discovery starts NOW — before the metadata
         # phase — so a magnet whose only peer is on the LAN can
         # bootstrap its metadata from it. Heard peers buffer in
         # _lsd_heard until the swarm exists, then flow into its queue.
         # Needs a real listener (the announce carries a port someone
         # must be able to dial); degrades silently without multicast.
-        if listener is not None and self._lsd:
+        if listener is not None and self._lsd and not self._private:
             try:
                 from .lsd import LSD
 
@@ -2335,8 +2361,13 @@ class SwarmDownloader:
         if info is None:
             discovery_error: Exception | None = None
             try:
+                # dht_announce_port=None: whether this magnet is
+                # PRIVATE (BEP 27) is unknown until the metadata
+                # arrives, and a DHT announce for a private info-hash
+                # would persist in remote nodes for their peer TTL; the
+                # first post-metadata discovery round announces instead
                 peers = self._discover_peers(
-                    left=1, token=token, port=port, dht_announce_port=dht_port
+                    left=1, token=token, port=port, dht_announce_port=None
                 )
                 announce_event = ""
             except TransferError as exc:
@@ -2396,11 +2427,27 @@ class SwarmDownloader:
                     )
                 token.raise_if_cancelled()
                 time.sleep(0.1)
-            # metadata-phase LAN peers must reach the swarm queue too
-            for peer in lan_peers:
-                if peer not in peers:
-                    peers.append(peer)
             log.info("fetched torrent metadata")
+            if _is_private(info):
+                # a magnet that turned out private (BEP 27): the
+                # metadata-bootstrap lookup already happened — that is
+                # the unavoidable exception — but from here on the job
+                # is trackers-only: stop LSD, forget LAN/DHT-sourced
+                # peers (peers=None forces a tracker-only rediscovery),
+                # and the _private flag gates DHT and PEX below
+                self._private = True
+                if self._lsd_client is not None:
+                    self._lsd_client.close()
+                    self._lsd_client = None
+                self._lsd_heard.clear()
+                lan_peers.clear()
+                peers = None
+                log.info("private torrent: dht/lsd/pex disabled")
+            else:
+                # metadata-phase LAN peers must reach the swarm queue
+                for peer in lan_peers:
+                    if peer not in peers:
+                        peers.append(peer)
 
         store = PieceStore(info, self._base_dir)
 
@@ -2440,7 +2487,9 @@ class SwarmDownloader:
             listener.attach(
                 store,
                 info_bytes,
-                peer_source=swarm.known_peers,
+                # BEP 27: no outgoing PEX gossip for private torrents
+                # (a None source suppresses ut_pex sends entirely)
+                peer_source=None if self._private else swarm.known_peers,
                 peer_sink=lambda peer: swarm.enqueue_discovered([peer]),
             )
 
@@ -2778,6 +2827,10 @@ class SwarmDownloader:
         # whether to reciprocate based on these HAVEs — flushing only
         # after unchoke would deadlock against exactly such peers
         def drain_gossip() -> None:
+            if self._private:
+                # BEP 27: PEX must not grow a private torrent's swarm
+                conn.pex_peers = []
+                return
             if conn.pex_peers:
                 swarm.add_peers(conn.pex_peers)
                 conn.pex_peers = []

@@ -38,6 +38,7 @@ def make_torrent(
     data: bytes | dict[str, bytes],
     piece_length: int = 32 * 1024,
     trackers: tuple[str, ...] = (),
+    private: bool = False,
 ) -> tuple[dict, bytes, bytes]:
     """Build (info_dict, metainfo_bytes, content_blob) for a single- or
     multi-file torrent held in memory."""
@@ -67,6 +68,8 @@ def make_torrent(
     )
     pieces = b"".join(piece_digests)
     info[b"pieces"] = pieces
+    if private:
+        info[b"private"] = 1  # BEP 27
     meta: dict = {b"info": info}
     if trackers:
         meta[b"announce"] = trackers[0].encode()
@@ -153,8 +156,11 @@ class Seeder:
         corrupt_pieces: tuple[int, ...] = (),
         serve_limit: int | None = None,
         serve_delay: float = 0.0,
+        private: bool = False,
     ):
-        self.info, self.metainfo, self.blob = make_torrent(name, data, piece_length)
+        self.info, self.metainfo, self.blob = make_torrent(
+            name, data, piece_length, private=private
+        )
         self.info_bytes = bencode.encode(self.info)
         self.info_hash = hashlib.sha1(self.info_bytes).digest()
         self.piece_length = piece_length

@@ -2824,6 +2824,87 @@ class TestMidDownloadCancellation:
         assert snapshot == after, "files changed after cancellation"
 
 
+class TestPrivateTorrents:
+    """BEP 27: a private torrent uses its trackers ONLY — no DHT
+    lookup/announce, no LSD, no PEX in either direction (trackers on
+    private swarms ban clients that leak)."""
+
+    PIECE = 32 * 1024
+
+    def test_private_job_never_touches_dht_and_completes(self, tmp_path):
+        data = bytes(range(256)) * 600
+        with Seeder("movie.mkv", data, private=True) as s:
+            with SwarmTracker() as tracker:
+                tracker.peers[
+                    ("127.0.0.1", s.peer_address[1])
+                ] = True
+                info, meta, _ = make_torrent(
+                    "movie.mkv",
+                    data,
+                    self.PIECE,
+                    trackers=(tracker.url,),
+                    private=True,
+                )
+                assert info[b"private"] == 1
+                with FakeDHTNode(values=[("10.9.8.7", 1234)]) as router:
+                    downloader = SwarmDownloader(
+                        parse_metainfo(meta),
+                        str(tmp_path),
+                        progress_interval=0.01,
+                        dht_bootstrap=(router.address,),
+                        lsd=True,  # must be suppressed by the flag
+                    )
+                    downloader.run(CancelToken(), lambda p: None)
+                    # a known-private metainfo job must not even start
+                    # a serving node, so NOTHING reaches the router
+                    assert not router.queries, (
+                        f"private torrent leaked to DHT: {router.queries}"
+                    )
+                    assert downloader._dht_node is None
+                assert downloader._lsd_client is None  # LSD suppressed
+        assert (tmp_path / "movie.mkv").read_bytes() == data
+
+    def test_private_listener_sends_no_pex(self, tmp_path):
+        """An inbound leecher that negotiates ut_pex on a private
+        torrent's listener must receive no PEX message."""
+        from downloader_tpu.fetch.peer import PeerConnection, PeerListener
+
+        data = bytes(range(256)) * 300
+        info, _, _ = make_torrent(
+            "movie.mkv", data, self.PIECE, private=True
+        )
+        store = PieceStore(info, str(tmp_path))
+        for i in range(store.num_pieces):
+            store.write_piece(
+                i, data[i * self.PIECE : i * self.PIECE + store.piece_size(i)]
+            )
+        info_bytes = encode(info)
+        listener = PeerListener(
+            hashlib.sha1(info_bytes).digest(), generate_peer_id()
+        )
+        # what SwarmDownloader does for private jobs: no peer_source
+        listener.attach(store, info_bytes, peer_source=None)
+        try:
+            with PeerConnection(
+                "127.0.0.1",
+                listener.port,
+                listener.info_hash,
+                generate_peer_id(),
+                CancelToken(),
+                timeout=5,
+            ) as conn:
+                got_pex = False
+                deadline = time.monotonic() + 1.5
+                while time.monotonic() < deadline:
+                    conn.poll_messages(0.1)
+                    if conn.pex_peers:
+                        got_pex = True
+                        break
+                assert not got_pex, "private listener gossiped PEX"
+        finally:
+            listener.close()
+
+
 class TestDHTNode:
     """The serving DHT half (BEP 5): this host answers KRPC queries —
     ping/find_node/get_peers/announce_peer — making it a full DHT
