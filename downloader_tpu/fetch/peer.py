@@ -2213,7 +2213,6 @@ class SwarmDownloader:
         # other leechers can route through and register with us — the
         # full-citizen role anacrolix's node plays (torrent.go:44)
         self._dht_node = None
-        self._private = False  # re-derived per run by _run
         if (
             listener is not None
             and self._dht_bootstrap != ()
