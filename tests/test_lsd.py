@@ -153,16 +153,54 @@ class TestDiscovery:
 
 
 @needs_multicast
+def _seed_disjoint(info, dirs, data, piece):
+    """Give each dir every len(dirs)-th piece: full disjoint coverage,
+    so completion requires every peer to serve every other."""
+    from downloader_tpu.fetch.peer import PieceStore
+
+    for idx, d in enumerate(dirs):
+        store = PieceStore(info, str(d))
+        for i in range(store.num_pieces):
+            if i % len(dirs) == idx:
+                store.write_piece(
+                    i, data[i * piece : i * piece + store.piece_size(i)]
+                )
+
+
+def _run_swarm(downloaders, timeout=90):
+    """Run every downloader to completion concurrently; assert none
+    hang and none fail."""
+    from downloader_tpu.utils.cancel import CancelToken
+
+    errs: dict = {}
+
+    def run(idx):
+        try:
+            downloaders[idx].run(CancelToken(), lambda p: None)
+            errs[idx] = None
+        except Exception as exc:  # noqa: BLE001 - asserted below
+            errs[idx] = exc
+
+    threads = [
+        threading.Thread(target=run, args=(i,))
+        for i in range(len(downloaders))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    assert all(not t.is_alive() for t in threads), "swarm hung"
+    assert errs == {i: None for i in range(len(downloaders))}, errs
+
+
 class TestSwarmViaLSD:
     def test_mutual_leech_discovered_by_lsd_only(self, tmp_path):
         """Each downloader announces to its own PRIVATE tracker (which
         therefore never knows the other peer) and DHT is off: the only
         way they can find each other is the BEP 14 multicast group."""
-        from downloader_tpu.fetch.bencode import encode
         from downloader_tpu.fetch.magnet import parse_metainfo
-        from downloader_tpu.fetch.peer import PieceStore, SwarmDownloader
+        from downloader_tpu.fetch.peer import SwarmDownloader
         from downloader_tpu.fetch.seeder import SwarmTracker, make_torrent
-        from downloader_tpu.utils.cancel import CancelToken
 
         piece = 32 * 1024
         data = os.urandom(piece * 5 + 321)
@@ -174,14 +212,7 @@ class TestSwarmViaLSD:
                 for t in trackers
             ]
             dirs = [tmp_path / "a", tmp_path / "b"]
-            for idx, d in enumerate(dirs):
-                store = PieceStore(info, str(d))
-                for i in range(store.num_pieces):
-                    if i % 2 == idx:
-                        store.write_piece(
-                            i,
-                            data[i * piece : i * piece + store.piece_size(i)],
-                        )
+            _seed_disjoint(info, dirs, data, piece)
             downloaders = [
                 SwarmDownloader(
                     parse_metainfo(metas[idx]),
@@ -193,29 +224,51 @@ class TestSwarmViaLSD:
                 )
                 for idx in range(2)
             ]
-            errs: dict = {}
-
-            def run(idx):
-                try:
-                    downloaders[idx].run(CancelToken(), lambda p: None)
-                    errs[idx] = None
-                except Exception as exc:  # noqa: BLE001 - asserted below
-                    errs[idx] = exc
-
-            threads = [
-                threading.Thread(target=run, args=(i,)) for i in range(2)
-            ]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join(timeout=90)
-            assert all(not t.is_alive() for t in threads), "swarm hung"
-            assert errs == {0: None, 1: None}, errs
+            _run_swarm(downloaders)
             for d in dirs:
                 assert (d / "movie.mkv").read_bytes() == data
         finally:
             for t in trackers:
                 t.__exit__(None, None, None)
+
+    def test_everything_on_capstone_swarm(self, tmp_path):
+        """All the round's machinery engaged at once: THREE downloaders
+        with NO tracker, discovery via a DHT hub + LSD multicast,
+        REQUIRED MSE encryption over TCP-or-uTP, the choker rationing
+        slots, allowed-fast grants, and mutual piece serving — each
+        peer starts with a disjoint third and must finish."""
+        from downloader_tpu.fetch.dht import DHTNode
+        from downloader_tpu.fetch.magnet import parse_metainfo
+        from downloader_tpu.fetch.peer import SwarmDownloader
+        from downloader_tpu.fetch.seeder import make_torrent
+
+        piece = 32 * 1024
+        data = os.urandom(piece * 8 + 123)
+        info, meta, _ = make_torrent("movie.mkv", data, piece)
+        hub = DHTNode()
+        try:
+            dirs = [tmp_path / f"peer{i}" for i in range(3)]
+            _seed_disjoint(info, dirs, data, piece)
+            downloaders = [
+                SwarmDownloader(
+                    parse_metainfo(meta),
+                    str(d),
+                    progress_interval=0.01,
+                    dht_bootstrap=(("127.0.0.1", hub.port),),
+                    discovery_rounds=30,
+                    lsd=True,
+                    encryption="require",
+                    transport="both",
+                )
+                for d in dirs
+            ]
+            _run_swarm(downloaders, timeout=120)
+            for d in dirs:
+                assert (d / "movie.mkv").read_bytes() == data
+            # mutual serving actually happened on every peer
+            assert all(dl.blocks_served > 0 for dl in downloaders)
+        finally:
+            hub.close()
 
     def test_magnet_bootstraps_metadata_from_lan_peer(self, tmp_path):
         """The headline trackerless case: a MAGNET job with zero
@@ -223,22 +276,15 @@ class TestSwarmViaLSD:
         LAN peer found via BEP 14, then completes mutually."""
         from downloader_tpu.fetch.bencode import encode
         from downloader_tpu.fetch.magnet import parse_magnet, parse_metainfo
-        from downloader_tpu.fetch.peer import PieceStore, SwarmDownloader
+        from downloader_tpu.fetch.peer import SwarmDownloader
         from downloader_tpu.fetch.seeder import make_torrent
-        from downloader_tpu.utils.cancel import CancelToken
 
         piece = 32 * 1024
         data = os.urandom(piece * 5 + 222)
         info, meta, _ = make_torrent("movie.mkv", data, piece)
         info_hash = hashlib.sha1(encode(info)).digest()
         dirs = [tmp_path / "meta-side", tmp_path / "magnet-side"]
-        for idx, d in enumerate(dirs):
-            store = PieceStore(info, str(d))
-            for i in range(store.num_pieces):
-                if i % 2 == idx:
-                    store.write_piece(
-                        i, data[i * piece : i * piece + store.piece_size(i)]
-                    )
+        _seed_disjoint(info, dirs, data, piece)
         jobs = [
             parse_metainfo(meta),  # has metadata, but NO trackers
             parse_magnet(
@@ -256,21 +302,6 @@ class TestSwarmViaLSD:
             )
             for idx in range(2)
         ]
-        errs: dict = {}
-
-        def run(idx):
-            try:
-                downloaders[idx].run(CancelToken(), lambda p: None)
-                errs[idx] = None
-            except Exception as exc:  # noqa: BLE001 - asserted below
-                errs[idx] = exc
-
-        threads = [threading.Thread(target=run, args=(i,)) for i in range(2)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=90)
-        assert all(not t.is_alive() for t in threads), "swarm hung"
-        assert errs == {0: None, 1: None}, errs
+        _run_swarm(downloaders)
         for d in dirs:
             assert (d / "movie.mkv").read_bytes() == data
