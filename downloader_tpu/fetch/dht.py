@@ -17,6 +17,7 @@ and stop when a round yields nothing new or enough peers are in hand.
 from __future__ import annotations
 
 import hashlib
+import hmac
 import ipaddress
 import secrets
 import selectors
@@ -430,7 +431,13 @@ class DHTNode:
         return hashlib.sha1(secret + ip.encode()).digest()[:8]
 
     def _check_token(self, ip: str, token: bytes) -> bool:
-        return any(token == self._token_for(ip, s) for s in self._secrets)
+        # constant-time compare: token bytes are attacker-supplied, and
+        # == leaks a timing oracle an off-path attacker could use to
+        # forge announce_peer registrations without doing get_peers
+        ok = False
+        for s in self._secrets:
+            ok |= hmac.compare_digest(token, self._token_for(ip, s))
+        return ok
 
     def _distance(self, node_id: bytes) -> int:
         return int.from_bytes(node_id, "big") ^ int.from_bytes(

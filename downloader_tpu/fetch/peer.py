@@ -132,6 +132,11 @@ TRANSPORT_MODES: dict[str, tuple[str, ...]] = {
     "both": ("tcp", "utp"),
 }
 UTP_CONNECT_TIMEOUT = 5.0  # a dead UDP port gives no refusal signal
+# dead-silent-peer reap horizon for idle poll loops: 2x BEP 3's upper
+# keepalive cadence ("generally sent once every two minutes") plus
+# grace, so one jittered keepalive never gets a healthy choked peer
+# reaped — the same dead-vs-quiet margin the AMQP heartbeat uses
+IDLE_REAP_TIMEOUT = 250.0
 
 
 def generate_peer_id() -> bytes:
@@ -440,7 +445,9 @@ class PeerConnection:
         self._pending_haves: "collections.deque[int]" = collections.deque()
         self.blocks_served = 0
         self.bytes_served = 0
+        self._timeout = timeout
         self._last_send = time.monotonic()
+        self._last_recv = time.monotonic()
         self._poll_waiter: SocketWaiter | None = None
         self._sock: "socket.socket | mse.EncryptedSocket | None" = None
         self._remove_cancel_hook = token.add_callback(self.close)
@@ -649,6 +656,9 @@ class PeerConnection:
         bitfield / extension state as a side effect."""
         while True:
             length = struct.unpack(">I", self._recv_exact(4))[0]
+            # any complete frame header — keepalives included — proves
+            # the peer alive; poll_messages' idle reaper keys off this
+            self._last_recv = time.monotonic()
             if length == 0:
                 continue  # keepalive
             if length > (1 << 20) + 9:
@@ -776,7 +786,25 @@ class PeerConnection:
         updating choke/bitfield state. Used while holding a connection
         idle (swarm WAIT) so a remote CHOKE is processed now instead of
         surfacing as a stale frame mid-piece later. Readability is
-        checked first so an idle wait never consumes a partial frame."""
+        checked first so an idle wait never consumes a partial frame.
+
+        Reaps dead-silent peers: the worker's choked/WAIT states call
+        this in a loop that (unlike a blocking read_message, which hits
+        the socket timeout) would otherwise never time out, so a peer
+        that handshakes and then says nothing forever would pin a
+        worker thread. A peer silent past the connection timeout is
+        raised out as a protocol error. The horizon is NOT the socket
+        timeout: a healthy choked peer with nothing to say legitimately
+        sends only keepalives, every ~60-120 s per BEP 3 (our own
+        cadence is 60 s, and our inbound loop reads under a 120 s
+        socket timeout) — so reap only past 2x the 120 s upper
+        cadence, the same dead-vs-quiet margin the AMQP heartbeat
+        uses."""
+        reap_after = max(self._timeout, IDLE_REAP_TIMEOUT)
+        if time.monotonic() - self._last_recv > reap_after:
+            raise PeerProtocolError(
+                f"peer silent for over {reap_after:.0f}s while idle"
+            )
         deadline = time.monotonic() + duration
         # SocketWaiter, not bare select.select: select raises ValueError
         # for fds >= FD_SETSIZE (possible in the long-lived daemon) and

@@ -127,6 +127,7 @@ class UTPSocket:
         # rx state
         self._ack = 0  # last in-order seq received
         self._ooo: dict[int, bytes] = {}  # out-of-order reassembly
+        self._ooo_bytes = 0  # bytes buffered in _ooo (RECV_WINDOW cap)
         self._stream = bytearray()  # ordered bytes ready for recv()
         self._last_ts_diff = 0
         self._fin_seq: int | None = None
@@ -217,15 +218,21 @@ class UTPSocket:
                 self._cwnd + max(1, len(acked)) / max(1, self._cwnd),
             )
             self._writable.notify_all()
-        elif self._inflight:
-            # an ack that acks nothing while data is in flight: the
+        elif self._inflight and ptype == ST_STATE:
+            # a pure ack that acks nothing while data is in flight: the
             # remote is missing our head-of-line packet (it acks
             # immediately on every gap arrival — delayed acks mean the
             # value itself may differ from the last one we saw, so no
-            # equality test). Two in a row = fast retransmit without
-            # waiting out the RTO: AIMD keeps the window small after a
-            # loss, so TCP's classic 3 may never accumulate, and a
-            # spurious head retransmit costs one packet.
+            # equality test). Only payload-free ST_STATE counts — TCP's
+            # rule that only pure acks are duplicates: on a
+            # bidirectional transfer the remote's ST_DATA packets
+            # legitimately repeat an unchanged ack_nr whenever WE have
+            # an in-flight gap, and counting those would fire spurious
+            # head retransmits and halve cwnd repeatedly. Two in a row
+            # = fast retransmit without waiting out the RTO: AIMD keeps
+            # the window small after a loss, so TCP's classic 3 may
+            # never accumulate, and a spurious head retransmit costs
+            # one packet.
             self._dup_acks += 1
             if self._dup_acks >= 2:
                 self._dup_acks = 0
@@ -249,14 +256,25 @@ class UTPSocket:
             self._on_data_locked(seq, b"")
 
     def _on_data_locked(self, seq: int, payload: bytes) -> None:
-        gap = payload and (seq != (self._ack + 1) & 0xFFFF)
-        if payload:
-            if _seq_lt(self._ack, seq) and len(self._ooo) * MSS < RECV_WINDOW:
-                self._ooo.setdefault(seq, payload)
+        is_next = seq == (self._ack + 1) & 0xFFFF
+        gap = payload and not is_next
+        if payload and _seq_lt(self._ack, seq) and seq not in self._ooo:
+            # cap the reassembly buffer on actual buffered BYTES (a
+            # per-entry cap times MSS undercounts sub-MSS datagrams and
+            # could reject a retransmitted head while ~749 tiny packets
+            # sit buffered) — and ALWAYS admit the next-in-order packet
+            # regardless of the cap: it drains _ooo immediately below,
+            # so rejecting it would deadlock the very packet that frees
+            # the buffer
+            if is_next or self._ooo_bytes < RECV_WINDOW:
+                self._ooo[seq] = payload
+                self._ooo_bytes += len(payload)
         # drain everything now in order
         while (self._ack + 1) & 0xFFFF in self._ooo:
             self._ack = (self._ack + 1) & 0xFFFF
-            self._stream += self._ooo.pop(self._ack)
+            drained = self._ooo.pop(self._ack)
+            self._ooo_bytes -= len(drained)
+            self._stream += drained
             self._unacked += 1
         if self._fin_seq is not None and (self._ack + 1) & 0xFFFF == self._fin_seq:
             self._ack = self._fin_seq  # consume the FIN's slot

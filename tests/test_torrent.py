@@ -2134,6 +2134,66 @@ class TestKeepalive:
             server.close()
 
 
+class TestIdleReaper:
+    def test_poll_messages_reaps_dead_silent_peer(self, tmp_path):
+        """A peer that handshakes and then keeps us choked forever
+        without sending a byte used to pin a worker thread: the 20 Hz
+        poll loop (unlike a blocking read_message, which hits the
+        socket timeout) never timed out. poll_messages must raise once
+        the peer has been silent past the connection timeout."""
+        from downloader_tpu.fetch.peer import (
+            HANDSHAKE_PSTR,
+            PeerConnection,
+            PeerProtocolError,
+        )
+
+        info_hash = hashlib.sha1(b"reap").digest()
+        server = socket.create_server(("127.0.0.1", 0))
+
+        def remote():
+            sock, _ = server.accept()
+            sock.settimeout(10)
+            data = bytearray()
+            while len(data) < 68:
+                data += sock.recv(68 - len(data))
+            sock.sendall(
+                bytes([len(HANDSHAKE_PSTR)]) + HANDSHAKE_PSTR + bytes(8)
+                + info_hash + b"-RP0000-" + b"r" * 12
+            )
+            # ...and then total silence: never unchoke, never keepalive
+            try:
+                sock.recv(1)
+            except OSError:
+                pass
+            sock.close()
+
+        th = threading.Thread(target=remote, daemon=True)
+        th.start()
+        conn = PeerConnection(
+            "127.0.0.1",
+            server.getsockname()[1],
+            info_hash,
+            generate_peer_id(),
+            CancelToken(),
+            timeout=5,
+        )
+        try:
+            # fresh activity: an idle poll returns without raising
+            conn.poll_messages(0.05)
+            # silence shorter than the reap horizon is legitimate (a
+            # choked peer keepalives only every ~60-120 s, and one
+            # jittered keepalive must not kill it): no reap
+            conn._last_recv = time.monotonic() - 200
+            conn.poll_messages(0.05)
+            # silence past the reap horizon: dead, raised out
+            conn._last_recv = time.monotonic() - 300
+            with pytest.raises(PeerProtocolError, match="silent"):
+                conn.poll_messages(0.05)
+        finally:
+            conn.close()
+            server.close()
+
+
 class TestFastExtension:
     """BEP 6 surface: compact availability (covered in TestInboundPeer)
     plus explicit REJECTs instead of silent request drops."""

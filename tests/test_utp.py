@@ -499,3 +499,84 @@ class TestProtocolEdges:
         assert t >> 4 == utp.ST_DATA and t & 0x0F == utp.VERSION
         assert (cid, tsd, wnd, seq, ack) == (7, 123, 456, 8, 9)
         assert pkt[utp.HEADER_LEN :] == b"payload"
+
+
+class TestCongestionDetails:
+    """Regression coverage for the round-4 advisor findings: dup-ack
+    accounting on bidirectional transfers, and the reassembly-buffer
+    admission rule for the next-in-order packet."""
+
+    def test_remote_data_is_not_a_duplicate_ack(self, pair):
+        """Only pure ST_STATE counts toward fast-retransmit (TCP's
+        pure-ack rule). On a bidirectional transfer the remote's
+        ST_DATA packets legitimately repeat an unchanged ack_nr while
+        WE have an in-flight gap; counting them used to fire spurious
+        head retransmits and halve cwnd toward CWND_MIN."""
+        conn, peer = pair
+        sent: list[bytes] = []
+        conn._send_raw = sent.append
+        with conn._lock:
+            seq0 = conn._seq
+            conn._inflight[seq0] = (b"HEADPKT", time.monotonic(), 1)
+            conn._seq = (conn._seq + 1) & 0xFFFF
+            stale_ack = (seq0 - 1) & 0xFFFF
+            base = conn._ack
+            cwnd_before = conn._cwnd
+        # four remote DATA packets, all carrying the stale ack
+        for i in range(4):
+            conn._on_packet(
+                utp.ST_DATA,
+                (base + 1 + i) & 0xFFFF,
+                stale_ack,
+                utp._now_us(),
+                1 << 20,
+                b"x",
+            )
+        assert conn._dup_acks == 0
+        assert conn._cwnd >= cwnd_before  # no loss-signal halving
+        assert b"HEADPKT" not in sent  # no spurious retransmit
+        # ...but two PURE acks with the same stale ack do fast-retransmit
+        conn._on_packet(
+            utp.ST_STATE, 0, stale_ack, utp._now_us(), 1 << 20, b""
+        )
+        conn._on_packet(
+            utp.ST_STATE, 0, stale_ack, utp._now_us(), 1 << 20, b""
+        )
+        assert b"HEADPKT" in sent
+        with conn._lock:
+            conn._inflight.clear()  # let teardown proceed cleanly
+
+    def test_next_in_order_admitted_past_entry_flood(self, pair):
+        """A spec-compliant remote may send sub-MSS datagrams: ~800
+        one-byte out-of-order packets sit far under the byte window but
+        blew the old per-entry cap (749 = RECV_WINDOW/MSS), after which
+        the retransmitted head was dropped forever and the stream
+        stalled. The next-in-order packet must ALWAYS be admitted — it
+        drains the buffer immediately."""
+        conn, peer = pair
+        with peer._lock:
+            base = peer._ack
+            for i in range(800):
+                peer._on_data_locked((base + 2 + i) & 0xFFFF, b"z")
+            assert len(peer._ooo) == 800
+            assert not peer._stream  # head still missing
+            peer._on_data_locked((base + 1) & 0xFFFF, b"h")
+            assert not peer._ooo  # fully drained
+            assert bytes(peer._stream) == b"h" + b"z" * 800
+            assert peer._ooo_bytes == 0
+
+    def test_reassembly_cap_counts_bytes_not_entries(self, pair):
+        """Full-size out-of-order packets past the byte window are
+        rejected (bounded memory), while the byte accounting tracks
+        admissions exactly."""
+        conn, peer = pair
+        big = b"b" * utp.MSS
+        with peer._lock:
+            base = peer._ack
+            admitted = 0
+            for i in range(1000):  # 1000 * 1400 B > 1 MiB window
+                peer._on_data_locked((base + 2 + i) & 0xFFFF, big)
+                admitted = len(peer._ooo)
+            assert admitted < 1000  # cap engaged
+            assert peer._ooo_bytes == admitted * utp.MSS
+            assert peer._ooo_bytes < utp.RECV_WINDOW + utp.MSS
