@@ -32,7 +32,8 @@ longer runs average the host's multi-second noise bursts, measured
 tightening per-pair ratio spread from ~0.1 to ~0.03),
 BENCH_CONCURRENCY (default 6), BENCH_SLICES (alternating sub-runs per
 pair, default 4), BENCH_REPEATS (pairs, default 5), BENCH_DIR (default
-/dev/shm if present).
+/dev/shm if present), BENCH_ABLATION=0 to skip the sub-ratio ablation,
+BENCH_ABLATION_REPEATS (interleaved triples, default 3).
 
 On the measurement noise: this box's absolute throughput swings ~3x on
 multi-second timescales (the same configuration has measured 85 and 580
@@ -293,6 +294,84 @@ def run_config(
         pipeline.close()
 
 
+def run_ablation(
+    jobs: int,
+    mb_per_job: int,
+    concurrency: int,
+    site: str,
+    repeats: int,
+) -> dict:
+    """Decompose the headline into two FIXED sub-ratios so the combined
+    figure is separable (the headline otherwise conflates "we lifted
+    the reference's single-goroutine limit" with "our data path is
+    faster"):
+
+    - ``data_path_ratio_c1``: zero-copy vs userspace copies, BOTH at
+      concurrency 1 — isolates the splice/sendfile data-path win
+      against the reference's io.Copy shape at the reference's own
+      concurrency (cmd/downloader/downloader.go:62,100-103).
+    - ``concurrency_ratio_zero_copy``: concurrency N vs 1, zero-copy
+      fixed on both sides — isolates the concurrency win.
+
+    Same noise defense as the headline: the three configurations run
+    interleaved (A B C per triple) so a noise burst lands on all
+    three, per-triple ratios cancel shared noise, and the median is
+    reported."""
+    configs = (
+        ("userspace_c1", dict(concurrency=1, prefetch=1, zero_copy=False)),
+        ("zerocopy_c1", dict(concurrency=1, prefetch=1, zero_copy=True)),
+        ("zerocopy_cN", dict(
+            concurrency=concurrency, prefetch=concurrency, zero_copy=True
+        )),
+    )
+    triples: list[dict] = []
+    for i in range(repeats):
+        rates: dict[str, float] = {}
+        for name, kwargs in configs:
+            moved, took = run_config(
+                jobs,
+                mb_per_job,
+                kwargs["concurrency"],
+                kwargs["prefetch"],
+                site,
+                zero_copy=kwargs["zero_copy"],
+            )
+            rates[name] = moved / took
+        triples.append(
+            {
+                "MBps": {k: round(v, 1) for k, v in rates.items()},
+                "data_path_ratio_c1": round(
+                    rates["zerocopy_c1"] / rates["userspace_c1"], 2
+                ),
+                "concurrency_ratio_zero_copy": round(
+                    rates["zerocopy_cN"] / rates["zerocopy_c1"], 2
+                ),
+            }
+        )
+        _log(
+            f"bench: ablation triple {i + 1}: "
+            f"userspace_c1 {rates['userspace_c1']:.1f} MB/s, "
+            f"zerocopy_c1 {rates['zerocopy_c1']:.1f} MB/s, "
+            f"zerocopy_c{concurrency} {rates['zerocopy_cN']:.1f} MB/s "
+            f"-> data-path {triples[-1]['data_path_ratio_c1']:.2f}x, "
+            f"concurrency {triples[-1]['concurrency_ratio_zero_copy']:.2f}x"
+        )
+
+    def median_of(key: str) -> float:
+        ordered = sorted(triple[key] for triple in triples)
+        return ordered[len(ordered) // 2]
+
+    return {
+        "metric": "ablation",
+        "data_path_ratio_c1": median_of("data_path_ratio_c1"),
+        "concurrency_ratio_zero_copy": median_of(
+            "concurrency_ratio_zero_copy"
+        ),
+        "concurrency": concurrency,
+        "triples": triples,
+    }
+
+
 def run_latency(site: str, samples: int, concurrency: int) -> float:
     """Per-job overhead: enqueue → Convert hand-off consumed, for a tiny
     payload, one job in flight at a time. Returns the median in ms
@@ -397,6 +476,29 @@ def main() -> None:
             f"{[round(r, 2) for r in ratios]} -> vs_baseline {vs_baseline:.2f}"
         )
 
+        ablation = None
+        if os.environ.get("BENCH_ABLATION", "1") != "0":
+            ablation_repeats = max(
+                1, int(os.environ.get("BENCH_ABLATION_REPEATS", 3))
+            )
+            # never inflate the requested workload (same invariant as
+            # the slice logic above): one concurrency wave per config
+            # when BENCH_JOBS allows it, else exactly what was asked
+            ablation_jobs = min(jobs, max(concurrency, jobs // max(1, slices)))
+            _log(
+                f"bench: ablation, {ablation_repeats} interleaved triples of "
+                f"{ablation_jobs} jobs x {mb_per_job} MB per config"
+            )
+            ablation = run_ablation(
+                ablation_jobs, mb_per_job, concurrency, site, ablation_repeats
+            )
+            _log(
+                f"bench: ablation medians: data-path (zero-copy vs userspace "
+                f"@ c1) {ablation['data_path_ratio_c1']:.2f}x, concurrency "
+                f"(c{concurrency} vs c1, zero-copy fixed) "
+                f"{ablation['concurrency_ratio_zero_copy']:.2f}x"
+            )
+
         latency_samples = max(3, int(os.environ.get("BENCH_LATENCY_SAMPLES", 15)))
         _log(f"bench: per-job overhead latency, {latency_samples} tiny jobs")
         tiny = os.path.join(site, "tiny.bin")
@@ -423,6 +525,8 @@ def main() -> None:
                 ],
             },
         ]
+        if ablation is not None:
+            extra_metrics.append(ablation)
         if os.environ.get("BENCH_DIGEST", "1") != "0":
             _log("bench: digest kernel micro-benchmark (pallas vs hashlib)")
             try:
