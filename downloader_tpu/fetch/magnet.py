@@ -46,7 +46,7 @@ class TorrentJob:
     trackers: tuple[str, ...] = ()
     # explicit peer addresses from the magnet's x.pe params (BEP 9)
     peer_hints: tuple[tuple[str, int], ...] = ()
-    # BEP 19 webseeds: HTTP(S) sources for the content itself, from the
+    # BEP 19 webseeds: HTTP(S)/FTP sources for the content itself, from the
     # metainfo's url-list or the magnet's ws= params
     web_seeds: tuple[str, ...] = ()
     # populated when parsed from a .torrent file (magnet jobs fetch it
@@ -91,7 +91,7 @@ def parse_magnet(uri: str) -> TorrentJob:
     web_seeds = [
         url
         for url in params.get("ws", [])
-        if url.startswith(("http://", "https://"))
+        if url.startswith(("http://", "https://", "ftp://"))
     ]
 
     return TorrentJob(
@@ -157,7 +157,10 @@ def parse_metainfo(data: bytes) -> TorrentJob:
     for entry in url_list:
         if isinstance(entry, bytes):
             url = entry.decode("utf-8", "replace")
-            if url.startswith(("http://", "https://")) and url not in web_seeds:
+            if (
+                url.startswith(("http://", "https://", "ftp://"))
+                and url not in web_seeds
+            ):
                 web_seeds.append(url)
 
     name = info.get(b"name", b"")

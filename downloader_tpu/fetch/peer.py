@@ -1190,15 +1190,17 @@ def _webseed_file_url(base: str, parts: tuple[str, ...], single: bool) -> str:
 
 
 class _WebSeedClient:
-    """Per-worker HTTP client with a persistent connection: a 4 GB
-    torrent at 1 MiB pieces would otherwise pay ~4000 TCP(/TLS)
-    handshakes to the same host, one per piece. Cancellation closes
-    the connection (the token callback), unblocking any in-flight
-    read immediately."""
+    """Per-worker HTTP/FTP client with a persistent connection: a 4 GB
+    torrent at 1 MiB pieces would otherwise pay ~4000 TCP(/TLS or
+    login) handshakes to the same host, one per piece. Cancellation
+    closes the connection (the token callback), unblocking any
+    in-flight read immediately."""
 
     def __init__(self, timeout: float = 30.0):
         self._timeout = timeout
         self._conn: "http.client.HTTPConnection | None" = None
+        self._ftp = None  # ftplib.FTP, lazily imported
+        self._ftp_data: "socket.socket | None" = None  # in-flight RETR
         self._key: tuple[str, str] | None = None
 
     def close(self) -> None:
@@ -1208,11 +1210,38 @@ class _WebSeedClient:
                 conn.close()
             except OSError:
                 pass
+        # the data socket first: the cancel hook's whole job is to
+        # unblock an in-flight recv immediately — which takes a real
+        # shutdown(); close() alone only drops the fd and leaves a
+        # concurrently-blocked recv waiting out its timeout
+        data, self._ftp_data = self._ftp_data, None
+        if data is not None:
+            try:
+                data.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                data.close()
+            except OSError:
+                pass
+        ftp, self._ftp = self._ftp, None
+        if ftp is not None:
+            try:
+                # close(), not quit(): quit() writes QUIT and BLOCKS on
+                # the reply — this runs from the cancel hook, which must
+                # unblock an in-flight read, not start a new one
+                ftp.close()
+            except OSError:
+                pass
 
     def fetch_range(self, url: str, offset: int, length: int) -> bytes:
         import http.client
 
         parsed = urllib.parse.urlsplit(url)
+        if parsed.scheme == "ftp" and parsed.netloc:
+            # BEP 19 names "HTTP/FTP seeding"; anacrolix's webseed
+            # support is what the reference inherits (torrent.go:44)
+            return self._fetch_ftp_range(parsed, offset, length, url)
         if parsed.scheme not in ("http", "https") or not parsed.netloc:
             raise _WebSeedPermanent(f"unsupported webseed url: {url}")
         key = (parsed.scheme, parsed.netloc)
@@ -1282,6 +1311,148 @@ class _WebSeedClient:
         except (http.client.HTTPException, OSError) as exc:
             self.close()
             raise TransferError(f"webseed read failed: {exc}") from exc
+
+    def _fetch_ftp_range(
+        self, parsed, offset: int, length: int, url: str
+    ) -> bytes:
+        """One range via FTP: binary RETR with a REST offset (RFC 959 /
+        RFC 3659), reading exactly ``length`` bytes then aborting the
+        transfer. The control connection persists across pieces like
+        the HTTP keep-alive; a server that gets confused by the ABOR
+        dance just costs a reconnect on the next piece."""
+        import ftplib
+
+        # torrent-supplied URL: malformed ports raise ValueError from
+        # .port, hostless netlocs give hostname None, and CR/LF smuggled
+        # through percent-encoding (in the path OR the userinfo) would
+        # inject FTP commands — all deterministic, so classify as
+        # permanent, not a traceback
+        try:
+            port = parsed.port or 21
+        except ValueError as exc:
+            raise _WebSeedPermanent(f"unsupported webseed url: {url}") from exc
+        path = urllib.parse.unquote(parsed.path) or "/"
+        # URL userinfo wins; anonymous otherwise (the conventional
+        # email-ish password)
+        user = urllib.parse.unquote(parsed.username or "anonymous")
+        passwd = urllib.parse.unquote(parsed.password or "anonymous@")
+        if not parsed.hostname or any(
+            c in field for field in (path, user, passwd) for c in "\r\n"
+        ):
+            raise _WebSeedPermanent(f"unsupported webseed url: {url}")
+
+        key = ("ftp", parsed.netloc)
+        last: Exception | None = None
+        for attempt in range(2):  # one silent retry: stale control conn
+            if self._ftp is None or self._key != key:
+                self.close()
+                ftp = ftplib.FTP(timeout=self._timeout)
+                try:
+                    ftp.connect(parsed.hostname, port)
+                    ftp.login(user, passwd)
+                    ftp.voidcmd("TYPE I")  # binary; ASCII would mangle
+                except ftplib.error_perm as exc:
+                    # 5xx on connect/login: credentials/policy — no
+                    # retry can fix it
+                    try:
+                        ftp.close()
+                    except OSError:
+                        pass
+                    raise _WebSeedPermanent(
+                        f"ftp webseed login refused: {exc}"
+                    ) from exc
+                except (ftplib.Error, OSError, EOFError) as exc:
+                    try:
+                        ftp.close()
+                    except OSError:
+                        pass
+                    last = exc
+                    continue
+                self._ftp = ftp
+                self._key = key
+            else:
+                ftp = self._ftp
+            # LOCAL binding from here on: the cancel hook's close() may
+            # null self._ftp concurrently mid-piece; operations on the
+            # closed-out local then raise OSError (caught) instead of
+            # AttributeError on None
+            discard = 0
+            try:
+                # rest=None when offset is 0: sending "REST 0" would
+                # make a REST-less server 502 every fetch, disqualifying
+                # a webseed that works fine for whole-file reads
+                data_sock = ftp.transfercmd(
+                    f"RETR {path}", rest=offset if offset else None
+                )
+            except ftplib.error_perm as exc:
+                if not offset:
+                    # 550 no-such-file etc.: deterministic — permanent
+                    self.close()
+                    raise _WebSeedPermanent(f"ftp webseed: {exc}") from exc
+                # could be REST unsupported (502/501): degrade once to a
+                # plain RETR and discard the prefix, mirroring the HTTP
+                # path's Range-ignoring-server handling; a genuine 550
+                # just fails again below, permanently
+                try:
+                    data_sock = ftp.transfercmd(f"RETR {path}")
+                    discard = offset
+                except ftplib.error_perm as exc2:
+                    self.close()
+                    raise _WebSeedPermanent(f"ftp webseed: {exc2}") from exc2
+                except (ftplib.Error, OSError, EOFError) as exc2:
+                    self.close()
+                    last = exc2
+                    continue
+            except (ftplib.Error, OSError, EOFError) as exc:
+                self.close()
+                last = exc
+                continue
+            self._ftp_data = data_sock  # cancel hook can now unblock recv
+            try:
+                data_sock.settimeout(self._timeout)
+                remaining = discard
+                while remaining > 0:
+                    skipped = data_sock.recv(min(1 << 16, remaining))
+                    if not skipped:
+                        raise TransferError(f"ftp webseed short body: {url}")
+                    remaining -= len(skipped)
+                chunk = bytearray()
+                while len(chunk) < length:
+                    got = data_sock.recv(min(1 << 16, length - len(chunk)))
+                    if not got:
+                        raise TransferError(f"ftp webseed short read: {url}")
+                    chunk += got
+            except (TransferError, OSError, EOFError) as exc:
+                # drop the whole session: the control conn is mid-RETR
+                # with an unread completion reply, useless as-is
+                self.close()
+                try:
+                    data_sock.close()
+                except OSError:
+                    pass
+                if isinstance(exc, TransferError):
+                    raise
+                raise TransferError(f"ftp webseed read failed: {exc}") from exc
+            # mid-file stop: close the data connection and ABOR, then
+            # drain whatever completion reply the server queued. Any
+            # disagreement here poisons only the control conn — drop
+            # it and the next piece reconnects.
+            self._ftp_data = None
+            try:
+                data_sock.close()
+            except OSError:
+                pass
+            try:
+                ftp.abort()
+            except (ftplib.Error, OSError, EOFError, AttributeError):
+                self.close()
+            else:
+                try:
+                    ftp.voidresp()  # the transfer's own 226/426
+                except (ftplib.Error, OSError, EOFError):
+                    self.close()
+            return bytes(chunk)
+        raise TransferError(f"ftp webseed fetch failed: {last}")
 
 
 def _fetch_webseed_piece(

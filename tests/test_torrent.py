@@ -2666,6 +2666,134 @@ class TestPEX:
             listener.close()
 
 
+class _TestFTPServer:
+    """Minimal RFC 959 server for FTP-webseed tests: USER/PASS/TYPE/
+    PASV/REST/RETR/ABOR/QUIT over an in-memory file dict, binary only.
+    Records REST offsets and RETR paths so tests can assert the ranged
+    fetch actually used resume offsets."""
+
+    def __init__(
+        self,
+        files: dict[str, bytes],
+        stall_after_send: bool = False,
+        support_rest: bool = True,
+    ):
+        self.files = files
+        # hold the data connection open (no close, no 226) after the
+        # body: models a stalled server for cancellation tests
+        self.stall_after_send = stall_after_send
+        # reply 502 to REST: models a minimal server without resume
+        self.support_rest = support_rest
+        self.rest_offsets: list[int] = []
+        self.retrs: list[str] = []
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        self.port = self._srv.getsockname()[1]
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def close(self) -> None:
+        self._srv.close()
+
+    def __enter__(self) -> "_TestFTPServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                sock, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._session, args=(sock,), daemon=True
+            ).start()
+
+    def _session(self, sock: socket.socket) -> None:
+        # ftplib sends ABOR with MSG_OOB; without OOBINLINE the urgent
+        # byte (the trailing newline) never reaches a normal read
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_OOBINLINE, 1)
+        sock.settimeout(10)
+        reader = sock.makefile("rb")
+
+        def send(line: str) -> None:
+            sock.sendall(line.encode() + b"\r\n")
+
+        rest = 0
+        data_srv: socket.socket | None = None
+        try:
+            send("220 test ftp ready")
+            while True:
+                line = reader.readline()
+                if not line:
+                    return
+                parts = line.decode("latin-1").strip().split(" ", 1)
+                cmd = parts[0].upper().strip("\xff\xf4\xf2")  # Telnet IP/DM
+                arg = parts[1] if len(parts) > 1 else ""
+                if cmd == "USER":
+                    send("331 password please")
+                elif cmd == "PASS":
+                    send("230 logged in")
+                elif cmd == "TYPE":
+                    send("200 type set")
+                elif cmd == "PASV":
+                    if data_srv is not None:
+                        data_srv.close()
+                    data_srv = socket.create_server(("127.0.0.1", 0))
+                    port = data_srv.getsockname()[1]
+                    send(
+                        f"227 passive (127,0,0,1,{port >> 8},{port & 255})"
+                    )
+                elif cmd == "REST":
+                    if not self.support_rest:
+                        send("502 REST not implemented")
+                        continue
+                    rest = int(arg)
+                    self.rest_offsets.append(rest)
+                    send("350 restarting")
+                elif cmd == "RETR":
+                    name = arg.lstrip("/")
+                    self.retrs.append(name)
+                    body = self.files.get(name)
+                    if body is None or data_srv is None:
+                        send("550 not found")
+                        rest = 0
+                        continue
+                    send("150 opening data connection")
+                    conn, _ = data_srv.accept()
+                    data_srv.close()
+                    data_srv = None
+                    try:
+                        conn.sendall(body[rest:])
+                        if self.stall_after_send:
+                            # leave the data conn open and silent: the
+                            # client's recv must be unblocked by ITS
+                            # close, not by our EOF
+                            time.sleep(20)
+                        send("226 transfer complete")
+                    except OSError:
+                        send("426 transfer aborted")
+                    finally:
+                        conn.close()
+                    rest = 0
+                elif cmd == "ABOR":
+                    send("226 abort ok")
+                elif cmd == "QUIT":
+                    send("221 bye")
+                    return
+                else:
+                    send("502 not implemented")
+        except (OSError, ValueError):
+            pass
+        finally:
+            if data_srv is not None:
+                data_srv.close()
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
 class _RangeHTTPServer:
     """Static file server with HTTP Range support (python's built-in
     handler has none); ``support_ranges=False`` ignores Range and
@@ -2739,14 +2867,25 @@ class TestWebSeeds:
     def test_metainfo_url_list_and_magnet_ws_parsed(self):
         _, meta, _ = make_torrent("movie.mkv", b"A" * 1000)
         raw = decode(meta)
-        raw[b"url-list"] = [b"http://seed.example/d/", b"ftp://nope"]
+        raw[b"url-list"] = [
+            b"http://seed.example/d/",
+            b"ftp://mirror.example/d/",
+            b"gopher://nope",
+        ]
         job = parse_metainfo(encode(raw))
-        assert job.web_seeds == ("http://seed.example/d/",)
+        assert job.web_seeds == (
+            "http://seed.example/d/",
+            "ftp://mirror.example/d/",
+        )
         magnet_job = parse_magnet(
             f"magnet:?xt=urn:btih:{'a' * 40}"
-            "&ws=http%3A%2F%2Fcdn%2Fmovie.mkv&ws=junk"
+            "&ws=http%3A%2F%2Fcdn%2Fmovie.mkv"
+            "&ws=ftp%3A%2F%2Fcdn%2Fmovie.mkv&ws=junk"
         )
-        assert magnet_job.web_seeds == ("http://cdn/movie.mkv",)
+        assert magnet_job.web_seeds == (
+            "http://cdn/movie.mkv",
+            "ftp://cdn/movie.mkv",
+        )
 
     def test_zero_peer_download_via_webseed(self, tmp_path):
         payload = bytes(range(256)) * 600
@@ -2790,6 +2929,178 @@ class TestWebSeeds:
             ).run(CancelToken(), lambda p: None)
         assert (tmp_path / "pack/season 1/e1.mkv").read_bytes() == files["season 1/e1.mkv"]
         assert (tmp_path / "pack/notes.txt").read_bytes() == files["notes.txt"]
+
+    def test_ftp_fetch_range_uses_rest_offsets(self):
+        """The FTP client issues binary RETR with a REST offset and
+        reads exactly the requested window; the persistent control
+        connection survives the mid-file abort between ranges."""
+        from downloader_tpu.fetch.peer import _WebSeedClient
+
+        payload = bytes(range(256)) * 100
+        with _TestFTPServer({"d/movie.mkv": payload}) as server:
+            client = _WebSeedClient(timeout=10)
+            try:
+                url = f"ftp://127.0.0.1:{server.port}/d/movie.mkv"
+                assert client.fetch_range(url, 0, 1000) == payload[:1000]
+                assert (
+                    client.fetch_range(url, 5000, 2000)
+                    == payload[5000:7000]
+                )
+                # tail range, exact EOF
+                assert (
+                    client.fetch_range(url, len(payload) - 100, 100)
+                    == payload[-100:]
+                )
+            finally:
+                client.close()
+        # offset-0 fetches send NO REST (a "REST 0" would 502 on
+        # REST-less servers and disqualify the webseed)
+        assert server.rest_offsets == [5000, len(payload) - 100]
+        assert server.retrs == ["d/movie.mkv"] * 3
+
+    def test_ftp_restless_server_degrades_to_discard(self):
+        """A 502 to REST degrades to a plain RETR with the prefix
+        discarded — the FTP analogue of the HTTP path's
+        Range-ignoring-server handling."""
+        from downloader_tpu.fetch.peer import _WebSeedClient
+
+        payload = bytes(range(256)) * 40
+        with _TestFTPServer(
+            {"f.bin": payload}, support_rest=False
+        ) as server:
+            client = _WebSeedClient(timeout=10)
+            try:
+                url = f"ftp://127.0.0.1:{server.port}/f.bin"
+                assert (
+                    client.fetch_range(url, 3000, 1200)
+                    == payload[3000:4200]
+                )
+                # the session survives for the next piece
+                assert client.fetch_range(url, 0, 64) == payload[:64]
+            finally:
+                client.close()
+        # REST never succeeded, and the 502'd attempt dies before its
+        # RETR is sent — so exactly one RETR per completed fetch
+        assert server.rest_offsets == []
+        assert server.retrs == ["f.bin"] * 2
+
+    def test_ftp_missing_file_is_permanent(self):
+        from downloader_tpu.fetch.peer import (
+            _WebSeedClient,
+            _WebSeedPermanent,
+        )
+
+        with _TestFTPServer({}) as server:
+            client = _WebSeedClient(timeout=10)
+            try:
+                with pytest.raises(_WebSeedPermanent):
+                    client.fetch_range(
+                        f"ftp://127.0.0.1:{server.port}/gone.bin", 0, 10
+                    )
+            finally:
+                client.close()
+
+    def test_ftp_malformed_urls_are_permanent(self):
+        """Torrent-supplied URLs: out-of-range port, hostless netloc,
+        and percent-encoded CR/LF (FTP command smuggling) must classify
+        as permanent webseed errors, not raw tracebacks."""
+        from downloader_tpu.fetch.peer import (
+            _WebSeedClient,
+            _WebSeedPermanent,
+        )
+
+        client = _WebSeedClient(timeout=5)
+        try:
+            for url in (
+                "ftp://host:99999/f",
+                "ftp://user@/f",
+                "ftp://127.0.0.1:21/%0D%0ADELE%20x",
+            ):
+                with pytest.raises(_WebSeedPermanent):
+                    client.fetch_range(url, 0, 10)
+        finally:
+            client.close()
+
+    def test_ftp_truncated_file_resets_session(self):
+        """A server whose file is shorter than the requested window:
+        TransferError (transient — the worker's retry budget applies),
+        and the poisoned mid-RETR session is dropped so the NEXT fetch
+        reconnects cleanly instead of desyncing on a stale reply."""
+        from downloader_tpu.fetch import TransferError as XferError
+        from downloader_tpu.fetch.peer import _WebSeedClient
+
+        payload = b"s" * 500
+        with _TestFTPServer({"short.bin": payload}) as server:
+            client = _WebSeedClient(timeout=10)
+            try:
+                url = f"ftp://127.0.0.1:{server.port}/short.bin"
+                with pytest.raises(XferError):
+                    client.fetch_range(url, 0, 1000)  # > file size
+                assert client._ftp is None  # session dropped
+                # clean follow-up fetch on a fresh session
+                assert client.fetch_range(url, 100, 400) == payload[100:]
+            finally:
+                client.close()
+
+    def test_ftp_cancel_unblocks_inflight_read(self):
+        """The worker's token hook calls client.close(); it must
+        unblock a recv() blocked on a stalled data connection now, not
+        after the 30 s socket timeout."""
+        from downloader_tpu.fetch import TransferError as XferError
+        from downloader_tpu.fetch.peer import _WebSeedClient
+
+        # a server that opens the data connection and then stalls
+        payload = b"x" * 200
+        with _TestFTPServer(
+            {"stall.bin": payload}, stall_after_send=True
+        ) as server:
+            client = _WebSeedClient(timeout=30)
+            result: dict = {}
+
+            def fetch():
+                try:
+                    # ask for more than the server will ever send; the
+                    # data conn delivers 200 B then the server-side send
+                    # completes, recv blocks awaiting the rest
+                    client.fetch_range(
+                        f"ftp://127.0.0.1:{server.port}/stall.bin", 0, 10_000
+                    )
+                except (XferError, OSError) as exc:
+                    result["err"] = exc
+
+            th = threading.Thread(target=fetch, daemon=True)
+            th.start()
+            deadline = time.monotonic() + 5
+            while client._ftp_data is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            start = time.monotonic()
+            client.close()
+            th.join(timeout=5)
+            assert not th.is_alive(), "fetch thread still blocked"
+            assert time.monotonic() - start < 5
+            assert "err" in result
+
+    def test_zero_peer_download_via_ftp_webseed(self, tmp_path):
+        """BEP 19 names 'HTTP/FTP seeding': a torrent job with zero
+        peers completes over plain FTP, resume offsets and all."""
+        payload = bytes(range(256)) * 600
+        with _TestFTPServer({"movie.mkv": payload}) as server:
+            _, meta, _ = make_torrent("movie.mkv", payload)
+            raw = decode(meta)
+            raw[b"url-list"] = f"ftp://127.0.0.1:{server.port}/".encode()
+            job = parse_metainfo(encode(raw))
+            assert job.web_seeds
+            SwarmDownloader(
+                job,
+                str(tmp_path),
+                progress_interval=0.01,
+                dht_bootstrap=(),
+                seed_drain_timeout=0.2,
+            ).run(CancelToken(), lambda p: None)
+        assert (tmp_path / "movie.mkv").read_bytes() == payload
+        assert any(offset > 0 for offset in server.rest_offsets), (
+            "no REST offsets used"
+        )
 
     def test_webseed_supplements_swarm(self, tmp_path):
         """Peers and webseeds drain the same claim pool: both source
