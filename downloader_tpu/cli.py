@@ -152,6 +152,21 @@ def _transport_from_env() -> str:
     return raw
 
 
+def _announce_all_from_env() -> bool:
+    """TRACKER_ANNOUNCE env: 'tiered' (default — BEP 12 tier order,
+    per-tier shuffle, promote-on-success) or 'all' (announce to every
+    tracker concurrently; bounded latency when most are dead)."""
+    raw = os.environ.get("TRACKER_ANNOUNCE", "").strip().lower()
+    if raw in ("", "tiered"):
+        return False
+    if raw == "all":
+        return True
+    log.with_fields(value=raw).warning(
+        "unknown TRACKER_ANNOUNCE (want tiered|all); using 'tiered'"
+    )
+    return False
+
+
 def _default_backends():
     from .fetch.torrent import TorrentBackend
     from .utils import flag_from_env, zero_copy_from_env
@@ -165,6 +180,7 @@ def _default_backends():
             transport=_transport_from_env(),
             # LSD env: "off" disables BEP 14 multicast discovery
             lsd=flag_from_env("LSD"),
+            announce_all=_announce_all_from_env(),
         ),
         HTTPBackend(zero_copy=zero_copy_from_env()),
     ]

@@ -44,6 +44,11 @@ class TorrentJob:
     info_hash: bytes  # 20-byte SHA-1 of the bencoded info dict
     display_name: str = ""
     trackers: tuple[str, ...] = ()
+    # BEP 12 announce-list tiers: trackers grouped by priority. Magnets
+    # have no tier syntax, so each tr= is its own tier (anacrolix does
+    # the same); .torrent files carry the real structure. Empty when
+    # there are no trackers; always covers every entry of ``trackers``.
+    tracker_tiers: tuple[tuple[str, ...], ...] = ()
     # explicit peer addresses from the magnet's x.pe params (BEP 9)
     peer_hints: tuple[tuple[str, int], ...] = ()
     # BEP 19 webseeds: HTTP(S)/FTP sources for the content itself, from the
@@ -94,10 +99,12 @@ def parse_magnet(uri: str) -> TorrentJob:
         if url.startswith(("http://", "https://", "ftp://"))
     ]
 
+    trackers = tuple(params.get("tr", []))
     return TorrentJob(
         info_hash=info_hash,
         display_name=params.get("dn", [""])[0],
-        trackers=tuple(params.get("tr", [])),
+        trackers=trackers,
+        tracker_tiers=tuple((t,) for t in trackers),
         peer_hints=tuple(peer_hints),
         web_seeds=tuple(web_seeds),
     )
@@ -137,16 +144,32 @@ def parse_metainfo(data: bytes) -> TorrentJob:
     info_hash = hashlib.sha1(raw_info).digest()
 
     trackers: list[str] = []
+    tiers: list[tuple[str, ...]] = []
     announce = meta.get(b"announce")
     if isinstance(announce, bytes):
         trackers.append(announce.decode("utf-8", "replace"))
     for tier in meta.get(b"announce-list", []) or []:
         if isinstance(tier, list):
+            tier_urls: list[str] = []
             for tracker in tier:
                 if isinstance(tracker, bytes):
                     url = tracker.decode("utf-8", "replace")
+                    if url not in tier_urls:
+                        tier_urls.append(url)
                     if url not in trackers:
                         trackers.append(url)
+            if tier_urls:
+                tiers.append(tuple(tier_urls))
+    if not tiers and trackers:
+        # no (usable) announce-list: the bare announce is tier 0
+        # (BEP 12: clients ignore announce when announce-list exists)
+        tiers = [tuple(trackers)]
+    elif tiers and trackers and trackers[0] not in {
+        url for tier_urls in tiers for url in tier_urls
+    }:
+        # bare announce not repeated in announce-list: keep it as a
+        # last-resort tier so it is never silently dropped
+        tiers.append((trackers[0],))
 
     web_seeds: list[str] = []
     url_list = meta.get(b"url-list")
@@ -168,6 +191,7 @@ def parse_metainfo(data: bytes) -> TorrentJob:
         info_hash=info_hash,
         display_name=name.decode("utf-8", "replace") if isinstance(name, bytes) else "",
         trackers=tuple(trackers),
+        tracker_tiers=tuple(tiers),
         web_seeds=tuple(web_seeds),
         info=info,
     )

@@ -2195,6 +2195,7 @@ class SwarmDownloader:
         encryption: str = "allow",
         transport: str = "both",
         lsd: bool = False,
+        announce_all: bool = False,
     ):
         self._job = job
         self._base_dir = base_dir
@@ -2222,6 +2223,22 @@ class SwarmDownloader:
         self._lsd = lsd
         self._seed_drain_timeout = seed_drain_timeout
         self._discovery_rounds = max(1, discovery_rounds)
+        # BEP 12 announce state. Default: tier-ordered announce with a
+        # per-tier shuffle (load-spreading, per the BEP) and
+        # promote-on-success; ``announce_all=True`` opts into
+        # announcing to every tracker concurrently instead (bounded
+        # discovery latency when most trackers are dead, at the cost
+        # of tracker-etiquette compliance).
+        self._announce_all = announce_all
+        tiers = job.tracker_tiers or tuple((t,) for t in job.trackers)
+        self._tiers: list[list[str]] = []
+        for tier in tiers:
+            shuffled = list(tier)
+            random.shuffle(shuffled)
+            self._tiers.append(shuffled)
+        # trackers that have accepted an announce this job — the only
+        # ones lifecycle events (completed/stopped) should bother
+        self._announced: dict[str, None] = {}
         # populated by run(): the live announced port and upload stats
         self.listen_port: int | None = None
         self.blocks_served = 0
@@ -2279,15 +2296,30 @@ class SwarmDownloader:
                 )
             raise TransferError("unsupported tracker scheme")
 
-        if self._job.trackers:
+        def record_success(tracker: str, found: list) -> None:
+            nonlocal tracker_responded, tracker_answered
+            tracker_responded = True
+            # a tracker now lists us: the teardown "stopped" announce
+            # has someone to inform
+            self._tracker_contacted = True
+            self._announced[tracker] = None
+            # any non-empty announce counts, even if it only repeats
+            # the x.pe hints — a tracker-confirmed peer is no reason
+            # to fall through to a DHT lookup
+            tracker_answered = tracker_answered or bool(found)
+            for peer in found:
+                if peer not in peers:
+                    peers.append(peer)
+
+        if self._job.trackers and self._announce_all:
             if token is not None:
                 token.raise_if_cancelled()
-            # announce to every tracker concurrently — a deliberate
-            # divergence from BEP 12's try-tiers-in-order semantics:
-            # real magnets carry many tr= entries, mostly dead, and
-            # each dead one costs its full timeout — serially that is
-            # minutes before DHT fires. The cost is slightly more
-            # tracker traffic; the win is bounded discovery latency.
+            # opt-in divergence from BEP 12's try-tiers-in-order
+            # semantics: real magnets carry many tr= entries, mostly
+            # dead, and each dead one costs its full timeout —
+            # serially that is minutes before DHT fires. The cost is
+            # more tracker traffic; the win is bounded discovery
+            # latency.
             with concurrent.futures.ThreadPoolExecutor(
                 max_workers=min(8, len(self._job.trackers)),
                 thread_name_prefix="announce",
@@ -2302,19 +2334,33 @@ class SwarmDownloader:
                     except TransferError as exc:
                         errors.append(f"{futures[future]}: {exc}")
                         continue
-                    tracker_responded = True
-                    # a tracker now lists us: the teardown "stopped"
-                    # announce has someone to inform
-                    self._tracker_contacted = True
-                    # any non-empty announce counts, even if it only
-                    # repeats the x.pe hints — a tracker-confirmed peer
-                    # is no reason to fall through to a DHT lookup
-                    tracker_answered = tracker_answered or bool(found)
-                    for peer in found:
-                        if peer not in peers:
-                            peers.append(peer)
+                    record_success(futures[future], found)
             if token is not None:
                 token.raise_if_cancelled()
+        elif self._job.trackers:
+            # BEP 12: walk tiers in order; within a tier (shuffled once
+            # per job) try trackers in order and stop at the first that
+            # responds, promoting it to the tier's front so later
+            # announces go straight to the tracker that works. Lower
+            # tiers are touched only when every higher tier failed.
+            for tier in self._tiers:
+                succeeded: str | None = None
+                for tracker in list(tier):
+                    if token is not None:
+                        token.raise_if_cancelled()
+                    try:
+                        found = one_announce(tracker)
+                    except TransferError as exc:
+                        errors.append(f"{tracker}: {exc}")
+                        continue
+                    record_success(tracker, found)
+                    succeeded = tracker
+                    break
+                if succeeded is not None:
+                    if tier[0] != succeeded:
+                        tier.remove(succeeded)
+                        tier.insert(0, succeeded)
+                    break
 
         dht_responded = False
         if (
@@ -2807,9 +2853,20 @@ class SwarmDownloader:
         downloaded: int,
         left: int = 0,
     ) -> None:
-        """Best-effort lifecycle announce ("completed"/"stopped") to
-        every tracker; short timeouts, errors swallowed — stats only."""
-        for tracker in self._job.trackers:
+        """Best-effort lifecycle announce ("completed"/"stopped");
+        short timeouts, errors swallowed — stats only. Tiered mode
+        informs only the trackers that actually accepted an announce
+        this job (BEP 12 etiquette: the others never listed us) —
+        unless NONE did, where a completed job's announce can still
+        register us (the run() teardown gate's promise), so fall back
+        to every tracker. Announce-all mode always tells everyone,
+        matching its registration."""
+        targets = (
+            tuple(self._announced)
+            if not self._announce_all and self._announced
+            else self._job.trackers
+        )
+        for tracker in targets:
             try:
                 if tracker.startswith(("http://", "https://")):
                     announce(

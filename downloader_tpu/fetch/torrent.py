@@ -44,6 +44,7 @@ class TorrentBackend:
         encryption: str = "allow",
         transport: str = "both",
         lsd: bool = False,
+        announce_all: bool = False,
     ):
         self._progress_interval = progress_interval
         self._metadata_timeout = metadata_timeout
@@ -60,6 +61,9 @@ class TorrentBackend:
         # and tests would cross-talk on the shared well-known group;
         # the daemon/CLI enables it via the LSD env flag (default on)
         self._lsd = lsd
+        # BEP 12: tier-ordered announce by default; True announces to
+        # every tracker concurrently (CLI: TRACKER_ANNOUNCE=all)
+        self._announce_all = announce_all
 
     def register(self) -> BackendRegistration:
         return BackendRegistration(
@@ -118,6 +122,7 @@ class TorrentBackend:
             encryption=self._encryption,
             transport=self._transport,
             lsd=self._lsd,
+            announce_all=self._announce_all,
         )
         downloader.run(token, lambda percent: progress(url, percent))
         progress(url, 100.0)

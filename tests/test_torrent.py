@@ -479,8 +479,10 @@ class TestUDPTracker:
             )
 
     def test_dead_trackers_announce_concurrently(self, seeder, tmp_path):
-        """Several dead trackers must cost max(timeout), not the sum:
-        discovery announces to all trackers concurrently."""
+        """The announce-all opt-in (TRACKER_ANNOUNCE=all): several dead
+        trackers must cost max(timeout), not the sum — discovery
+        announces to all trackers concurrently. (The default is BEP 12
+        tiered order; this flag trades etiquette for bounded latency.)"""
         import time as time_mod
 
         dead = [socket.socket(socket.AF_INET, socket.SOCK_DGRAM) for _ in range(3)]
@@ -498,7 +500,9 @@ class TestUDPTracker:
                 )
                 start = time_mod.monotonic()
                 TorrentBackend(
-                    progress_interval=0.01, dht_bootstrap=()
+                    progress_interval=0.01,
+                    dht_bootstrap=(),
+                    announce_all=True,
                 ).download(
                     CancelToken(), str(tmp_path), lambda u, p: None, magnet
                 )
@@ -526,6 +530,117 @@ class TestUDPTracker:
                 )
         finally:
             sock.close()
+
+
+class TestBEP12Tiers:
+    """BEP 12 announce-list: tier-ordered announce with per-tier
+    shuffle and promote-on-success (the default; the reference's
+    anacrolix honors tiers the same way). Concurrent-all stays as the
+    TRACKER_ANNOUNCE=all opt-in, covered in TestUDPTracker."""
+
+    INFO_HASH = hashlib.sha1(b"bep12").digest()
+
+    def _downloader(self, tiers, **kwargs):
+        from downloader_tpu.fetch.magnet import TorrentJob
+        from downloader_tpu.fetch.peer import SwarmDownloader
+
+        job = TorrentJob(
+            info_hash=self.INFO_HASH,
+            trackers=tuple(t for tier in tiers for t in tier),
+            tracker_tiers=tuple(tuple(tier) for tier in tiers),
+        )
+        return SwarmDownloader(job, "/tmp", dht_bootstrap=(), **kwargs)
+
+    def test_metainfo_tiers_parsed(self):
+        _, meta, _ = make_torrent("movie.mkv", b"A" * 1000)
+        raw = decode(meta)
+        raw[b"announce"] = b"http://solo/announce"
+        raw[b"announce-list"] = [
+            [b"http://t1a/announce", b"http://t1b/announce"],
+            [b"http://t2/announce"],
+        ]
+        job = parse_metainfo(encode(raw))
+        assert job.tracker_tiers == (
+            ("http://t1a/announce", "http://t1b/announce"),
+            ("http://t2/announce",),
+            # bare announce not in announce-list: kept as a final tier
+            ("http://solo/announce",),
+        )
+        # no announce-list: the bare announce is the only tier
+        del raw[b"announce-list"]
+        job = parse_metainfo(encode(raw))
+        assert job.tracker_tiers == (("http://solo/announce",),)
+
+    def test_magnet_trackers_are_singleton_tiers(self):
+        job = parse_magnet(
+            f"magnet:?xt=urn:btih:{'a' * 40}"
+            "&tr=http%3A%2F%2Fone%2Fa&tr=http%3A%2F%2Ftwo%2Fa"
+        )
+        assert job.tracker_tiers == (
+            ("http://one/a",),
+            ("http://two/a",),
+        )
+
+    def test_tier_failover_and_stop_at_first_success(self, seeder):
+        """Tier 1 dead -> tier 2's live tracker is used; tier 3 (also
+        live) is never contacted once a higher tier succeeded."""
+        with FakeUDPTracker([seeder.peer_address]) as untouched:
+            downloader = self._downloader(
+                [
+                    ["http://127.0.0.1:1/announce"],  # refused instantly
+                    [seeder.tracker_url],
+                    [untouched.url],
+                ]
+            )
+            peers = downloader._discover_peers(left=100, allow_empty=True)
+            assert seeder.peer_address in peers
+            assert seeder.announces, "live tier-2 tracker not announced to"
+            assert untouched.announces == [], (
+                "lower tier contacted despite higher-tier success"
+            )
+
+    def test_promote_on_success(self, seeder):
+        """Within a tier, the tracker that answered moves to the front
+        so the next announce goes straight to it."""
+        dead = "http://127.0.0.1:1/announce"
+        downloader = self._downloader([[dead, seeder.tracker_url]])
+        # defeat the per-tier shuffle: force the dead one first
+        downloader._tiers = [[dead, seeder.tracker_url]]
+        downloader._discover_peers(left=100, allow_empty=True)
+        assert downloader._tiers[0][0] == seeder.tracker_url
+        first_count = len(seeder.announces)
+        assert first_count >= 1
+        # second round: straight to the promoted tracker (the dead one
+        # is never retried while the promoted one answers)
+        downloader._discover_peers(left=100, allow_empty=True, event="")
+        assert len(seeder.announces) == first_count + 1
+
+    def test_per_tier_shuffle_preserves_tier_membership(self):
+        tiers = [["http://a/x", "http://b/x", "http://c/x"], ["http://d/x"]]
+        downloader = self._downloader(tiers)
+        assert sorted(downloader._tiers[0]) == sorted(tiers[0])
+        assert downloader._tiers[1] == tiers[1]
+
+    def test_lifecycle_announces_only_successful_trackers(self, seeder):
+        """The teardown completed/stopped announces go only to trackers
+        that actually accepted an announce this job (the dead tier-1
+        tracker never listed us)."""
+        downloader = self._downloader(
+            [["http://127.0.0.1:1/announce"], [seeder.tracker_url]]
+        )
+        downloader._discover_peers(left=100, allow_empty=True)
+        assert tuple(downloader._announced) == (seeder.tracker_url,)
+
+    def test_lifecycle_falls_back_to_all_when_never_registered(self, seeder):
+        """A job that completed without any successful discovery
+        announce (DHT/LSD/webseed-only) still sends its completion to
+        the trackers — that announce is what registers us."""
+        downloader = self._downloader([[seeder.tracker_url]])
+        assert not downloader._announced  # discovery never ran
+        before = len(seeder.announces)
+        downloader._announce_event("completed", 6881, 0, 0, 0)
+        assert len(seeder.announces) == before + 1
+        assert seeder.announces[-1].get("event") == "completed"
 
 
 class TestSwarmClaim:
