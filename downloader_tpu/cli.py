@@ -167,7 +167,11 @@ def _announce_all_from_env() -> bool:
     return False
 
 
-def _default_backends():
+def _default_backends(shared_dht: bool = False):
+    """``shared_dht=True`` (the daemon) keeps ONE process-lifetime DHT
+    node across jobs, with optional routing-table persistence via
+    DHT_STATE_PATH; the one-shot CLI keeps per-job construction like
+    the reference's per-job client (torrent.go:43-44)."""
     from .fetch.torrent import TorrentBackend
     from .utils import flag_from_env, zero_copy_from_env
 
@@ -181,6 +185,10 @@ def _default_backends():
             # LSD env: "off" disables BEP 14 multicast discovery
             lsd=flag_from_env("LSD"),
             announce_all=_announce_all_from_env(),
+            shared_dht=shared_dht,
+            dht_state_path=(
+                os.environ.get("DHT_STATE_PATH") or None
+            ) if shared_dht else None,
         ),
         HTTPBackend(zero_copy=zero_copy_from_env()),
     ]

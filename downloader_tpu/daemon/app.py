@@ -280,7 +280,8 @@ def serve(
 
     from ..cli import _default_backends
 
-    dispatcher = DispatchClient(token, config.base_dir, _default_backends())
+    backends = _default_backends(shared_dht=True)
+    dispatcher = DispatchClient(token, config.base_dir, backends)
     uploader = Uploader.from_env(config.bucket)
 
     daemon = Daemon(token, client, dispatcher, uploader, config)
@@ -297,4 +298,8 @@ def serve(
     finally:
         if health is not None:
             health.stop()
+        for backend in backends:
+            backend_close = getattr(backend, "close", None)
+            if backend_close is not None:
+                backend_close()
     return 0

@@ -19,6 +19,8 @@ from __future__ import annotations
 import hashlib
 import hmac
 import ipaddress
+import json
+import os
 import secrets
 import selectors
 import socket
@@ -118,6 +120,11 @@ class DHTClient:
         # Distinguishes "lookup completed, swarm just empty" (worth
         # retrying) from "nobody answered" (every source dead)
         self.responded = False
+        # addresses of nodes that answered the LAST lookup well-formed:
+        # fodder for a shared process-lifetime DHTNode's routing table
+        # (the daemon feeds these back so later jobs bootstrap from a
+        # warm table instead of the BEP 5 routers)
+        self.seen_nodes: list[tuple[str, int]] = []
 
     # -- KRPC ------------------------------------------------------------
 
@@ -253,6 +260,7 @@ class DHTClient:
         if len(info_hash) != 20:
             raise DHTError("info-hash must be 20 bytes")
         self.responded = False
+        self.seen_nodes = []
 
         def distance(node_id: bytes) -> int:
             return int.from_bytes(node_id, "big") ^ int.from_bytes(
@@ -286,6 +294,12 @@ class DHTClient:
                 )
                 if replies:
                     self.responded = True
+                    for reply_addr in replies:
+                        if (
+                            reply_addr not in self.seen_nodes
+                            and len(self.seen_nodes) < 64
+                        ):
+                            self.seen_nodes.append(reply_addr)
                 progressed = False
                 for reply_addr, reply in replies.items():
                     reply_token = reply.get(b"token")
@@ -388,8 +402,13 @@ class DHTNode:
         max_nodes: int = 256,
         max_peers_per_hash: int = 64,
         max_hashes: int = 64,
+        state_path: str | None = None,
     ):
         self.node_id = node_id or secrets.token_bytes(20)
+        # optional routing-table persistence: saved node addresses are
+        # re-pinged on startup (respondents re-enter the table), so a
+        # restarted daemon warms up without touching the BEP 5 routers
+        self._state_path = state_path
         self._max_nodes = max_nodes
         self._max_peers_per_hash = max_peers_per_hash
         # tokens bind the announcer's IP, not the info-hash, so one
@@ -416,14 +435,84 @@ class DHTNode:
         threading.Thread(
             target=self._serve, daemon=True, name=f"dht-node-{self.port}"
         ).start()
-        if bootstrap:
+        candidates = list(bootstrap) + self._load_state()
+        if candidates:
             # off the constructor: hostname routers mean synchronous
             # DNS, and __init__ runs on the job's startup path
             threading.Thread(
-                target=lambda: [self._send_ping(a) for a in bootstrap],
+                target=lambda: [self._send_ping(a) for a in candidates],
                 daemon=True,
                 name=f"dht-bootstrap-{self.port}",
             ).start()
+
+    # -- shared-node surface ---------------------------------------------
+
+    def routing_nodes(self, limit: int = 64) -> tuple[tuple[str, int], ...]:
+        """Snapshot of the routing table's addresses, XOR-closest to our
+        id first: bootstrap fodder for job lookups sharing this
+        process-lifetime node — a warm table means zero queries to the
+        BEP 5 routers (anacrolix keeps its node alive the same way;
+        the per-job alternative re-bootstraps every job)."""
+        with self._lock:
+            ordered = sorted(self._table, key=self._distance)
+            return tuple(self._table[nid] for nid in ordered[:limit])
+
+    def add_candidates(self, addrs, limit: int = 16) -> None:
+        """Ping addresses a job's lookup heard from; respondents enter
+        the table via the normal reply path. This is how the shared
+        node's table grows from job traffic (its serving half only
+        learns nodes that contact it)."""
+        with self._lock:
+            known = set(self._table.values())
+        # filter BEFORE limiting: in steady state the first responders
+        # are exactly the already-known table nodes, and spending the
+        # limit on them would starve the genuinely new nodes heard in
+        # later lookup rounds — freezing the table's growth
+        fresh = [addr for addr in addrs if addr not in known]
+        for addr in fresh[:limit]:
+            self._send_ping(addr)
+
+    def _load_state(self) -> list[tuple[str, int]]:
+        if not self._state_path:
+            return []
+        try:
+            with open(self._state_path, "rb") as handle:
+                raw = json.load(handle)
+        except (OSError, ValueError):
+            return []
+        addrs: list[tuple[str, int]] = []
+        if isinstance(raw, list):
+            for entry in raw[: self._max_nodes]:
+                if (
+                    isinstance(entry, list)
+                    and len(entry) == 2
+                    and isinstance(entry[0], str)
+                    and isinstance(entry[1], int)
+                    and 0 < entry[1] < 65536
+                ):
+                    addrs.append((entry[0], entry[1]))
+        return addrs
+
+    def save_state(self) -> None:
+        """Write the table's addresses for the next process; atomic
+        replace so a crash mid-write can't truncate the state."""
+        if not self._state_path:
+            return
+        with self._lock:
+            addrs = list(self._table.values())
+        if not addrs:
+            # a run that never warmed up (routers unreachable) must not
+            # clobber the last GOOD snapshot with an empty list
+            return
+        tmp = f"{self._state_path}.tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as handle:
+                json.dump([[host, port] for host, port in addrs], handle)
+            os.replace(tmp, self._state_path)
+        except OSError as exc:
+            log.with_fields(path=self._state_path).debug(
+                f"dht state save failed: {exc}"
+            )
 
     # -- token + table ---------------------------------------------------
 
@@ -669,6 +758,7 @@ class DHTNode:
             self._rotated = now
 
     def close(self) -> None:
+        self.save_state()
         self._closed = True
         try:
             self.sock.close()

@@ -2196,8 +2196,16 @@ class SwarmDownloader:
         transport: str = "both",
         lsd: bool = False,
         announce_all: bool = False,
+        dht_node: "object | None" = None,
     ):
         self._job = job
+        # externally-owned process-lifetime DHTNode (daemon): shared
+        # across jobs so lookups bootstrap from its warm routing table
+        # instead of the BEP 5 routers, and never closed here. None =
+        # per-job construction (one-shot CLI / library default),
+        # mirroring the reference's per-job client (torrent.go:43-44)
+        # — anacrolix itself keeps its DHT server process-wide.
+        self._shared_dht_node = dht_node
         self._base_dir = base_dir
         self._metadata_timeout = metadata_timeout
         self._progress_interval = progress_interval
@@ -2383,11 +2391,19 @@ class SwarmDownloader:
                 # our node via its bootstrap pings and return it in
                 # their `nodes` answers, so announces reach it with a
                 # real source address.
-                client = (
-                    DHTClient(bootstrap=self._dht_bootstrap)
-                    if self._dht_bootstrap is not None
-                    else DHTClient()
-                )
+                warm: tuple = ()
+                if self._shared_dht_node is not None:
+                    # process-lifetime node: bootstrap the lookup from
+                    # its warm routing table — zero router queries for
+                    # every job after the first (a dead-table lookup
+                    # just fails this round; the node self-heals)
+                    warm = self._shared_dht_node.routing_nodes()
+                if warm:
+                    client = DHTClient(bootstrap=warm)
+                elif self._dht_bootstrap is not None:
+                    client = DHTClient(bootstrap=self._dht_bootstrap)
+                else:
+                    client = DHTClient()
                 # announce our live listener port into the DHT so other
                 # leechers can find us (anacrolix's node does the same);
                 # None when no listener actually BOUND — a config flag
@@ -2410,6 +2426,12 @@ class SwarmDownloader:
                 # into a dead network returns [] WITHOUT error and must
                 # not count as "the swarm is just empty, retry"
                 dht_responded = client.responded
+                if self._shared_dht_node is not None and client.seen_nodes:
+                    # feed responders back into the shared node's table
+                    # (ping-verified there) so the NEXT job's lookup
+                    # starts warm — the serving half alone only learns
+                    # nodes that happen to contact it
+                    self._shared_dht_node.add_candidates(client.seen_nodes)
             except DHTError as exc:
                 errors.append(str(exc))
 
@@ -2457,8 +2479,13 @@ class SwarmDownloader:
         # this host answers ping/find_node/get_peers/announce_peer so
         # other leechers can route through and register with us — the
         # full-citizen role anacrolix's node plays (torrent.go:44)
+        # per-job serving node, owned and closed by this run. With a
+        # shared process-lifetime node (self._shared_dht_node, daemon)
+        # none is built: the shared node serves for every job and the
+        # lookup/feedback paths read _shared_dht_node directly (private
+        # jobs are gated there via BEP 27's _private flag).
         self._dht_node = None
-        if (
+        if self._shared_dht_node is None and (
             listener is not None
             and self._dht_bootstrap != ()
             # a metainfo job already known private (BEP 27) has no use

@@ -20,6 +20,7 @@ promptly at every stage.
 
 from __future__ import annotations
 
+import threading
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -45,6 +46,8 @@ class TorrentBackend:
         transport: str = "both",
         lsd: bool = False,
         announce_all: bool = False,
+        shared_dht: bool = False,
+        dht_state_path: str | None = None,
     ):
         self._progress_interval = progress_interval
         self._metadata_timeout = metadata_timeout
@@ -64,6 +67,48 @@ class TorrentBackend:
         # BEP 12: tier-ordered announce by default; True announces to
         # every tracker concurrently (CLI: TRACKER_ANNOUNCE=all)
         self._announce_all = announce_all
+        # shared_dht=True: ONE process-lifetime DHT node for every job
+        # this backend runs (the daemon's posture — anacrolix keeps its
+        # DHT server alive for the process; the reference's per-job
+        # client is torrent.go:43-44). Created lazily on first use;
+        # close() persists its routing table when dht_state_path is
+        # set. False = each job builds and tears down its own node
+        # (one-shot CLI / hermetic tests).
+        self._shared_dht = shared_dht
+        self._dht_state_path = dht_state_path
+        self._dht_node = None
+        self._dht_lock = threading.Lock()
+
+    def _shared_node(self):
+        """The lazily-created process-lifetime DHT node, or None when
+        sharing is off or DHT is disabled. Creation failures are
+        logged and retried on the next job (a transient bind failure
+        must not permanently disable DHT for the process)."""
+        if not self._shared_dht or self._dht_bootstrap == ():
+            return None
+        with self._dht_lock:
+            if self._dht_node is None:
+                from .dht import DEFAULT_BOOTSTRAP, DHTNode
+
+                try:
+                    self._dht_node = DHTNode(
+                        bootstrap=self._dht_bootstrap or DEFAULT_BOOTSTRAP,
+                        state_path=self._dht_state_path,
+                    )
+                except OSError as exc:
+                    log.with_fields(error=str(exc)).info(
+                        "shared dht node unavailable"
+                    )
+                    return None
+            return self._dht_node
+
+    def close(self) -> None:
+        """Release process-lifetime resources (the shared DHT node,
+        which persists its routing table when configured)."""
+        with self._dht_lock:
+            node, self._dht_node = self._dht_node, None
+        if node is not None:
+            node.close()
 
     def register(self) -> BackendRegistration:
         return BackendRegistration(
@@ -123,6 +168,7 @@ class TorrentBackend:
             transport=self._transport,
             lsd=self._lsd,
             announce_all=self._announce_all,
+            dht_node=self._shared_node(),
         )
         downloader.run(token, lambda percent: progress(url, percent))
         progress(url, 100.0)

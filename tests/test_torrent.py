@@ -532,6 +532,125 @@ class TestUDPTracker:
             sock.close()
 
 
+class TestSharedDHTNode:
+    """Process-lifetime DHT node (daemon posture): one node + routing
+    table across jobs, so repeated jobs bootstrap from the warm table
+    instead of the BEP 5 routers — the lifetime anacrolix gives its
+    DHT server vs the reference's per-job client (torrent.go:43-44)."""
+
+    def _wait(self, predicate, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.02)
+        return predicate()
+
+    def test_routing_nodes_and_state_persistence(self, tmp_path):
+        from downloader_tpu.fetch.dht import DHTNode
+
+        hub = DHTNode()
+        state = str(tmp_path / "dht_state.json")
+        node = DHTNode(
+            bootstrap=(("127.0.0.1", hub.port),), state_path=state
+        )
+        try:
+            assert self._wait(lambda: node.routing_nodes()), (
+                "bootstrap ping never learned the hub"
+            )
+            assert ("127.0.0.1", hub.port) in node.routing_nodes()
+        finally:
+            node.close()  # persists the table
+        assert os.path.exists(state)
+        # a fresh process warms up from the saved table, NO bootstrap
+        reborn = DHTNode(state_path=state)
+        try:
+            assert self._wait(lambda: reborn.routing_nodes()), (
+                "saved state did not re-warm the table"
+            )
+            assert ("127.0.0.1", hub.port) in reborn.routing_nodes()
+        finally:
+            reborn.close()
+            hub.close()
+
+    def test_second_job_lookup_survives_router_death(self):
+        """Job 1's lookup (bootstrapped from the shared node's table)
+        feeds its responders back; after the router dies, job 2's
+        lookup still completes purely from the warm table — zero
+        live-bootstrap dependence."""
+        from downloader_tpu.fetch.dht import DHTClient, DHTNode
+        from downloader_tpu.fetch.magnet import TorrentJob
+        from downloader_tpu.fetch.peer import SwarmDownloader
+
+        info_hash = hashlib.sha1(b"shared-dht").digest()
+        router = DHTNode()
+        # the node that actually holds the peer registration; it knows
+        # the router (its bootstrap ping registers it there too)
+        keeper = DHTNode(bootstrap=(("127.0.0.1", router.port),))
+        shared = DHTNode(bootstrap=(("127.0.0.1", router.port),))
+        try:
+            assert self._wait(lambda: shared.routing_nodes())
+            assert self._wait(lambda: keeper.routing_nodes())
+            assert self._wait(
+                lambda: router.routing_nodes()
+            ), "router never learned its queriers"
+            # register a swarm peer on the keeper ONLY (max_rounds=1:
+            # the announce targets just the first round's token bearer,
+            # so the lookup below must traverse router -> keeper)
+            DHTClient(
+                bootstrap=(("127.0.0.1", keeper.port),)
+            ).get_peers(info_hash, announce_port=7777, max_rounds=1)
+
+            def job(n):
+                return SwarmDownloader(
+                    TorrentJob(info_hash=info_hash),
+                    "/tmp",
+                    dht_node=shared,
+                )
+
+            peers = job(1)._discover_peers(left=1, allow_empty=True)
+            assert ("127.0.0.1", 7777) in peers
+            # the lookup's responders were fed back into the shared
+            # table (ping-verified): the keeper is now known directly
+            assert self._wait(
+                lambda: ("127.0.0.1", keeper.port) in shared.routing_nodes()
+            ), "lookup responders never reached the shared table"
+
+            router.close()  # the only bootstrap source dies
+            peers = job(2)._discover_peers(left=1, allow_empty=True)
+            assert ("127.0.0.1", 7777) in peers
+        finally:
+            shared.close()
+            keeper.close()
+            router.close()
+
+    def test_backend_shares_one_node_across_jobs(self, tmp_path):
+        from downloader_tpu.fetch.dht import DHTNode
+
+        hub = DHTNode()
+        state = str(tmp_path / "state.json")
+        backend = TorrentBackend(
+            dht_bootstrap=(("127.0.0.1", hub.port),),
+            shared_dht=True,
+            dht_state_path=state,
+        )
+        try:
+            first = backend._shared_node()
+            assert first is not None
+            assert backend._shared_node() is first  # one node, reused
+            # let the bootstrap ping land: an empty table is (by
+            # design) never persisted over a previous good snapshot
+            assert self._wait(lambda: first.routing_nodes())
+        finally:
+            backend.close()
+            hub.close()
+        assert os.path.exists(state)  # close persisted the table
+        # per-job posture (the default): no shared node at all
+        assert TorrentBackend(
+            dht_bootstrap=(("127.0.0.1", 1),)
+        )._shared_node() is None
+
+
 class TestBEP12Tiers:
     """BEP 12 announce-list: tier-ordered announce with per-tier
     shuffle and promote-on-success (the default; the reference's
