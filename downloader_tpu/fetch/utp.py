@@ -11,17 +11,20 @@ stdlib UDP socket:
 - three-way-ish setup (ST_SYN → ST_STATE), ordered reliable delivery
   with out-of-order reassembly, ST_FIN teardown, ST_RESET on unknown
   connections,
-- retransmission with exponential backoff and AIMD windowing (halve on
-  loss, grow per clean round-trip).
-
-Deliberate divergence from the full BEP 29 congestion controller: the
-LEDBAT delay-based gating (target 100 ms one-way delay, scaled gain) is
-replaced by plain AIMD. LEDBAT's goal is *yielding to foreground
-traffic on consumer uplinks*; this service runs in datacenters where
-loss-signalled AIMD is the norm, and AIMD is strictly more aggressive,
-never slower. The timestamp/timestamp_diff fields are still filled per
-spec so LEDBAT-speaking remotes can run their controller against us.
-The selective-ack extension is parsed (skipped) but not emitted.
+- retransmission with exponential backoff,
+- the full BEP 29 congestion controller: LEDBAT delay-based windowing
+  (target 100 ms one-way queuing delay, scaled gain, base-delay
+  tracked as a rolling 2-minute minimum of the remote's echoed
+  timestamp_diff) with multiplicative decrease on loss. Plain AIMD
+  remains as a config fallback (``UTP_CONGESTION=aimd`` or
+  ``UTPMultiplexer(congestion="aimd")``) for datacenter paths where
+  yielding to foreground traffic is not wanted,
+- selective acks (extension 1), both directions: the receiver attaches
+  a SACK bitmask to acks while its reassembly buffer holds a gap, and
+  the sender treats sacked packets as delivered (LEDBAT receivers
+  never renege), fast-retransmitting the head once 3+ later packets
+  are sacked — recovering multi-loss windows without RTO stalls
+  (``UTP_SACK=off`` disables emission).
 
 A ``UTPSocket`` duck-types the blocking ``socket.socket`` surface the
 peer wire uses (``sendall``/``recv``/``settimeout``/``close``/
@@ -61,6 +64,12 @@ CWND_MIN = 2
 CWND_MAX = 256
 RTO_INIT = 0.5
 RTO_MAX = 8.0
+# LEDBAT (BEP 29 / RFC 6817): target one-way queuing delay and gain —
+# at most GAIN packets of window change per window's worth of acks
+LEDBAT_TARGET_US = 100_000
+LEDBAT_GAIN = 1.0
+BASE_DELAY_WINDOW = 60.0  # base-delay bucket width (2 buckets kept)
+SACK_MAX_BYTES = 32  # bitmask cap: 256 packets = CWND_MAX
 CONNECT_TIMEOUT = 10.0
 ACK_EVERY = 4  # delayed-ack stride; the mux tick flushes stragglers
 
@@ -81,20 +90,30 @@ def _pack(
     seq: int,
     ack: int,
     payload: bytes = b"",
+    sack: bytes = b"",
 ) -> bytes:
-    return (
-        HEADER.pack(
-            (ptype << 4) | VERSION,
-            0,
-            conn_id,
-            _now_us(),
-            ts_diff & 0xFFFFFFFF,
-            wnd,
-            seq,
-            ack,
-        )
-        + payload
+    header = HEADER.pack(
+        (ptype << 4) | VERSION,
+        1 if sack else 0,  # first-extension type: 1 = selective ack
+        conn_id,
+        _now_us(),
+        ts_diff & 0xFFFFFFFF,
+        wnd,
+        seq,
+        ack,
     )
+    if sack:
+        # extension block: [type-of-next-ext, length, bitmask]
+        header += bytes((0, len(sack))) + sack
+    return header + payload
+
+
+def _restamp(pkt: bytes) -> bytes:
+    """Fresh header timestamp for a retransmission: resending the
+    original bytes would make the receiver echo the ORIGINAL send
+    time's delta as timestamp_diff, which LEDBAT would read as hundreds
+    of ms of queuing and collapse the window (libutp re-stamps too)."""
+    return pkt[:4] + struct.pack(">I", _now_us()) + pkt[8:]
 
 
 def _seq_lt(a: int, b: int) -> bool:
@@ -102,16 +121,48 @@ def _seq_lt(a: int, b: int) -> bool:
     return 0 < (b - a) & 0xFFFF < 0x8000
 
 
+def _delay_lt(a: int, b: int) -> bool:
+    """a < b in mod-2^32 delay space: timestamp_diff samples embed an
+    arbitrary inter-host clock offset mod 2^32, so plain comparisons
+    misread samples that straddle the wrap boundary."""
+    return 0 < (b - a) & 0xFFFFFFFF < 1 << 31
+
+
 class UTPSocket:
     """One uTP stream. Created via ``connect()`` (initiator) or handed
     to the listener's accept callback (receiver). Thread-safe like a
     socket: one reader and one writer may run concurrently."""
 
-    def __init__(self, mux: "UTPMultiplexer", addr, send_id: int, recv_id: int):
+    def __init__(
+        self,
+        mux: "UTPMultiplexer",
+        addr,
+        send_id: int,
+        recv_id: int,
+        congestion: str = "ledbat",
+        emit_sack: bool = True,
+    ):
         self._mux = mux
         self.addr = addr
         self._send_id = send_id
         self._recv_id = recv_id
+        self._congestion = congestion
+        self._emit_sack = emit_sack
+        # LEDBAT: rolling base-delay minimum of the remote's echoed
+        # timestamp_diff (two BASE_DELAY_WINDOW buckets = ~2 min
+        # history; the clock-skew constant cancels in sample - base)
+        self._delay_min_cur: int | None = None
+        self._delay_min_prev: int | None = None
+        self._delay_bucket_at = time.monotonic()
+        # fast-recovery: window was last cut at this time — one
+        # multiplicative decrease per RTT-ish episode, not per resend
+        self._last_cut = 0.0
+        # consecutive RTO expiries without cumulative progress: drives
+        # the RTO's exponential backoff AND the give-up limit. Distinct
+        # from the per-packet resend count — sack/dup-ack-paced resends
+        # are frequent by design and must inflate neither.
+        self._rto_backoff = 0
+        self.rto_retransmits = 0  # timeout-driven resends (observability)
         self._lock = threading.Lock()
         self._readable = threading.Condition(self._lock)
         self._writable = threading.Condition(self._lock)
@@ -179,21 +230,54 @@ class UTPSocket:
                 max(0, RECV_WINDOW - len(self._stream)),
                 self._seq,
                 self._ack,
+                sack=self._build_sack_locked(),
             )
         )
 
+    def _build_sack_locked(self) -> bytes:
+        """Selective-ack bitmask (BEP 29 extension 1) over the
+        reassembly buffer: bit i of byte i>>3 represents seq
+        ack_nr + 2 + i. Empty when there is no gap."""
+        if not self._ooo or not self._emit_sack:
+            return b""
+        base_seq = (self._ack + 2) & 0xFFFF
+        bits = bytearray(4)  # spec: at least 4 bytes, multiples of 4
+        for s in self._ooo:
+            i = (s - base_seq) & 0xFFFF
+            if i >= SACK_MAX_BYTES * 8:
+                continue  # beyond the mask cap: cumulative ack covers it later
+            needed = ((i >> 5) + 1) * 4  # grow in 4-byte steps
+            if needed > len(bits):
+                bits.extend(bytes(needed - len(bits)))
+            bits[i >> 3] |= 1 << (i & 7)
+        return bytes(bits)
+
     # -- mux-thread entry points ----------------------------------------
 
-    def _on_packet(self, ptype: int, seq: int, ack: int, ts: int, wnd: int, payload: bytes) -> None:
+    def _on_packet(
+        self,
+        ptype: int,
+        seq: int,
+        ack: int,
+        ts: int,
+        ts_diff: int,
+        wnd: int,
+        payload: bytes,
+        sack: bytes = b"",
+    ) -> None:
         with self._lock:
-            self._on_packet_locked(ptype, seq, ack, ts, wnd, payload)
+            self._on_packet_locked(
+                ptype, seq, ack, ts, ts_diff, wnd, payload, sack
+            )
             teardown = self._closed and (
                 not self._inflight or self._error is not None
             )
         if teardown:
             self._maybe_teardown()
 
-    def _on_packet_locked(self, ptype, seq, ack, ts, wnd, payload) -> None:
+    def _on_packet_locked(
+        self, ptype, seq, ack, ts, ts_diff, wnd, payload, sack=b""
+    ) -> None:
         self._last_ts_diff = (_now_us() - ts) & 0xFFFFFFFF
         self._peer_wnd = wnd
         if ptype == ST_RESET:
@@ -204,23 +288,37 @@ class UTPSocket:
             return
         # ack processing (every packet type carries ack_nr)
         acked = [s for s in self._inflight if not _seq_lt(ack, s)]
-        if acked:
-            self._dup_acks = 0
+        # selective acks: packets the remote holds past the cumulative
+        # ack are DELIVERED (a BEP 29 reassembly buffer never reneges,
+        # unlike TCP SACK), so they leave the in-flight window now
+        # instead of being resent after the head's recovery
+        sacked: list[int] = []
+        if sack and self._inflight:
+            base_seq = (ack + 2) & 0xFFFF
+            for i in range(len(sack) * 8):
+                if sack[i >> 3] & (1 << (i & 7)):
+                    s = (base_seq + i) & 0xFFFF
+                    if s in self._inflight:
+                        sacked.append(s)
+        if acked or sacked:
             for s in acked:
                 pkt, sent_at, tries = self._inflight.pop(s)
                 if tries == 1 and s == ack:
                     # Karn's rule: only first-transmission samples
                     sample = time.monotonic() - sent_at
                     self._rtt = 0.8 * self._rtt + 0.2 * sample
-            # clean ack: additive increase, one packet per window
-            self._cwnd = min(
-                CWND_MAX,
-                self._cwnd + max(1, len(acked)) / max(1, self._cwnd),
-            )
+            for s in sacked:
+                self._inflight.pop(s, None)  # no rtt sample: not cumulative
+            self._grow_cwnd_locked(len(acked) + len(sacked), ts_diff)
             self._writable.notify_all()
-        elif self._inflight and ptype == ST_STATE:
-            # a pure ack that acks nothing while data is in flight: the
-            # remote is missing our head-of-line packet (it acks
+        if acked:
+            self._dup_acks = 0
+            self._rto_backoff = 0  # cumulative progress: path is alive
+        elif self._inflight and ptype == ST_STATE and not sack:
+            # a pure SACK-LESS ack that acks nothing while data is in
+            # flight (with a sack block attached, the sack rule below
+            # is strictly better loss information than blind counting):
+            # the remote is missing our head-of-line packet (it acks
             # immediately on every gap arrival — delayed acks mean the
             # value itself may differ from the last one we saw, so no
             # equality test). Only payload-free ST_STATE counts — TCP's
@@ -235,9 +333,32 @@ class UTPSocket:
             # one packet.
             self._dup_acks += 1
             if self._dup_acks >= 2:
-                self._dup_acks = 0
+                # NOT reset on firing: while progress stays absent,
+                # every further duplicate re-signals the same loss (a
+                # resend may itself have died); the resend pacing in
+                # _retransmit_head_locked dedupes the actual sends
                 self._retransmit_head_locked(time.monotonic())
         self._last_ack_seen = ack
+        # SACK loss signal (libutp's rule): 3+ packets sacked beyond
+        # the head prove the head was lost, not merely delayed — resend
+        # it without waiting out dup-acks or the RTO. Repeat firings
+        # for the same gap (every gap-advertising ack repeats the
+        # sack) are deduplicated by the resend pacing, which also
+        # covers the resend-itself-lost case at tick cadence.
+        if sack and self._inflight:
+            head = min(
+                self._inflight,
+                key=lambda s: (s - self._last_ack_seen) & 0xFFFF,
+            )
+            base_seq = (ack + 2) & 0xFFFF
+            later = 0
+            for i in range(len(sack) * 8):
+                if sack[i >> 3] & (1 << (i & 7)) and _seq_lt(
+                    head, (base_seq + i) & 0xFFFF
+                ):
+                    later += 1
+            if later >= 3:
+                self._retransmit_head_locked(time.monotonic())
         if ptype == ST_STATE:
             if not self._connected.is_set():
                 # the SYN-ACK's seq is the remote's initial seq; its
@@ -258,6 +379,7 @@ class UTPSocket:
     def _on_data_locked(self, seq: int, payload: bytes) -> None:
         is_next = seq == (self._ack + 1) & 0xFFFF
         gap = payload and not is_next
+        had_gap = bool(self._ooo)
         if payload and _seq_lt(self._ack, seq) and seq not in self._ooo:
             # cap the reassembly buffer on actual buffered BYTES (a
             # per-entry cap times MSS undercounts sub-MSS datagrams and
@@ -280,14 +402,69 @@ class UTPSocket:
             self._ack = self._fin_seq  # consume the FIN's slot
             self._eof = True
         # delayed ack: per-packet acks dominate CPU at loopback rates;
-        # ack on a gap (the sender's loss signal), every ACK_EVERY
-        # in-order packets, at EOF, and from the mux tick otherwise
-        if gap or self._unacked >= ACK_EVERY or self._eof:
+        # ack on a gap (the sender's loss signal), on an in-order
+        # arrival while a gap was outstanding (it was the
+        # retransmission the sender is pacing resends against —
+        # deferring THAT ack makes the sender refire spuriously until
+        # the delayed ack finally goes out), every ACK_EVERY in-order
+        # packets, at EOF, and from the mux tick otherwise
+        recovered = bool(payload) and is_next and had_gap
+        if gap or recovered or self._unacked >= ACK_EVERY or self._eof:
             self._send_ack_locked()
             self._unacked = 0
         if self._stream or self._eof:
             self._readable.notify_all()
             self._arm_pipe_locked()
+
+    def _grow_cwnd_locked(self, n_acked: int, echoed_delay: int) -> None:
+        """Window growth on ack progress. LEDBAT: the remote's echoed
+        timestamp_diff is our packets' one-way delay; its excess over
+        the rolling base delay is queuing WE caused. The window scales
+        toward the 100 ms target — grows below it, shrinks above it —
+        by at most LEDBAT_GAIN packets per window of acks (RFC 6817's
+        scaled gain). AIMD mode (and packets without a usable delay
+        echo, e.g. the handshake) grow additively, one packet per
+        window."""
+        if self._congestion == "ledbat" and echoed_delay:
+            now = time.monotonic()
+            if now - self._delay_bucket_at >= BASE_DELAY_WINDOW:
+                self._delay_min_prev = self._delay_min_cur
+                self._delay_min_cur = None
+                self._delay_bucket_at = now
+            # min/subtract in wrapping space: the samples carry the
+            # clock offset mod 2^32, so around the wrap boundary the
+            # smaller NUMBER is not the smaller DELAY — a plain min
+            # would latch a phantom base and read ~2^32 µs of queuing
+            # forever (libutp compares wrapping too)
+            if self._delay_min_cur is None or _delay_lt(
+                echoed_delay, self._delay_min_cur
+            ):
+                self._delay_min_cur = echoed_delay
+            base = self._delay_min_cur
+            if self._delay_min_prev is not None and _delay_lt(
+                self._delay_min_prev, base
+            ):
+                base = self._delay_min_prev
+            queuing = (echoed_delay - base) & 0xFFFFFFFF
+            if queuing >= 1 << 31:
+                queuing = 0  # sample below base: rebase already latched
+            off_target = (LEDBAT_TARGET_US - queuing) / LEDBAT_TARGET_US
+            off_target = max(-1.0, min(1.0, off_target))
+            self._cwnd = max(
+                CWND_MIN,
+                min(
+                    CWND_MAX,
+                    self._cwnd
+                    + LEDBAT_GAIN
+                    * off_target
+                    * max(1, n_acked)
+                    / max(1, self._cwnd),
+                ),
+            )
+        else:
+            self._cwnd = min(
+                CWND_MAX, self._cwnd + max(1, n_acked) / max(1, self._cwnd)
+            )
 
     def _on_tick(self) -> None:
         """Mux timer: flush a straggling delayed ack; retransmit
@@ -296,6 +473,15 @@ class UTPSocket:
             if self._unacked:
                 self._send_ack_locked()
                 self._unacked = 0
+            elif self._ooo and self._error is None:
+                # a gap is outstanding but nothing new is arriving —
+                # the retransmission we're waiting for may itself have
+                # been lost, and with no inbound data we'd otherwise
+                # send no acks at all, leaving the remote only its
+                # (exponentially backed-off) RTO. Re-advertise the gap
+                # (with SACK) every tick so the remote's dup-ack/sack
+                # machinery re-fires at tick cadence instead.
+                self._send_ack_locked()
             now = time.monotonic()
             if self._error is None and self._inflight:
                 # retransmit ONLY the head-of-line packet: everything
@@ -309,8 +495,13 @@ class UTPSocket:
                     key=lambda s: (s - self._last_ack_seen) & 0xFFFF,
                 )
                 pkt, sent_at, tries = self._inflight[head]
-                if now - sent_at >= rto * (2 ** (tries - 1)):
-                    if tries >= 6:
+                # backoff exponent = consecutive RTO expiries without
+                # progress, NOT the packet's total resend count: paced
+                # fast retransmits are frequent by design, and letting
+                # them inflate the exponent would push the give-up
+                # horizon from ~30 s out to minutes on a dead path
+                if now - sent_at >= rto * (2**self._rto_backoff):
+                    if self._rto_backoff >= 5:
                         self._error = UTPError(
                             "uTP retransmission limit reached"
                         )
@@ -318,22 +509,39 @@ class UTPSocket:
                         self._writable.notify_all()
                         self._arm_pipe_locked()
                     else:
-                        self._retransmit_head_locked(now)
+                        self._rto_backoff += 1
+                        self.rto_retransmits += 1
+                        self._retransmit_head_locked(now, force=True)
             teardown = self._closed and (
                 not self._inflight or self._error is not None
             )
         if teardown:
             self._maybe_teardown()
 
-    def _retransmit_head_locked(self, now: float) -> None:
+    def _retransmit_head_locked(self, now: float, force: bool = False) -> None:
         if not self._inflight:
             return
         head = min(
             self._inflight, key=lambda s: (s - self._last_ack_seen) & 0xFFFF
         )
         pkt, sent_at, tries = self._inflight[head]
-        # loss signal: multiplicative decrease
-        self._cwnd = max(CWND_MIN, self._cwnd / 2)
+        # pace resends: dup-acks and sack signals keep arriving for the
+        # SAME gap while a just-sent resend is still in flight — give
+        # each resend ~half an RTT to land before firing again, clamped
+        # to [10 ms, 50 ms]: the rtt estimate includes delayed-ack
+        # latency and inflates under loss, and an unclamped window
+        # would slow every recovery to that inflated pace (the RTO
+        # path forces, it IS the give-up timer)
+        if not force and now - sent_at < min(max(0.5 * self._rtt, 0.01), 0.05):
+            return
+        # loss signal: multiplicative decrease — once per RTT-ish
+        # episode (sack-triggered, dup-ack and RTO paths all land
+        # here; cutting per resend would collapse to CWND_MIN on any
+        # lossy stretch)
+        if now - self._last_cut > max(self._rtt, 0.05):
+            self._last_cut = now
+            self._cwnd = max(CWND_MIN, self._cwnd / 2)
+        pkt = _restamp(pkt)
         self._send_raw(pkt)
         self._inflight[head] = (pkt, now, tries + 1)
 
@@ -495,8 +703,28 @@ class UTPMultiplexer:
         port: int = 0,
         on_accept=None,
         sock: socket.socket | None = None,
+        congestion: str | None = None,
+        emit_sack: bool | None = None,
     ):
         self.on_accept = on_accept
+        # congestion controller for every stream on this mux: "ledbat"
+        # (BEP 29 default) or "aimd" (config fallback); env overrides
+        # for the CLI/daemon without plumbing a flag through the stack
+        if congestion is None:
+            congestion = os.environ.get("UTP_CONGESTION", "ledbat").lower()
+            if congestion not in ("ledbat", "aimd"):
+                congestion = "ledbat"  # env typo: safe default
+        else:
+            congestion = congestion.lower()
+            if congestion not in ("ledbat", "aimd"):
+                # an explicit argument is code, not config: fail loud
+                raise ValueError(f"unknown congestion mode {congestion!r}")
+        self.congestion = congestion
+        if emit_sack is None:
+            emit_sack = os.environ.get("UTP_SACK", "on").lower() not in (
+                "off", "0", "false",
+            )
+        self.emit_sack = emit_sack
         if sock is not None:
             self.sock = sock
         else:
@@ -506,7 +734,11 @@ class UTPMultiplexer:
             except OSError:
                 self.sock.close()
                 raise
-        self.sock.settimeout(0.1)  # tick granularity for retransmits
+        # tick granularity: retransmit checks AND the gap
+        # re-advertisement cadence — a window-stalled sender recovers
+        # one loss per gap re-advert, so the tick bounds per-loss
+        # recovery latency for sack-less remotes
+        self.sock.settimeout(0.05)
         self.port = self.sock.getsockname()[1]
         self._lock = threading.Lock()
         self._conns: dict[tuple, UTPSocket] = {}  # (addr, recv_id) -> conn
@@ -536,7 +768,12 @@ class UTPMultiplexer:
             # spec: the SYN carries our RECEIVE id; we send data with
             # recv_id + 1 and the remote replies labeled recv_id
             conn = UTPSocket(
-                self, addr, send_id=(recv_id + 1) & 0xFFFF, recv_id=recv_id
+                self,
+                addr,
+                send_id=(recv_id + 1) & 0xFFFF,
+                recv_id=recv_id,
+                congestion=self.congestion,
+                emit_sack=self.emit_sack,
             )
             self._conns[(addr, recv_id)] = conn
         conn._connect(timeout)
@@ -573,14 +810,21 @@ class UTPMultiplexer:
             if version != VERSION or ptype > ST_SYN:
                 continue
             payload = data[HEADER_LEN:]
+            sack = b""
             if ext:
-                # skip extension chain (we never negotiate any, but a
-                # remote may still attach selective acks)
+                # walk the extension chain; type 1 = selective ack
+                # (other types are skipped — we never negotiate any)
                 offset = HEADER_LEN
-                next_ext = ext
+                current = ext
                 try:
-                    while next_ext:
+                    while current:
                         next_ext, ext_len = data[offset], data[offset + 1]
+                        block = data[offset + 2 : offset + 2 + ext_len]
+                        if len(block) < ext_len:
+                            raise IndexError
+                        if current == 1:
+                            sack = block
+                        current = next_ext
                         offset += 2 + ext_len
                     payload = data[offset:]
                 except IndexError:
@@ -591,7 +835,9 @@ class UTPMultiplexer:
             with self._lock:
                 conn = self._conns.get((addr, conn_id))
             if conn is not None:
-                conn._on_packet(ptype, seq, ack, ts, wnd, payload)
+                conn._on_packet(
+                    ptype, seq, ack, ts, ts_diff, wnd, payload, sack
+                )
             elif ptype != ST_RESET:
                 # unknown stream: tell the remote to stop retrying
                 try:
@@ -624,7 +870,12 @@ class UTPMultiplexer:
             # per spec: receiver sends on the SYN's conn_id, receives
             # on conn_id + 1
             conn = UTPSocket(
-                self, addr, send_id=conn_id, recv_id=(conn_id + 1) & 0xFFFF
+                self,
+                addr,
+                send_id=conn_id,
+                recv_id=(conn_id + 1) & 0xFFFF,
+                congestion=self.congestion,
+                emit_sack=self.emit_sack,
             )
             self._conns[key] = conn
         conn._accept(seq)
