@@ -33,12 +33,23 @@ dep:
 
 # Single-file executable (zipapp), the static-binary analogue
 # (reference Makefile:24-28 builds bin/downloader with -ldflags '-w -s').
-build:
+build: native
 	rm -rf $(BINDIR)/.staging
 	mkdir -p $(BINDIR)/.staging
 	cp -r downloader_tpu $(BINDIR)/.staging/
 	find $(BINDIR)/.staging -name '__pycache__' -type d -exec rm -rf {} +
-	find $(BINDIR)/.staging -name '*.so' -delete  # ctypes can't load from a zipapp; rc4_native falls back cleanly
+	# _rc4.so ships INSIDE the archive: ctypes can't load from a zip,
+	# so rc4_native extracts it to a per-user cache dir on first use
+	# (content-hash keyed); compiler-less hosts then still get native
+	# MSE speed from the shipped single file. Never ship a stale
+	# binary that doesn't even load HERE (e.g. carried over from a
+	# different-arch build tree) — the runtime falls back to
+	# compiling the shipped source, but a known-bad .so is dead weight
+	@if [ -f $(BINDIR)/.staging/downloader_tpu/fetch/_rc4.so ] && \
+	  ! $(PYTHON) -c "import ctypes; ctypes.CDLL('$(BINDIR)/.staging/downloader_tpu/fetch/_rc4.so')" 2>/dev/null; then \
+	  rm -f $(BINDIR)/.staging/downloader_tpu/fetch/_rc4.so; \
+	  echo "dropped unloadable _rc4.so from the archive"; \
+	fi
 	printf 'from downloader_tpu.cli import main\nimport sys\nsys.exit(main())\n' \
 	  > $(BINDIR)/.staging/__main__.py
 	$(PYTHON) -m zipapp $(BINDIR)/.staging -o $(BINDIR)/$(APP).pyz \

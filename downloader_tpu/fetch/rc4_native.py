@@ -9,11 +9,23 @@ no pybind11, no build-time dependency. When no compiler is available
 output (cross-checked in tests against RFC 6229 vectors), just slower —
 fine for handshakes and tests, throttling only bulk encrypted
 transfers on compiler-less hosts.
+
+Zipapp deployments (bin/downloader.pyz, the static-binary analogue):
+ctypes cannot load a .so from inside a zip, so when the package files
+are not real paths the loader pulls ``_rc4.so`` (shipped prebuilt in
+the archive) — or failing that the C source — out via
+importlib.resources into a per-user cache directory keyed by content
+hash, and loads/compiles from there. An extracted .so that fails to
+load (foreign arch) falls through to compiling the shipped source.
+First run pays one extraction; every later run hits the cache. The
+shipped single-file artifact gets the same native MSE speed as a
+wheel install.
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import shutil
 import subprocess
@@ -27,45 +39,153 @@ _lock = threading.Lock()
 _lib: "ctypes.CDLL | None | bool" = None  # None = not tried, False = unavailable
 
 
-def _compile() -> str | None:
-    compiler = shutil.which("cc") or shutil.which("gcc") or shutil.which("clang")
-    if compiler is None or not os.path.exists(_C_PATH):
+def _find_compiler() -> str | None:
+    return shutil.which("cc") or shutil.which("gcc") or shutil.which("clang")
+
+
+def _compile_source(src_path: str, final: str) -> str | None:
+    """Compile C source to ``final`` via a temp file + atomic rename
+    (a concurrent process never loads a half-written .so). Returns the
+    loadable path — which is the temp file itself when the rename
+    fails (cross-device, perms) — or None."""
+    compiler = _find_compiler()
+    if compiler is None:
         return None
-    # build into a temp name then atomically rename, so a concurrent
-    # process never loads a half-written .so; fall back to a tempdir
-    # .so when the package directory is read-only
-    for target_dir in (os.path.dirname(_SO_PATH), tempfile.gettempdir()):
-        tmp = None
-        try:
-            # mkstemp inside the try: a read-only package dir raises
-            # PermissionError here, and that must advance the loop to
-            # the tempdir, not escape to the caller
-            fd, tmp = tempfile.mkstemp(suffix=".so", dir=target_dir)
-            os.close(fd)
-            subprocess.run(
-                [compiler, "-O2", "-shared", "-fPIC", "-o", tmp, _C_PATH],
-                check=True,
-                capture_output=True,
-                timeout=60,
-            )
-        except (subprocess.SubprocessError, OSError):
-            if tmp is not None:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-            continue
-        final = (
-            _SO_PATH
-            if target_dir == os.path.dirname(_SO_PATH)
-            else os.path.join(target_dir, f"downloader_tpu_rc4-{os.getpid()}.so")
+    tmp = None
+    try:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(final))
+        os.close(fd)
+        subprocess.run(
+            [compiler, "-O2", "-shared", "-fPIC", "-o", tmp, src_path],
+            check=True,
+            capture_output=True,
+            timeout=60,
         )
-        try:
-            os.replace(tmp, final)
-        except OSError:
-            return tmp  # cross-device or perms: load the temp directly
-        return final
+    except (subprocess.SubprocessError, OSError):
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return None
+    try:
+        os.replace(tmp, final)
+    except OSError:
+        return tmp
+    return final
+
+
+def _compile() -> str | None:
+    """Normal (on-disk) install: build _rc4.c next to itself, falling
+    back to the per-user cache when the package dir is read-only."""
+    if not os.path.exists(_C_PATH):
+        return None
+    for final in (_SO_PATH, os.path.join(_cache_dir(), "_rc4-local.so")):
+        path = _compile_source(_C_PATH, final)
+        if path is not None:
+            return path
     return None
+
+
+def _cache_dir() -> str:
+    """Per-user cache for artifacts extracted/compiled out of a zipapp
+    (XDG-style). The fallback when $HOME is unusable is a PER-USER,
+    0700 directory under the tempdir — never the shared tempdir
+    itself, where another local user could pre-plant a .so at the
+    predictable content-hash name and have us CDLL it."""
+    root = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    candidates = [os.path.join(root, "downloader_tpu")]
+    uid = os.getuid() if hasattr(os, "getuid") else "win"
+    candidates.append(
+        os.path.join(tempfile.gettempdir(), f"downloader_tpu-{uid}")
+    )
+    for path in candidates:
+        try:
+            os.makedirs(path, mode=0o700, exist_ok=True)
+            stat = os.stat(path)
+            if hasattr(os, "getuid") and (
+                stat.st_uid != os.getuid() or stat.st_mode & 0o022
+            ):
+                continue  # squatted or group/other-writable: unsafe
+            probe = os.path.join(path, ".probe")
+            with open(probe, "w"):
+                pass
+            os.unlink(probe)
+            return path
+        except OSError:
+            continue
+    # last resort: a fresh private directory (0700 by construction);
+    # per-process, so the cache is cold every run — safe over fast
+    return tempfile.mkdtemp(prefix="downloader_tpu-")
+
+
+def _resource_bytes(name: str) -> bytes | None:
+    """Read a packaged file through importlib.resources — works from a
+    zipapp where plain paths do not exist."""
+    try:
+        import importlib.resources as resources
+
+        return (
+            resources.files("downloader_tpu.fetch").joinpath(name).read_bytes()
+        )
+    except Exception:
+        return None
+
+
+def _loadable(path: str) -> bool:
+    try:
+        ctypes.CDLL(path)
+        return True
+    except OSError:
+        return False
+
+
+def _materialize_from_archive() -> str | None:
+    """Running from a zipapp: place a loadable .so in the cache dir —
+    extract the shipped prebuilt if the archive has one AND it loads
+    on this host (a foreign-arch .so must not dead-end us), else
+    compile the shipped C source. Content-hash names make upgrades
+    rebuild and concurrent processes converge on the same file."""
+    cache = _cache_dir()
+    so_bytes = _resource_bytes("_rc4.so")
+    if so_bytes:
+        digest = hashlib.sha1(so_bytes).hexdigest()[:12]
+        final = os.path.join(cache, f"_rc4-{digest}.so")
+        if os.path.exists(final) and _loadable(final):
+            return final
+        try:
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=cache)
+            with os.fdopen(fd, "wb") as handle:
+                handle.write(so_bytes)
+            if _loadable(tmp):
+                os.replace(tmp, final)  # atomic: racers never half-load
+                return final
+            os.unlink(tmp)  # foreign arch: fall through to the source
+        except OSError:
+            pass  # extraction failed: fall through to the source
+    c_bytes = _resource_bytes("_rc4.c")
+    if not c_bytes:
+        return None
+    digest = hashlib.sha1(c_bytes).hexdigest()[:12]
+    final = os.path.join(cache, f"_rc4-{digest}.so")
+    if os.path.exists(final) and _loadable(final):
+        return final
+    tmp_c = None
+    try:
+        fd, tmp_c = tempfile.mkstemp(suffix=".c", dir=cache)
+        with os.fdopen(fd, "wb") as handle:
+            handle.write(c_bytes)
+        return _compile_source(tmp_c, final)
+    except OSError:
+        return None
+    finally:
+        if tmp_c is not None:
+            try:
+                os.unlink(tmp_c)
+            except OSError:
+                pass
 
 
 def _load() -> "ctypes.CDLL | None":
@@ -75,7 +195,13 @@ def _load() -> "ctypes.CDLL | None":
     with _lock:
         if _lib is not None:
             return _lib or None
-        path = _SO_PATH if os.path.exists(_SO_PATH) else _compile()
+        if os.path.exists(_SO_PATH):
+            path = _SO_PATH
+        elif not os.path.isfile(_C_PATH):
+            # package files are not real paths: we are inside a zipapp
+            path = _materialize_from_archive()
+        else:
+            path = _compile()
         lib = None
         if path is not None:
             try:

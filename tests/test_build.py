@@ -94,3 +94,50 @@ def test_zipapp_build(tmp_path):
     )
     assert result.returncode == 0
     assert "download-once" in result.stdout
+
+
+def test_zipapp_ships_and_extracts_native_rc4(tmp_path):
+    """The shipped single-file artifact must not quietly pay
+    pure-Python RC4 on every MSE byte: the .so ships inside the
+    archive and rc4_native extracts it to a cache dir on first load
+    (ctypes cannot load from a zip)."""
+    subprocess.run(
+        ["make", "build", f"BINDIR={tmp_path}"],
+        cwd=REPO,
+        check=True,
+        capture_output=True,
+    )
+    pyz = tmp_path / "downloader.pyz"
+    with zipfile.ZipFile(pyz) as zf:
+        names = zf.namelist()
+    if "downloader_tpu/fetch/_rc4.so" not in names:
+        import pytest
+
+        pytest.skip("no C compiler on this host: archive has no .so")
+    cache = tmp_path / "cache"
+    code = (
+        "import sys\n"
+        f"sys.path.insert(0, {str(pyz)!r})\n"
+        "from downloader_tpu.fetch.rc4_native import RC4\n"
+        "rc4 = RC4(b'Key')\n"
+        "assert rc4.crypt(b'Plaintext').hex() == 'bbf316e8d940af0ad3'\n"
+        "assert rc4._native is not None, 'zip fell back to pure python'\n"
+    )
+    result = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**__import__("os").environ, "XDG_CACHE_HOME": str(cache)},
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    extracted = list((cache / "downloader_tpu").glob("_rc4-*.so"))
+    assert extracted, "native core was not extracted to the cache dir"
+    # second load hits the cache (same content hash, no new file)
+    result = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**__import__("os").environ, "XDG_CACHE_HOME": str(cache)},
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0
+    assert list((cache / "downloader_tpu").glob("_rc4-*.so")) == extracted
