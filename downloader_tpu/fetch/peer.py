@@ -23,2159 +23,91 @@ nothing — a mainline DHT get_peers lookup (BEP 5, fetch/dht.py), so
 trackerless magnets work like the reference's anacrolix client.
 """
 
+
+# Round 5: the historical 3.2k-line module is split by role with NO
+# behavior change — tracker.py (announce), peerwire.py (outbound wire +
+# PeerConnection), pieces.py (PieceStore), webseed.py (BEP 19),
+# inbound.py (listener + choker), swarmstate.py (claim pool + piece
+# batch). This module keeps the SwarmDownloader orchestration and
+# re-exports the split names, so ``downloader_tpu.fetch.peer`` remains
+# the stable import surface.
+
 from __future__ import annotations
 
 import collections
 import concurrent.futures
 import hashlib
 import ipaddress
-import os
-import queue
 import random
-import secrets
-import socket
 import struct
 import threading
 import time
-import urllib.parse
-import urllib.request
 
-from ..parallel import DigestEngine, default_engine
 from ..utils import get_logger, metrics
 from ..utils.cancel import Cancelled, CancelToken
-from ..utils.netio import SocketWaiter
-from . import bencode, mse, utp
+from . import bencode, utp
 from .http import TransferError
 from .magnet import TorrentJob
+from .inbound import PeerListener, _InboundPeer
+from .peerwire import (
+    ALLOWED_FAST_K,
+    BLOCK_SIZE,
+    ENCRYPTION_MODES,
+    HANDSHAKE_PSTR,
+    IDLE_REAP_TIMEOUT,
+    MAX_REQUEST_LENGTH,
+    MSG_ALLOWED_FAST,
+    MSG_BITFIELD,
+    MSG_CANCEL,
+    MSG_CHOKE,
+    MSG_EXTENDED,
+    MSG_HAVE,
+    MSG_HAVE_ALL,
+    MSG_HAVE_NONE,
+    MSG_INTERESTED,
+    MSG_NOT_INTERESTED,
+    MSG_PIECE,
+    MSG_REJECT,
+    MSG_REQUEST,
+    MSG_UNCHOKE,
+    TRANSPORT_MODES,
+    UTP_CONNECT_TIMEOUT,
+    UT_METADATA,
+    UT_PEX,
+    PeerConnection,
+    PeerIdentityError,
+    PeerProtocolError,
+    _frame,
+    _is_private,
+    _recv_into,
+    allowed_fast_set,
+    fetch_metadata,
+    generate_peer_id,
+    pack_bitfield,
+)
+from .pieces import PieceStore
+from .swarmstate import _PieceBatch, _SwarmState
+from .tracker import (
+    announce,
+    announce_udp,
+    decode_compact_peers,
+    decode_compact_peers6,
+)
+from .webseed import (
+    _WebSeedClient,
+    _WebSeedPermanent,
+    _WebSeedSource,
+    _fetch_webseed_piece,
+    _webseed_file_url,
+)
 
 log = get_logger("fetch.peer")
-
-BLOCK_SIZE = 16 * 1024
-HANDSHAKE_PSTR = b"BitTorrent protocol"
-
-MSG_CHOKE = 0
-MSG_UNCHOKE = 1
-MSG_INTERESTED = 2
-MSG_NOT_INTERESTED = 3
-MSG_HAVE = 4
-MSG_BITFIELD = 5
-MSG_REQUEST = 6
-MSG_PIECE = 7
-MSG_CANCEL = 8
-# BEP 6 fast extension (reserved[7] & 0x04); anacrolix speaks it too
-MSG_HAVE_ALL = 14
-MSG_HAVE_NONE = 15
-MSG_REJECT = 16
-MSG_ALLOWED_FAST = 17
-MSG_EXTENDED = 20
-
-# BEP 6 allowed-fast set size; also the cap on how many ALLOWED_FAST
-# grants we accept from a remote (a hostile flood must not grow state)
-ALLOWED_FAST_K = 10
-
-
-def allowed_fast_set(
-    ip: str, info_hash: bytes, num_pieces: int, k: int = ALLOWED_FAST_K
-) -> set[int]:
-    """BEP 6 canonical allowed-fast generation: pieces a choked peer at
-    ``ip`` may download anyway, derived from SHA-1 over the /24-masked
-    address + info-hash so both ends can compute the same set."""
-    if num_pieces <= 0:
-        return set()
-    try:
-        packed = socket.inet_aton(ip)
-    except OSError:
-        return set()  # v6/hostname: the spec defines the v4 derivation
-    x = bytes(a & b for a, b in zip(packed, b"\xff\xff\xff\x00")) + info_hash
-    allowed: set[int] = set()
-    k = min(k, num_pieces)
-    while len(allowed) < k:
-        x = hashlib.sha1(x).digest()
-        for offset in range(0, 20, 4):
-            if len(allowed) >= k:
-                break
-            index = int.from_bytes(x[offset : offset + 4], "big") % num_pieces
-            allowed.add(index)
-    return allowed
-
-# largest block an inbound REQUEST may ask for; the de-facto norm is
-# 16 KiB but mainstream clients tolerate up to 128 KiB before dropping
-# the requester as hostile
-MAX_REQUEST_LENGTH = 128 * 1024
-
-UT_METADATA = 1  # our local extended-message id for ut_metadata
-UT_PEX = 2  # our local extended-message id for ut_pex (BEP 11)
-
-
-def _is_private(info) -> bool:
-    """BEP 27: the info dict's private flag (trackers-only swarm)."""
-    return isinstance(info, dict) and info.get(b"private") == 1
-
-# MSE policy → outbound connection attempts, in order. The reference's
-# anacrolix client accepts and initiates obfuscated connections by
-# default (Config.HeaderObfuscationPolicy); inbound, every policy but
-# "off" auto-detects plaintext vs MSE from the first bytes.
-ENCRYPTION_MODES: dict[str, tuple[str, ...]] = {
-    "off": ("plain",),  # plaintext only, encrypted inbound rejected
-    "allow": ("plain", "mse"),  # default: plaintext first, MSE fallback
-    "prefer": ("mse", "plain"),  # MSE first, plaintext fallback
-    "require": ("mse",),  # MSE only, plaintext inbound rejected
-}
-
-# transport policy → outbound attempt order. The reference's anacrolix
-# client dials TCP and uTP (BEP 29) both; here TCP is tried first (fast
-# refusal on datacenter networks) with uTP as the fallback that reaches
-# NAT'd peers inbound-TCP can't. The listener accepts both always.
-TRANSPORT_MODES: dict[str, tuple[str, ...]] = {
-    "tcp": ("tcp",),
-    "utp": ("utp",),
-    "both": ("tcp", "utp"),
-}
-UTP_CONNECT_TIMEOUT = 5.0  # a dead UDP port gives no refusal signal
-# dead-silent-peer reap horizon for idle poll loops: 2x BEP 3's upper
-# keepalive cadence ("generally sent once every two minutes") plus
-# grace, so one jittered keepalive never gets a healthy choked peer
-# reaped — the same dead-vs-quiet margin the AMQP heartbeat uses
-IDLE_REAP_TIMEOUT = 250.0
-
-
-def generate_peer_id() -> bytes:
-    # Azureus-style prefix; "dT" = downloader_tpu
-    return b"-DT0100-" + secrets.token_bytes(12)
-
-
-def _frame(msg_id: int, payload: bytes = b"") -> bytes:
-    """One length-prefixed peer-wire frame (shared by both halves)."""
-    return struct.pack(">IB", 1 + len(payload), msg_id) + payload
-
-
-def _recv_into(sock: socket.socket, count: int) -> bytes | None:
-    """Read exactly ``count`` bytes; None on EOF (callers raise their
-    side's idiomatic exception — TransferError outbound, OSError inbound)."""
-    data = bytearray()
-    while len(data) < count:
-        chunk = sock.recv(count - len(data))
-        if not chunk:
-            return None
-        data += chunk
-    return bytes(data)
-
-
-def pack_bitfield(flags) -> bytes:
-    """BEP 3 BITFIELD payload from an iterable of have-booleans
-    (MSB-first within each byte)."""
-    flags = list(flags)
-    field = bytearray((len(flags) + 7) // 8)
-    for i, done in enumerate(flags):
-        if done:
-            field[i // 8] |= 0x80 >> (i % 8)
-    return bytes(field)
-
-
-# ---------------------------------------------------------------------------
-# tracker announce
-
-
-def announce(
-    tracker_url: str,
-    info_hash: bytes,
-    peer_id: bytes,
-    left: int,
-    port: int = 6881,
-    timeout: float = 15.0,
-    event: str = "started",
-    uploaded: int = 0,
-    downloaded: int = 0,
-) -> list[tuple[str, int]]:
-    """HTTP announce; returns peer (host, port) pairs. Supports compact
-    (BEP 23) and dict-form peer lists. ``event=""`` is a regular
-    re-announce — repeating "started" would reset the session on real
-    trackers (and some rate-limit it). ``uploaded``/``downloaded`` are
-    real session counters (the listener serves blocks now), not the
-    zeros a leech-only client reports."""
-    params = {
-        "info_hash": info_hash,
-        "peer_id": peer_id,
-        "port": str(port),
-        "uploaded": str(uploaded),
-        "downloaded": str(downloaded),
-        "left": str(left),
-        "compact": "1",
-    }
-    if event:
-        params["event"] = event
-    query = urllib.parse.urlencode(
-        params,
-        quote_via=urllib.parse.quote,
-        safe="",
-    )
-    separator = "&" if "?" in tracker_url else "?"
-    url = f"{tracker_url}{separator}{query}"
-    try:
-        with urllib.request.urlopen(url, timeout=timeout) as response:
-            body = response.read()
-    except (urllib.error.URLError, OSError) as exc:
-        raise TransferError(f"tracker announce failed: {exc}") from exc
-
-    try:
-        reply = bencode.decode(body)
-    except bencode.BencodeError as exc:
-        raise TransferError(f"tracker returned invalid bencoding: {exc}") from exc
-    if not isinstance(reply, dict):
-        raise TransferError("tracker reply is not a dict")
-    if b"failure reason" in reply:
-        reason = reply[b"failure reason"]
-        raise TransferError(
-            f"tracker failure: {reason.decode('utf-8', 'replace') if isinstance(reason, bytes) else reason}"
-        )
-
-    peers = reply.get(b"peers", b"")
-    result: list[tuple[str, int]] = []
-    if isinstance(peers, bytes):
-        result.extend(decode_compact_peers(peers))
-    elif isinstance(peers, list):
-        for entry in peers:
-            if isinstance(entry, dict) and b"ip" in entry and b"port" in entry:
-                result.append(
-                    (entry[b"ip"].decode("utf-8", "replace"), int(entry[b"port"]))
-                )
-    peers6 = reply.get(b"peers6", b"")
-    if isinstance(peers6, bytes):
-        result.extend(decode_compact_peers6(peers6))
-    return result
-
-
-def decode_compact_peers(blob: bytes) -> list[tuple[str, int]]:
-    """BEP 23 compact peer list: 6 bytes per peer (IPv4 + big-endian port)."""
-    return [
-        (
-            str(ipaddress.IPv4Address(blob[i : i + 4])),
-            struct.unpack(">H", blob[i + 4 : i + 6])[0],
-        )
-        for i in range(0, len(blob) - 5, 6)
-    ]
-
-
-def decode_compact_peers6(blob: bytes) -> list[tuple[str, int]]:
-    """BEP 7 compact IPv6 peer list: 18 bytes per peer (IPv6 + port).
-    socket.create_connection takes the literal address as-is, so these
-    flow through the normal peer path."""
-    return [
-        (
-            str(ipaddress.IPv6Address(blob[i : i + 16])),
-            struct.unpack(">H", blob[i + 16 : i + 18])[0],
-        )
-        for i in range(0, len(blob) - 17, 18)
-    ]
-
-
-# UDP tracker protocol (BEP 15)
-
-_UDP_PROTOCOL_ID = 0x41727101980  # magic constant from the spec
-_UDP_ACTION_CONNECT = 0
-_UDP_ACTION_ANNOUNCE = 1
-_UDP_ACTION_ERROR = 3
-
-
-def _udp_roundtrip(
-    sock: socket.socket,
-    addr: tuple[str, int],
-    request: bytes,
-    transaction_id: int,
-    timeout: float,
-    retries: int,
-) -> bytes:
-    """Send and await the reply with matching transaction id; BEP 15
-    prescribes resend-on-timeout (spec: 15*2^n — scaled down here by the
-    caller's timeout since a media job shouldn't stall a minute per
-    tracker). Each attempt runs against a monotonic deadline, so a
-    chatty host spraying non-matching datagrams cannot reset the clock
-    and stall the announce past its documented bound."""
-    for attempt in range(retries + 1):
-        sock.sendto(request, addr)
-        deadline = time.monotonic() + timeout * (2**attempt)
-        try:
-            while True:
-                remain = deadline - time.monotonic()
-                if remain <= 0:
-                    raise socket.timeout()
-                sock.settimeout(remain)
-                reply, _ = sock.recvfrom(65536)
-                if len(reply) < 8:
-                    continue
-                action, tid = struct.unpack(">II", reply[:8])
-                if tid != transaction_id:
-                    continue  # stale datagram from an earlier attempt
-                if action == _UDP_ACTION_ERROR:
-                    message = reply[8:].decode("utf-8", "replace")
-                    raise TransferError(f"tracker error: {message}")
-                return reply
-        except socket.timeout:
-            continue
-    raise TransferError(f"tracker timed out after {retries + 1} attempts")
-
-
-def announce_udp(
-    tracker_url: str,
-    info_hash: bytes,
-    peer_id: bytes,
-    left: int,
-    port: int = 6881,
-    timeout: float = 3.0,
-    retries: int = 1,
-    event: str = "started",
-    uploaded: int = 0,
-    downloaded: int = 0,
-) -> list[tuple[str, int]]:
-    """UDP announce (BEP 15): connect handshake to obtain a connection
-    id, then announce; returns peer (host, port) pairs. Defaults bound a
-    dead tracker to ~9 s (3+6), not the spec's minute-plus schedule — a
-    media job with several dead trackers shouldn't stall the pipeline."""
-    parsed = urllib.parse.urlparse(tracker_url)
-    if parsed.scheme != "udp" or not parsed.hostname:
-        raise TransferError(f"not a udp tracker url: {tracker_url}")
-    try:
-        tracker_port = parsed.port  # raises ValueError when out of range
-    except ValueError as exc:
-        raise TransferError(f"udp tracker port invalid: {tracker_url}") from exc
-    if tracker_port is None:
-        # there is no meaningful default port for UDP trackers; guessing
-        # one buys a silent full-timeout stall instead of a clear error
-        raise TransferError(f"udp tracker url has no port: {tracker_url}")
-    addr = (parsed.hostname, tracker_port)
-
-    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as sock:
-        try:
-            tid = struct.unpack(">I", secrets.token_bytes(4))[0]
-            reply = _udp_roundtrip(
-                sock,
-                addr,
-                struct.pack(">QII", _UDP_PROTOCOL_ID, _UDP_ACTION_CONNECT, tid),
-                tid,
-                timeout,
-                retries,
-            )
-            if len(reply) < 16 or struct.unpack(">I", reply[:4])[0] != 0:
-                raise TransferError("malformed connect reply from tracker")
-            connection_id = struct.unpack(">Q", reply[8:16])[0]
-
-            tid = struct.unpack(">I", secrets.token_bytes(4))[0]
-            request = struct.pack(
-                ">QII20s20sQQQIIIiH",
-                connection_id,
-                _UDP_ACTION_ANNOUNCE,
-                tid,
-                info_hash,
-                peer_id,
-                downloaded,
-                left,
-                uploaded,
-                # BEP 15 event codes; 0 = none (regular re-announce)
-                {"": 0, "completed": 1, "started": 2, "stopped": 3}[event],
-                0,  # IP (default: sender address)
-                struct.unpack(">I", secrets.token_bytes(4))[0],  # key
-                -1,  # num_want: default
-                port,
-            )
-            reply = _udp_roundtrip(sock, addr, request, tid, timeout, retries)
-            if len(reply) < 20 or struct.unpack(">I", reply[:4])[0] != 1:
-                raise TransferError("malformed announce reply from tracker")
-            return decode_compact_peers(reply[20:])
-        except OSError as exc:
-            raise TransferError(f"tracker announce failed: {exc}") from exc
-
-
-# ---------------------------------------------------------------------------
-# peer connection
-
-
-class PeerProtocolError(TransferError):
-    pass
-
-
-class PeerIdentityError(PeerProtocolError):
-    """The transport worked and the remote answered a valid BT
-    handshake that proves no retry can help: it IS us, or it serves a
-    different torrent. Distinct from plain PeerProtocolError because an
-    EOF mid-handshake IS retryable — an MSE-only peer closes plaintext
-    handshakes cleanly, and that close must fall through to the MSE
-    attempt, not abort the whole attempt matrix."""
-
-
-class PeerConnection:
-    """One wire connection to a peer: handshake + message framing."""
-
-    def __init__(
-        self,
-        host: str,
-        port: int,
-        info_hash: bytes,
-        peer_id: bytes,
-        token: CancelToken,
-        timeout: float = 20.0,
-        encryption: str = "allow",
-        transport: str = "tcp",
-        utp_mux: "utp.UTPMultiplexer | None" = None,
-        listen_port: int | None = None,
-    ):
-        self.host, self.port = host, port
-        self.info_hash = info_hash
-        # our OWN listener port, advertised via BEP 10 "p" so the
-        # remote can dial us back
-        self.listen_port = listen_port
-        self.choked = True
-        self.bitfield = b""
-        self.remote_have_all = False  # BEP 6 HAVE_ALL received
-        self.allowed_fast: set[int] = set()  # BEP 6 grants received
-        self.remote_extensions: dict[bytes, int] = {}
-        self.metadata_size = 0
-        # BEP 11 gossip: peers this peer told us about; the swarm
-        # worker drains these into the shared peer queue
-        self.pex_peers: list[tuple[str, int]] = []
-        self._pex_received = 0  # lifetime count, enforces _PEX_PER_CONN
-        # reciprocation state: with a store attached (attach_store),
-        # the remote's INTERESTED/REQUEST frames are served inline from
-        # read_message — a real peer serves on connections it initiated
-        # too (anacrolix does; NAT'd remotes may have no other way in)
-        self._serve_store: "PieceStore | None" = None
-        self._remote_interested = False
-        self._remote_unchoked = False
-        # deque: appends come from other workers (GIL-atomic), popleft
-        # from the owner; O(1) both ways even for a 10k-piece catch-up
-        self._pending_haves: "collections.deque[int]" = collections.deque()
-        self.blocks_served = 0
-        self.bytes_served = 0
-        self._timeout = timeout
-        self._last_send = time.monotonic()
-        self._last_recv = time.monotonic()
-        self._poll_waiter: SocketWaiter | None = None
-        self._sock: "socket.socket | mse.EncryptedSocket | None" = None
-        self._remove_cancel_hook = token.add_callback(self.close)
-        modes = ENCRYPTION_MODES.get(encryption)
-        if modes is None:
-            self._remove_cancel_hook()
-            raise ValueError(f"unknown encryption policy {encryption!r}")
-        transports = TRANSPORT_MODES.get(transport)
-        if transports is None:
-            self._remove_cancel_hook()
-            raise ValueError(f"unknown transport policy {transport!r}")
-        if utp_mux is None:
-            transports = tuple(t for t in transports if t != "utp")
-            if not transports:
-                self._remove_cancel_hook()
-                raise ValueError("uTP transport requires a utp_mux")
-        try:
-            self._dial(
-                peer_id, token, timeout, encryption, transports, modes, utp_mux
-            )
-        except Exception:
-            self.close()
-            raise
-
-    def _dial(
-        self, peer_id, token, timeout, encryption, transports, modes, utp_mux
-    ) -> None:
-        """Attempt matrix: transports outer, crypto modes inner. A
-        CONNECT failure skips the transport's remaining crypto modes (a
-        socket that never established cannot depend on the crypto), so
-        a dead peer costs one dial per transport, not per (transport,
-        mode) pair; a HANDSHAKE failure retries the next crypto mode
-        over a fresh dial of the same transport."""
-        last_exc: Exception | None = None
-        for trans in transports:
-            for mode in modes:
-                try:
-                    if trans == "utp":
-                        self._sock = utp_mux.connect(
-                            (self.host, self.port),
-                            timeout=min(timeout, UTP_CONNECT_TIMEOUT),
-                        )
-                    else:
-                        self._sock = socket.create_connection(
-                            (self.host, self.port), timeout=timeout
-                        )
-                except OSError as exc:
-                    token.raise_if_cancelled()
-                    last_exc = exc
-                    break  # next transport: redialing can't succeed now
-                try:
-                    self._sock.settimeout(timeout)
-                    if mode == "mse":
-                        # under "require" the offer must not include
-                        # plaintext, or a plaintext-preferring receiver
-                        # could legally downgrade the session
-                        provide = (
-                            mse.CRYPTO_RC4
-                            if encryption == "require"
-                            else mse.CRYPTO_RC4 | mse.CRYPTO_PLAINTEXT
-                        )
-                        self._sock = mse.initiate(
-                            self._sock, self.info_hash, crypto_provide=provide
-                        )
-                    self._handshake(peer_id)
-                    return
-                except PeerIdentityError:
-                    # the remote proved its identity wrong for this job
-                    # (ourselves / foreign info-hash): no other attempt
-                    # can change that — fail now, but still report a
-                    # cancel-hook close as the cancellation it is
-                    self.close()
-                    token.raise_if_cancelled()
-                    raise
-                except (
-                    OSError, mse.MSEError, PeerProtocolError, struct.error
-                ) as exc:
-                    self.close()
-                    self._sock = None
-                    token.raise_if_cancelled()
-                    last_exc = exc
-        assert last_exc is not None
-        raise last_exc
-
-    def _handshake(self, peer_id: bytes) -> None:
-        reserved = bytearray(8)
-        reserved[5] |= 0x10  # BEP 10 extension protocol
-        reserved[7] |= 0x04  # BEP 6 fast extension
-        self._sock.sendall(
-            bytes([len(HANDSHAKE_PSTR)])
-            + HANDSHAKE_PSTR
-            + bytes(reserved)
-            + self.info_hash
-            + peer_id
-        )
-        reply = self._recv_exact(68)
-        if reply[1:20] != HANDSHAKE_PSTR:
-            raise PeerProtocolError("bad handshake protocol string")
-        if reply[28:48] != self.info_hash:
-            raise PeerIdentityError("peer served a different info-hash")
-        self.remote_peer_id = reply[48:68]
-        if self.remote_peer_id == peer_id:
-            # trackers echo our own announce back; a connection to our
-            # own listener would idle-loop (we have nothing we need)
-            raise PeerIdentityError("connected to ourselves")
-        self.remote_supports_extended = bool(reply[25] & 0x10)
-        self.remote_supports_fast = bool(reply[27] & 0x04)
-        if self.remote_supports_fast:
-            # BEP 6: exactly one of BITFIELD/HAVE_ALL/HAVE_NONE MUST
-            # precede any other message once fast is negotiated. The
-            # store isn't attached yet, so HAVE_NONE now + HAVE catch-up
-            # later (the lazy-bitfield flow BEP 6 sanctions).
-            self.send_message(MSG_HAVE_NONE)
-        if self.remote_supports_extended:
-            self.send_extended_handshake()
-
-    def send_extended_handshake(self) -> None:
-        ext: dict = {b"m": {b"ut_metadata": UT_METADATA, b"ut_pex": UT_PEX}}
-        if self.listen_port:
-            # BEP 10 "p": our listening port. This is how a peer we
-            # DIALED learns a dialable address for us — inbound
-            # connections are serve-only, so without it a peer that
-            # discovered us asymmetrically (LSD, PEX) could never
-            # leech back (anacrolix advertises it the same way)
-            ext[b"p"] = self.listen_port
-        self.send_message(MSG_EXTENDED, bytes([0]) + bencode.encode(ext))
-
-    def attach_store(self, store: "PieceStore") -> None:
-        """Arm reciprocation: the remote's INTERESTED is answered with
-        UNCHOKE and its REQUESTs are served from ``store`` as side
-        effects of read_message. Everything runs on the single worker
-        thread that owns this connection — socket writes stay
-        single-writer (no shearing), and a served block adds at most
-        one write between our own reads. Pieces we already have go out
-        as HAVE frames (a post-handshake BITFIELD is not spec-legal),
-        via the pending queue the owner flushes at its loop points."""
-        self._serve_store = store
-        for index, done in enumerate(store.have):
-            if done:
-                self._pending_haves.append(index)
-        # the remote may have declared interest before the store existed
-        if self._remote_interested and not self._remote_unchoked:
-            self._remote_unchoked = True
-            self.send_message(MSG_UNCHOKE)
-
-    def queue_have(self, index: int) -> None:
-        """Record a newly-acquired piece for the remote. Called by
-        WHICHEVER worker completed the piece — only queues (deque
-        append, GIL-atomic); the owning worker sends on its next
-        flush_haves so the socket keeps a single writer."""
-        self._pending_haves.append(index)
-
-    def flush_haves(self) -> None:
-        """Owner-thread only: send queued HAVE announcements, batched
-        into ONE sendall (a mostly-resumed 10k-piece torrent queues
-        thousands of 9-byte frames at attach; one syscall each would
-        flood the socket path)."""
-        if not self._pending_haves:
-            return
-        frames = bytearray()
-        while True:
-            try:
-                index = self._pending_haves.popleft()
-            except IndexError:
-                break
-            frames += _frame(MSG_HAVE, struct.pack(">I", index))
-        if frames:
-            self._sock.sendall(frames)
-
-    def _serve_remote_request(self, payload: bytes) -> None:
-        if len(payload) != 12:
-            return
-        index, begin, length = struct.unpack(">III", payload)
-        block = None
-        if (
-            self._serve_store is not None
-            and self._remote_unchoked
-            and length <= MAX_REQUEST_LENGTH
-        ):
-            block = self._serve_store.read_block(index, begin, length)
-        if block is None:
-            # BEP 6 remotes get an explicit REJECT (echoed request) so
-            # they re-request elsewhere now; legacy remotes get the
-            # historical silent drop
-            if self.remote_supports_fast:
-                self.send_message(MSG_REJECT, payload)
-            return
-        self.blocks_served += 1
-        self.bytes_served += len(block)
-        self.send_message(MSG_PIECE, struct.pack(">II", index, begin) + block)
-
-    # -- framing ---------------------------------------------------------
-
-    def _recv_exact(self, count: int) -> bytes:
-        data = _recv_into(self._sock, count)
-        if data is None:
-            raise PeerProtocolError("peer closed connection")
-        return data
-
-    def send_message(self, msg_id: int, payload: bytes = b"") -> None:
-        self._last_send = time.monotonic()
-        self._sock.sendall(_frame(msg_id, payload))
-
-    def read_message(self) -> tuple[int, bytes]:
-        """Return (msg_id, payload); keepalives are skipped. Updates choke /
-        bitfield / extension state as a side effect."""
-        while True:
-            length = struct.unpack(">I", self._recv_exact(4))[0]
-            # any complete frame header — keepalives included — proves
-            # the peer alive; poll_messages' idle reaper keys off this
-            self._last_recv = time.monotonic()
-            if length == 0:
-                continue  # keepalive
-            if length > (1 << 20) + 9:
-                raise PeerProtocolError(f"oversized frame: {length}")
-            body = self._recv_exact(length)
-            msg_id, payload = body[0], body[1:]
-            if msg_id == MSG_CHOKE:
-                self.choked = True
-            elif msg_id == MSG_UNCHOKE:
-                self.choked = False
-            elif msg_id == MSG_BITFIELD:
-                self.bitfield = payload
-            elif msg_id == MSG_HAVE and len(payload) >= 4:
-                self._mark_have(struct.unpack(">I", payload[:4])[0])
-            elif msg_id == MSG_HAVE_ALL:
-                # BEP 6: empty bitfield already means "assume seeder"
-                # to the claim heuristic; the flag keeps has_piece
-                # truthful too
-                self.bitfield = b""
-                self.remote_have_all = True
-            elif msg_id == MSG_HAVE_NONE:
-                # one all-zero byte: non-empty => "has nothing (yet)";
-                # later HAVE frames grow it via _mark_have
-                self.bitfield = b"\x00"
-                self.remote_have_all = False
-            elif msg_id == MSG_ALLOWED_FAST and len(payload) >= 4:
-                # BEP 6: pieces we may request even while choked. Cap
-                # so a hostile grant-flood can't grow state; trusting
-                # the grants (vs recomputing the canonical set) is
-                # safe — a peer over-granting only helps us
-                if len(self.allowed_fast) < 4 * ALLOWED_FAST_K:
-                    self.allowed_fast.add(
-                        struct.unpack(">I", payload[:4])[0]
-                    )
-            elif msg_id == MSG_INTERESTED:
-                self._remote_interested = True
-                if self._serve_store is not None and not self._remote_unchoked:
-                    self._remote_unchoked = True
-                    self.send_message(MSG_UNCHOKE)
-            elif msg_id == MSG_NOT_INTERESTED:
-                self._remote_interested = False
-            elif msg_id == MSG_REQUEST:
-                self._serve_remote_request(payload)
-            elif msg_id == MSG_EXTENDED and payload and payload[0] == 0:
-                self._parse_extended_handshake(payload[1:])
-            elif msg_id == MSG_EXTENDED and payload and payload[0] == UT_PEX:
-                self._parse_pex(payload[1:])
-            return msg_id, payload
-
-    # gossip bounds: BEP 11 suggests <=50 peers per message, and one
-    # connection has no business naming hundreds of peers over a job's
-    # lifetime — beyond that it's an address-flood, not a swarm
-    _PEX_PER_MESSAGE = 50
-    _PEX_PER_CONN = 200
-
-    def _parse_pex(self, body: bytes) -> None:
-        """BEP 11 ut_pex: fold the peer's 'added' lists into
-        ``pex_peers`` for the swarm to drain — tracker-thin swarms grow
-        through gossip this way (anacrolix speaks PEX too). Bounded per
-        message and per connection so a hostile peer cannot flood the
-        job with bogus addresses."""
-        try:
-            info = bencode.decode(body)
-        except bencode.BencodeError:
-            return
-        if not isinstance(info, dict):
-            return
-        fresh: list[tuple[str, int]] = []
-        added = info.get(b"added")
-        if isinstance(added, bytes):
-            fresh.extend(decode_compact_peers(added))
-        added6 = info.get(b"added6")
-        if isinstance(added6, bytes):
-            fresh.extend(decode_compact_peers6(added6))
-        # cumulative per-conn budget: pex_peers is drained (emptied) by
-        # the worker, so its length cannot carry the cap
-        room = self._PEX_PER_CONN - self._pex_received
-        take = fresh[: min(self._PEX_PER_MESSAGE, max(0, room))]
-        self._pex_received += len(take)
-        self.pex_peers.extend(take)
-
-    def _mark_have(self, index: int) -> None:
-        """Fold a HAVE announcement into the peer's bitfield, so piece
-        selection sees leechers gain pieces live (anacrolix tracks HAVE
-        the same way; without this, a peer's availability is frozen at
-        its initial bitfield and leecher-to-leecher swarms starve)."""
-        byte_index, bit = divmod(index, 8)
-        if byte_index >= 4 * 1024 * 1024:  # 32M pieces: hostile nonsense
-            raise PeerProtocolError(f"HAVE index out of range: {index}")
-        field = bytearray(self.bitfield)
-        if byte_index >= len(field):
-            field.extend(bytes(byte_index + 1 - len(field)))
-        field[byte_index] |= 0x80 >> bit
-        self.bitfield = bytes(field)
-
-    def _parse_extended_handshake(self, payload: bytes) -> None:
-        try:
-            info = bencode.decode(payload)
-        except bencode.BencodeError:
-            return
-        if isinstance(info, dict):
-            mapping = info.get(b"m", {})
-            if isinstance(mapping, dict):
-                # ids outside one byte can't go on the wire: bytes([v])
-                # would raise and kill the worker on a crafted handshake
-                self.remote_extensions = {
-                    k: v
-                    for k, v in mapping.items()
-                    if isinstance(v, int) and 0 < v < 256
-                }
-            size = info.get(b"metadata_size", 0)
-            if isinstance(size, int):
-                self.metadata_size = size
-
-    def has_piece(self, index: int) -> bool:
-        if self.remote_have_all:
-            return True  # BEP 6 HAVE_ALL
-        byte_index, bit = divmod(index, 8)
-        if byte_index >= len(self.bitfield):
-            return False
-        return bool(self.bitfield[byte_index] & (0x80 >> bit))
-
-    def poll_messages(self, duration: float) -> None:
-        """Drain incoming messages for up to ``duration`` seconds,
-        updating choke/bitfield state. Used while holding a connection
-        idle (swarm WAIT) so a remote CHOKE is processed now instead of
-        surfacing as a stale frame mid-piece later. Readability is
-        checked first so an idle wait never consumes a partial frame.
-
-        Reaps dead-silent peers: the worker's choked/WAIT states call
-        this in a loop that (unlike a blocking read_message, which hits
-        the socket timeout) would otherwise never time out, so a peer
-        that handshakes and then says nothing forever would pin a
-        worker thread. A peer silent past the connection timeout is
-        raised out as a protocol error. The horizon is NOT the socket
-        timeout: a healthy choked peer with nothing to say legitimately
-        sends only keepalives, every ~60-120 s per BEP 3 (our own
-        cadence is 60 s, and our inbound loop reads under a 120 s
-        socket timeout) — so reap only past 2x the 120 s upper
-        cadence, the same dead-vs-quiet margin the AMQP heartbeat
-        uses."""
-        reap_after = max(self._timeout, IDLE_REAP_TIMEOUT)
-        if time.monotonic() - self._last_recv > reap_after:
-            raise PeerProtocolError(
-                f"peer silent for over {reap_after:.0f}s while idle"
-            )
-        deadline = time.monotonic() + duration
-        # SocketWaiter, not bare select.select: select raises ValueError
-        # for fds >= FD_SETSIZE (possible in the long-lived daemon) and
-        # for the socket being closed mid-wait by the cancel hook; the
-        # waiter turns both into OSError, which the worker's error
-        # handling treats as an ordinary peer failure/cancel. Created
-        # once per connection — the swarm WAIT state polls every 50 ms
-        # and must not pay epoll setup/teardown per poll.
-        if self._poll_waiter is None:
-            self._poll_waiter = SocketWaiter(self._sock, write=False, what="read")
-        while True:
-            # a long WAIT state is pure silence from our side; peers
-            # following the spec reap connections idle ~2 min, so send
-            # the 4-byte keepalive frame once a minute (BEP 3)
-            if time.monotonic() - self._last_send > 60.0:
-                self._last_send = time.monotonic()
-                self._sock.sendall(struct.pack(">I", 0))
-            remain = deadline - time.monotonic()
-            if remain <= 0:
-                return
-            # an encrypted transport may hold already-decrypted surplus
-            # from the MSE handshake; the fd won't signal for those
-            pending = getattr(self._sock, "pending", None)
-            if pending is None or not pending():
-                try:
-                    self._poll_waiter.wait(remain)
-                except TimeoutError:
-                    return
-            # a frame has started arriving; read_message blocks under
-            # the normal socket timeout until it completes, keeping
-            # framing
-            self.read_message()
-
-    def close(self) -> None:
-        waiter, self._poll_waiter = self._poll_waiter, None
-        if waiter is not None:
-            waiter.close()
-        sock = self._sock
-        if sock is not None:
-            try:
-                sock.close()
-            except OSError:
-                pass
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self._remove_cancel_hook()
-        self.close()
-
-
-# ---------------------------------------------------------------------------
-# metadata exchange (BEP 9)
-
-
-def fetch_metadata(conn: PeerConnection, info_hash: bytes, deadline: float) -> dict:
-    """Download the info dict from a peer via ut_metadata and verify its
-    SHA-1 equals the info-hash (the reference's GotInfo phase)."""
-    if not conn.remote_supports_extended:
-        # no BEP 10 bit in its handshake: this peer can never provide
-        # metadata — fail in microseconds, not a read-timeout stall
-        raise PeerProtocolError("peer does not support extensions (BEP 10)")
-    while not conn.remote_extensions and time.monotonic() < deadline:
-        conn.read_message()
-    remote_id = conn.remote_extensions.get(b"ut_metadata")
-    if not remote_id or conn.metadata_size <= 0:
-        raise PeerProtocolError("peer does not offer ut_metadata")
-
-    piece_count = (conn.metadata_size + BLOCK_SIZE - 1) // BLOCK_SIZE
-    blob = bytearray()
-    for piece in range(piece_count):
-        request = bencode.encode({b"msg_type": 0, b"piece": piece})
-        conn.send_message(MSG_EXTENDED, bytes([remote_id]) + request)
-        while True:
-            if time.monotonic() > deadline:
-                raise TransferError("metadata exchange timed out")
-            msg_id, payload = conn.read_message()
-            if msg_id != MSG_EXTENDED or not payload or payload[0] != UT_METADATA:
-                continue
-            header, offset = bencode._decode(payload[1:], 0)
-            if not isinstance(header, dict) or header.get(b"msg_type") != 1:
-                if isinstance(header, dict) and header.get(b"msg_type") == 2:
-                    raise PeerProtocolError("peer rejected metadata request")
-                continue
-            if header.get(b"piece") != piece:
-                continue
-            blob += payload[1 + offset :]
-            break
-
-    if hashlib.sha1(blob).digest() != info_hash:
-        raise PeerProtocolError("metadata failed info-hash verification")
-    info = bencode.decode(bytes(blob))
-    if not isinstance(info, dict):
-        raise PeerProtocolError("metadata is not a dict")
-    return info
-
-
-# ---------------------------------------------------------------------------
-# piece storage
-
-
-class PieceStore:
-    """Maps verified pieces onto the torrent's file layout under base_dir,
-    mirroring anacrolix file storage (reference torrent.go:40-41)."""
-
-    def __init__(self, info: dict, base_dir: str):
-        self.piece_length = info.get(b"piece length", 0)
-        hashes = info.get(b"pieces", b"")
-        if (
-            not isinstance(self.piece_length, int)
-            or self.piece_length <= 0
-            or not isinstance(hashes, bytes)
-            or len(hashes) % 20
-        ):
-            raise TransferError("invalid torrent info dict")
-        self.piece_hashes = [hashes[i : i + 20] for i in range(0, len(hashes), 20)]
-
-        name_raw = info.get(b"name", b"download")
-        name = os.path.basename(
-            name_raw.decode("utf-8", "replace") if isinstance(name_raw, bytes) else "download"
-        ) or "download"
-
-        self.files: list[tuple[str, int]] = []  # (path, length)
-        # torrent-relative path segments per file (webseed URL building)
-        self.relative_paths: list[tuple[str, ...]] = []
-        self.single_file = b"files" not in info
-        if not self.single_file:  # multi-file: base_dir/name/<path...>
-            for entry in info[b"files"]:
-                parts = [
-                    p.decode("utf-8", "replace")
-                    for p in entry[b"path"]
-                    if isinstance(p, bytes)
-                ]
-                safe_parts = [os.path.basename(p) for p in parts if p not in ("", ".", "..")]
-                if not safe_parts:
-                    raise TransferError("torrent file entry has no usable path")
-                self.files.append(
-                    (os.path.join(base_dir, name, *safe_parts), int(entry[b"length"]))
-                )
-                self.relative_paths.append((name, *safe_parts))
-        else:  # single file: base_dir/name
-            self.files.append((os.path.join(base_dir, name), int(info[b"length"])))
-            self.relative_paths.append((name,))
-
-        self.total_length = sum(length for _, length in self.files)
-        expected_pieces = (
-            self.total_length + self.piece_length - 1
-        ) // self.piece_length
-        if expected_pieces != len(self.piece_hashes):
-            raise TransferError(
-                f"piece count mismatch: {len(self.piece_hashes)} hashes for "
-                f"{expected_pieces} pieces"
-            )
-        self.have = [False] * len(self.piece_hashes)
-        # serializes write_piece file IO: concurrent peer workers would
-        # otherwise race the exists()/"wb" decision and truncate each
-        # other's bytes in shared files
-        self._write_lock = threading.Lock()
-        # piece-complete callbacks (index) — the inbound listener hangs
-        # its HAVE broadcast here so remote leechers learn of new pieces
-        self._observers: list = []
-
-    def add_observer(self, callback) -> None:
-        self._observers.append(callback)
-
-    @property
-    def num_pieces(self) -> int:
-        return len(self.piece_hashes)
-
-    def piece_size(self, index: int) -> int:
-        if index == self.num_pieces - 1:
-            remainder = self.total_length - self.piece_length * (self.num_pieces - 1)
-            return remainder
-        return self.piece_length
-
-    def bytes_completed(self) -> int:
-        return sum(
-            self.piece_size(i) for i, done in enumerate(self.have) if done
-        )
-
-    def piece_file_ranges(
-        self, index: int
-    ) -> list[tuple[tuple[str, ...], int, int]]:
-        """[(relative_path_parts, offset_in_file, length)] covering one
-        piece — the per-file ranges a webseed fetch must request."""
-        offset = index * self.piece_length
-        size = self.piece_size(index)
-        out = []
-        file_start = 0
-        for (path, length), parts in zip(self.files, self.relative_paths):
-            file_end = file_start + length
-            lo = max(offset, file_start)
-            hi = min(offset + size, file_end)
-            if lo < hi:
-                out.append((parts, lo - file_start, hi - lo))
-            file_start = file_end
-        return out
-
-    def read_piece(self, index: int, handles: dict | None = None) -> bytes | None:
-        """Read one piece back from the on-disk file layout.
-
-        Returns None if any file covering the piece is missing or too
-        short (nothing to resume for that piece). ``handles`` is an
-        optional path→open-file cache so a whole-torrent scan
-        (resume_existing) opens each file once instead of once per piece.
-        """
-        return self._read_range(
-            index * self.piece_length, self.piece_size(index), handles
-        )
-
-    def read_block(self, index: int, begin: int, length: int) -> bytes | None:
-        """One block of a COMPLETED piece, for serving inbound REQUESTs.
-        Returns None for pieces we don't have or out-of-bounds ranges —
-        the serving side drops such requests rather than erroring."""
-        if not (0 <= index < self.num_pieces) or not self.have[index]:
-            return None
-        if begin < 0 or length <= 0 or begin + length > self.piece_size(index):
-            return None
-        return self._read_range(index * self.piece_length + begin, length)
-
-    def _read_range(
-        self, offset: int, size: int, handles: dict | None = None
-    ) -> bytes | None:
-        out = bytearray()
-        file_start = 0
-        for path, length in self.files:
-            file_end = file_start + length
-            lo = max(offset, file_start)
-            hi = min(offset + size, file_end)
-            if lo < hi:
-                if handles is not None and path in handles:
-                    src = handles[path]
-                else:
-                    try:
-                        src = open(path, "rb")
-                    except OSError:
-                        src = None
-                    if handles is not None:
-                        handles[path] = src
-                if src is None:
-                    return None
-                try:
-                    src.seek(lo - file_start)
-                    chunk = src.read(hi - lo)
-                except OSError:
-                    return None
-                finally:
-                    if handles is None:
-                        src.close()
-                if len(chunk) != hi - lo:
-                    return None
-                out += chunk
-            file_start = file_end
-        if len(out) != size:
-            return None
-        return bytes(out)
-
-    def resume_existing(
-        self,
-        engine: DigestEngine | None = None,
-        batch_bytes: int = 64 * 1024 * 1024,
-    ) -> int:
-        """Mark pieces already valid on disk as complete.
-
-        Re-verifies whatever a previous (interrupted) job left in the
-        file layout, batching pieces through the digest engine
-        (accelerator-offloaded for large batches) in ``batch_bytes``
-        chunks to bound host memory. Returns the number of resumed
-        pieces. Sparse regions written by out-of-order ``write_piece``
-        calls read back as zeros and simply fail verification.
-        """
-        engine = engine or default_engine()
-        resumed = 0
-        indices: list[int] = []
-        pieces: list[bytes] = []
-        pending = 0
-        handles: dict = {}  # one open per file for the whole scan
-
-        def flush() -> int:
-            nonlocal indices, pieces, pending
-            if not indices:
-                return 0
-            verdicts = engine.verify_pieces(
-                pieces, [self.piece_hashes[i] for i in indices]
-            )
-            count = 0
-            for index, good in zip(indices, verdicts):
-                if good:
-                    self.have[index] = True
-                    count += 1
-            indices, pieces, pending = [], [], 0
-            return count
-
-        try:
-            for index in range(self.num_pieces):
-                if self.have[index]:
-                    continue
-                data = self.read_piece(index, handles=handles)
-                if data is None:
-                    continue
-                indices.append(index)
-                pieces.append(data)
-                pending += len(data)
-                if pending >= batch_bytes:
-                    resumed += flush()
-        finally:
-            for handle in handles.values():
-                if handle is not None:
-                    handle.close()
-        resumed += flush()
-        return resumed
-
-    def write_piece(self, index: int, data: bytes) -> None:
-        """Verify one piece against its torrent hash and write it.
-        Per-piece hashlib verification: right for trickle arrivals and
-        direct callers; the swarm's batch path verifies through the
-        digest engine first and calls :meth:`write_verified`."""
-        if hashlib.sha1(data).digest() != self.piece_hashes[index]:
-            raise PeerProtocolError(f"piece {index} failed SHA-1 verification")
-        self.write_verified(index, data)
-
-    def write_verified(self, index: int, data: bytes) -> None:
-        """Write a piece that has ALREADY been verified (batch path)."""
-        offset = index * self.piece_length
-        cursor = 0
-        file_start = 0
-        with self._write_lock:
-            for path, length in self.files:
-                file_end = file_start + length
-                if offset + cursor < file_end and offset + len(data) > file_start:
-                    begin_in_file = max(offset + cursor - file_start, 0)
-                    take = min(file_end - (offset + cursor), len(data) - cursor)
-                    os.makedirs(os.path.dirname(path), exist_ok=True)
-                    with open(path, "r+b" if os.path.exists(path) else "wb") as sink:
-                        sink.seek(begin_in_file)
-                        sink.write(data[cursor : cursor + take])
-                    cursor += take
-                    if cursor == len(data):
-                        break
-                file_start = file_end
-            self.have[index] = True
-        metrics.GLOBAL.add("torrent_pieces_verified")
-        metrics.GLOBAL.add("torrent_bytes_downloaded", len(data))
-        # notify outside the write lock: observers hit the network (HAVE
-        # broadcasts) and must not serialize piece writes behind a slow
-        # remote's socket
-        for callback in list(self._observers):
-            callback(index)
-
-
-# ---------------------------------------------------------------------------
-# webseeds (BEP 19): HTTP servers as piece sources
-
-
-class _WebSeedSource:
-    """Virtual 'peer' a webseed worker hands to claim(): it has every
-    piece, never gossips, and is never registered for rarity (it would
-    shift every piece's availability uniformly anyway)."""
-
-    bitfield = b""  # empty = has-everything to the claim heuristic
-
-    def has_piece(self, index: int) -> bool:
-        return True
-
-    def queue_have(self, index: int) -> None:
-        pass
-
-
-class _WebSeedPermanent(TransferError):
-    """A webseed error retrying cannot fix (4xx, redirect, bad scheme):
-    the worker gives the URL up for the job instead of burning its
-    transient-failure budget on it."""
-
-
-def _webseed_file_url(base: str, parts: tuple[str, ...], single: bool) -> str:
-    """BEP 19 URL rules: a single-file URL not ending in '/' IS the
-    file; otherwise the torrent name (and subpaths) are appended."""
-    if single and not base.endswith("/"):
-        return base
-    path = "/".join(urllib.parse.quote(part) for part in parts)
-    return base.rstrip("/") + "/" + path
-
-
-class _WebSeedClient:
-    """Per-worker HTTP/FTP client with a persistent connection: a 4 GB
-    torrent at 1 MiB pieces would otherwise pay ~4000 TCP(/TLS or
-    login) handshakes to the same host, one per piece. Cancellation
-    closes the connection (the token callback), unblocking any
-    in-flight read immediately."""
-
-    def __init__(self, timeout: float = 30.0):
-        self._timeout = timeout
-        self._conn: "http.client.HTTPConnection | None" = None
-        self._ftp = None  # ftplib.FTP, lazily imported
-        self._ftp_data: "socket.socket | None" = None  # in-flight RETR
-        self._key: tuple[str, str] | None = None
-
-    def close(self) -> None:
-        conn, self._conn = self._conn, None
-        if conn is not None:
-            try:
-                conn.close()
-            except OSError:
-                pass
-        # the data socket first: the cancel hook's whole job is to
-        # unblock an in-flight recv immediately — which takes a real
-        # shutdown(); close() alone only drops the fd and leaves a
-        # concurrently-blocked recv waiting out its timeout
-        data, self._ftp_data = self._ftp_data, None
-        if data is not None:
-            try:
-                data.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                data.close()
-            except OSError:
-                pass
-        ftp, self._ftp = self._ftp, None
-        if ftp is not None:
-            try:
-                # close(), not quit(): quit() writes QUIT and BLOCKS on
-                # the reply — this runs from the cancel hook, which must
-                # unblock an in-flight read, not start a new one
-                ftp.close()
-            except OSError:
-                pass
-
-    def fetch_range(self, url: str, offset: int, length: int) -> bytes:
-        import http.client
-
-        parsed = urllib.parse.urlsplit(url)
-        if parsed.scheme == "ftp" and parsed.netloc:
-            # BEP 19 names "HTTP/FTP seeding"; anacrolix's webseed
-            # support is what the reference inherits (torrent.go:44)
-            return self._fetch_ftp_range(parsed, offset, length, url)
-        if parsed.scheme not in ("http", "https") or not parsed.netloc:
-            raise _WebSeedPermanent(f"unsupported webseed url: {url}")
-        key = (parsed.scheme, parsed.netloc)
-        last: Exception | None = None
-        for attempt in range(2):  # one silent retry: stale keep-alive
-            if self._conn is None or self._key != key:
-                self.close()
-                conn_cls = (
-                    http.client.HTTPSConnection
-                    if parsed.scheme == "https"
-                    else http.client.HTTPConnection
-                )
-                self._conn = conn_cls(parsed.netloc, timeout=self._timeout)
-                self._key = key
-            path = parsed.path or "/"
-            if parsed.query:
-                path += "?" + parsed.query
-            try:
-                self._conn.request(
-                    "GET",
-                    path,
-                    headers={"Range": f"bytes={offset}-{offset + length - 1}"},
-                )
-                response = self._conn.getresponse()
-            except (http.client.HTTPException, OSError) as exc:
-                self.close()
-                last = exc
-                continue
-            return self._consume(response, offset, length, url)
-        raise TransferError(f"webseed fetch failed: {last}")
-
-    def _consume(self, response, offset: int, length: int, url: str) -> bytes:
-        import http.client
-
-        status = response.status
-        if status >= 300:
-            # http.client follows nothing: redirects and 4xx are
-            # deterministic — permanent; 5xx/429 are worth a retry
-            try:
-                response.read()  # drain so the connection stays usable
-            except (http.client.HTTPException, OSError):
-                self.close()
-            if status == 429 or status >= 500:
-                raise TransferError(f"webseed status {status}: {url}")
-            raise _WebSeedPermanent(f"webseed status {status}: {url}")
-        try:
-            if status != 206 and offset:
-                # server ignored Range: discard the prefix — correct,
-                # if wasteful, which only hurts the degraded case
-                remaining = offset
-                while remaining > 0:
-                    skipped = response.read(min(1 << 20, remaining))
-                    if not skipped:
-                        raise TransferError(f"webseed short body: {url}")
-                    remaining -= len(skipped)
-            chunk = bytearray()
-            while len(chunk) < length:
-                got = response.read(length - len(chunk))
-                if not got:
-                    raise TransferError(f"webseed short read: {url}")
-                chunk += got
-            if response.read(1):
-                # unread remainder (Range-ignoring server): it would
-                # desync the next request on this connection
-                self.close()
-            return bytes(chunk)
-        except (http.client.HTTPException, OSError) as exc:
-            self.close()
-            raise TransferError(f"webseed read failed: {exc}") from exc
-
-    def _fetch_ftp_range(
-        self, parsed, offset: int, length: int, url: str
-    ) -> bytes:
-        """One range via FTP: binary RETR with a REST offset (RFC 959 /
-        RFC 3659), reading exactly ``length`` bytes then aborting the
-        transfer. The control connection persists across pieces like
-        the HTTP keep-alive; a server that gets confused by the ABOR
-        dance just costs a reconnect on the next piece."""
-        import ftplib
-
-        # torrent-supplied URL: malformed ports raise ValueError from
-        # .port, hostless netlocs give hostname None, and CR/LF smuggled
-        # through percent-encoding (in the path OR the userinfo) would
-        # inject FTP commands — all deterministic, so classify as
-        # permanent, not a traceback
-        try:
-            port = parsed.port or 21
-        except ValueError as exc:
-            raise _WebSeedPermanent(f"unsupported webseed url: {url}") from exc
-        path = urllib.parse.unquote(parsed.path) or "/"
-        # URL userinfo wins; anonymous otherwise (the conventional
-        # email-ish password)
-        user = urllib.parse.unquote(parsed.username or "anonymous")
-        passwd = urllib.parse.unquote(parsed.password or "anonymous@")
-        if not parsed.hostname or any(
-            c in field for field in (path, user, passwd) for c in "\r\n"
-        ):
-            raise _WebSeedPermanent(f"unsupported webseed url: {url}")
-
-        key = ("ftp", parsed.netloc)
-        last: Exception | None = None
-        for attempt in range(2):  # one silent retry: stale control conn
-            if self._ftp is None or self._key != key:
-                self.close()
-                ftp = ftplib.FTP(timeout=self._timeout)
-                try:
-                    ftp.connect(parsed.hostname, port)
-                    ftp.login(user, passwd)
-                    ftp.voidcmd("TYPE I")  # binary; ASCII would mangle
-                except ftplib.error_perm as exc:
-                    # 5xx on connect/login: credentials/policy — no
-                    # retry can fix it
-                    try:
-                        ftp.close()
-                    except OSError:
-                        pass
-                    raise _WebSeedPermanent(
-                        f"ftp webseed login refused: {exc}"
-                    ) from exc
-                except (ftplib.Error, OSError, EOFError) as exc:
-                    try:
-                        ftp.close()
-                    except OSError:
-                        pass
-                    last = exc
-                    continue
-                self._ftp = ftp
-                self._key = key
-            else:
-                ftp = self._ftp
-            # LOCAL binding from here on: the cancel hook's close() may
-            # null self._ftp concurrently mid-piece; operations on the
-            # closed-out local then raise OSError (caught) instead of
-            # AttributeError on None
-            discard = 0
-            try:
-                # rest=None when offset is 0: sending "REST 0" would
-                # make a REST-less server 502 every fetch, disqualifying
-                # a webseed that works fine for whole-file reads
-                data_sock = ftp.transfercmd(
-                    f"RETR {path}", rest=offset if offset else None
-                )
-            except ftplib.error_perm as exc:
-                if not offset:
-                    # 550 no-such-file etc.: deterministic — permanent
-                    self.close()
-                    raise _WebSeedPermanent(f"ftp webseed: {exc}") from exc
-                # could be REST unsupported (502/501): degrade once to a
-                # plain RETR and discard the prefix, mirroring the HTTP
-                # path's Range-ignoring-server handling; a genuine 550
-                # just fails again below, permanently
-                try:
-                    data_sock = ftp.transfercmd(f"RETR {path}")
-                    discard = offset
-                except ftplib.error_perm as exc2:
-                    self.close()
-                    raise _WebSeedPermanent(f"ftp webseed: {exc2}") from exc2
-                except (ftplib.Error, OSError, EOFError) as exc2:
-                    self.close()
-                    last = exc2
-                    continue
-            except (ftplib.Error, OSError, EOFError) as exc:
-                self.close()
-                last = exc
-                continue
-            self._ftp_data = data_sock  # cancel hook can now unblock recv
-            try:
-                data_sock.settimeout(self._timeout)
-                remaining = discard
-                while remaining > 0:
-                    skipped = data_sock.recv(min(1 << 16, remaining))
-                    if not skipped:
-                        raise TransferError(f"ftp webseed short body: {url}")
-                    remaining -= len(skipped)
-                chunk = bytearray()
-                while len(chunk) < length:
-                    got = data_sock.recv(min(1 << 16, length - len(chunk)))
-                    if not got:
-                        raise TransferError(f"ftp webseed short read: {url}")
-                    chunk += got
-            except (TransferError, OSError, EOFError) as exc:
-                # drop the whole session: the control conn is mid-RETR
-                # with an unread completion reply, useless as-is
-                self.close()
-                try:
-                    data_sock.close()
-                except OSError:
-                    pass
-                if isinstance(exc, TransferError):
-                    raise
-                raise TransferError(f"ftp webseed read failed: {exc}") from exc
-            # mid-file stop: close the data connection and ABOR, then
-            # drain whatever completion reply the server queued. Any
-            # disagreement here poisons only the control conn — drop
-            # it and the next piece reconnects.
-            self._ftp_data = None
-            try:
-                data_sock.close()
-            except OSError:
-                pass
-            try:
-                ftp.abort()
-            except (ftplib.Error, OSError, EOFError, AttributeError):
-                self.close()
-            else:
-                try:
-                    ftp.voidresp()  # the transfer's own 226/426
-                except (ftplib.Error, OSError, EOFError):
-                    self.close()
-            return bytes(chunk)
-        raise TransferError(f"ftp webseed fetch failed: {last}")
-
-
-def _fetch_webseed_piece(
-    client: _WebSeedClient, url: str, store: PieceStore, index: int
-) -> bytes:
-    """One piece via HTTP Range requests (one per file the piece spans)."""
-    out = bytearray()
-    for parts, offset, length in store.piece_file_ranges(index):
-        file_url = _webseed_file_url(url, parts, store.single_file)
-        out += client.fetch_range(file_url, offset, length)
-    return bytes(out)
-
-
-# ---------------------------------------------------------------------------
-# inbound peer half (the listener behind the announced port)
-
-
-class _InboundPeer:
-    """One accepted connection: handshake, then serve the remote leecher.
-
-    INTERESTED is answered with UNCHOKE when the listener grants an
-    upload slot (PeerListener's choker — slot-bounded with an optimistic
-    rotation, the shape anacrolix's choking algorithm gives the
-    reference, torrent.go:44); REQUESTs for completed pieces are
-    answered from the store, and ut_metadata requests are served from
-    the raw info dict so magnet-only peers can bootstrap metadata from
-    us (BEP 9).
-    """
-
-    def __init__(self, listener: "PeerListener", sock: socket.socket, addr):
-        self._listener = listener
-        self._sock = sock
-        self.addr = addr
-        # the serve loop and the sender thread interleave writes on one
-        # socket; frames must not shear
-        self._send_lock = threading.Lock()
-        self.interested = False
-        # sticky: drain accounting must still count a leecher that sent
-        # NOT_INTERESTED when finished (spec-compliant behavior)
-        self.ever_interested = False
-        self.remote_peer_id = b""  # set once the handshake arrives
-        self.remote_supports_fast = False  # BEP 6, from the handshake
-        self._unchoked = False
-        # BEP 6 allowed-fast pieces granted to this peer: requests for
-        # them are served even while choked
-        self._fast_grants: set[int] = set()
-        # total bytes served to this peer; the choker's fairness key.
-        # Written by the serve thread, read by the rechoke thread — a
-        # plain int is fine, a stale read only shifts one ranking round
-        self.bytes_to_peer = 0
-        self._remote_ext: dict[bytes, int] = {}
-        # nothing may be written before our handshake reply is on the
-        # wire: attach()/HAVE broadcasts land mid-handshake otherwise
-        # and the remote reads them as garbled handshake bytes
-        self._ready = threading.Event()
-        # async outbound frames (HAVE broadcasts, deferred UNCHOKE) go
-        # through a sender thread so a stalled remote's full TCP buffer
-        # can never block the piece-writer thread that completed a piece
-        self._outq: "queue.Queue[bytes | None]" = queue.Queue(maxsize=65536)
-        # bytes already consumed from the wire that the read path must
-        # yield first (the MSE initial-payload hand-off)
-        self._prefix = bytearray()
-        # generous: a remote in its WAIT state (all missing pieces
-        # claimed elsewhere) legitimately idles without keepalives
-        sock.settimeout(120.0)
-
-    # -- outgoing --------------------------------------------------------
-
-    def _send(self, msg_id: int, payload: bytes = b"") -> None:
-        with self._send_lock:
-            self._sock.sendall(_frame(msg_id, payload))
-
-    def _enqueue(self, frame: bytes) -> None:
-        if not self._ready.is_set():
-            return  # pre-handshake; the post-handshake catch-up covers it
-        try:
-            self._outq.put_nowait(frame)
-        except queue.Full:
-            self.close()  # pathologically slow consumer: reap
-
-    def _sender_loop(self) -> None:
-        while True:
-            try:
-                frame = self._outq.get(timeout=55.0)
-            except queue.Empty:
-                if not self._ready.is_set():
-                    continue  # mid-handshake: nothing may precede it
-                # nothing to say for ~a minute: keepalive, so a remote
-                # idling in its WAIT state doesn't reap us as dead
-                frame = struct.pack(">I", 0)
-            if frame is None:
-                return
-            # batch whatever else is queued into one sendall: an
-            # attach-time catch-up can queue thousands of 9-byte HAVE
-            # frames, and per-frame syscalls would flood the socket path
-            batch = bytearray(frame)
-            done = False
-            while True:
-                try:
-                    extra = self._outq.get_nowait()
-                except queue.Empty:
-                    break
-                if extra is None:
-                    done = True
-                    break
-                batch += extra
-            try:
-                with self._send_lock:
-                    self._sock.sendall(batch)
-            except OSError:
-                return  # dying connection; the serve loop reaps it
-            if done:
-                return
-
-    def notify_have(self, index: int) -> None:
-        self._enqueue(_frame(MSG_HAVE, struct.pack(">I", index)))
-
-    def arm(self, have_indices: list[int]) -> None:
-        """Attach-time catch-up for an already-handshaken connection:
-        pieces that existed before attach (resume) go out as HAVE
-        frames — a late BITFIELD is not spec-legal — and a remote that
-        declared INTERESTED while we had nothing to serve gets its
-        deferred UNCHOKE plus its allowed-fast grants. Connections
-        still mid-handshake are skipped (_enqueue no-ops pre-ready);
-        their post-handshake catch-up re-snapshots the store and
-        covers the same ground."""
-        for index in have_indices:
-            self.notify_have(index)
-        store, _ = self._listener.snapshot()
-        if store is not None and self._ready.is_set():
-            # pre-ready, _enqueue silently drops frames — granting here
-            # would mark the set sent without it ever reaching the
-            # wire; the post-handshake catch-up covers that window
-            self._grant_allowed_fast(store.num_pieces, enqueue=True)
-        self._maybe_unchoke()
-
-    def _grant_allowed_fast(self, num_pieces: int, enqueue: bool) -> None:
-        """Send the BEP 6 allowed-fast set once (idempotent): pieces
-        this remote may request even while choked — tit-for-tat
-        bootstrapping for peers the choker keeps waiting."""
-        if not self.remote_supports_fast or self._fast_grants:
-            return
-        self._fast_grants = allowed_fast_set(
-            self.addr[0], self._listener.info_hash, num_pieces
-        )
-        for index in sorted(self._fast_grants):
-            payload = struct.pack(">I", index)
-            if enqueue:
-                self._enqueue(_frame(MSG_ALLOWED_FAST, payload))
-            else:
-                self._send(MSG_ALLOWED_FAST, payload)
-
-    def _maybe_unchoke(self) -> None:
-        store, _ = self._listener.snapshot()
-        if store is None or not self.interested:
-            return  # defer: nothing to serve until attach
-        self._listener.request_unchoke(self)
-
-    def grant_unchoke(self) -> None:
-        """Choker decision: this peer holds an upload slot now.
-        Benign race: two callers can both pass the check and enqueue a
-        duplicate UNCHOKE, which the protocol tolerates."""
-        if self._unchoked:
-            return
-        self._unchoked = True
-        self._enqueue(_frame(MSG_UNCHOKE))
-
-    def revoke_unchoke(self) -> None:
-        """Choker decision: slot lost; the remote must stop requesting
-        (requests that race the CHOKE are REJECTed/dropped by
-        _serve_request's _unchoked check)."""
-        if not self._unchoked:
-            return
-        self._unchoked = False
-        self._enqueue(_frame(MSG_CHOKE))
-
-    def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
-        try:
-            self._outq.put_nowait(None)  # wake the sender so it exits
-        except queue.Full:
-            pass  # sender will die on the closed socket instead
-
-    # -- serve loop ------------------------------------------------------
-
-    def run(self) -> None:
-        sender = threading.Thread(
-            target=self._sender_loop,
-            daemon=True,
-            name=f"peer-send-{self.addr[0]}:{self.addr[1]}",
-        )
-        sender.start()
-        try:
-            self._serve()
-        except (OSError, PeerProtocolError, struct.error):
-            pass  # remote gone or misbehaving: reap quietly
-        finally:
-            self.close()
-            self._listener.discard(self)
-
-    def _recv_exact(self, count: int) -> bytes:
-        out = bytearray()
-        if self._prefix:
-            out += self._prefix[:count]
-            del self._prefix[:count]
-        if len(out) < count:
-            data = _recv_into(self._sock, count - len(out))
-            if data is None:
-                raise OSError("remote closed")
-            out += data
-        return bytes(out)
-
-    def _serve(self) -> None:
-        # plaintext vs MSE detection: a plaintext BT handshake begins
-        # with 0x13"BitTorrent protocol"; anything else is an MSE DH
-        # public key (anacrolix's listener does the same detection)
-        head = self._recv_exact(20)
-        if head[0] == len(HANDSHAKE_PSTR) and head[1:20] == HANDSHAKE_PSTR:
-            if self._listener.encryption == "require":
-                return  # policy: obfuscated connections only
-            hs = head + self._recv_exact(48)
-        else:
-            if self._listener.encryption == "off":
-                return
-            try:
-                wrapped, ia = mse.accept(
-                    self._sock,
-                    self._listener.info_hash,
-                    prefix=head,
-                    allow_plaintext=self._listener.encryption != "require",
-                )
-            except mse.MSEError:
-                return  # not MSE either (or wrong torrent): reap
-            self._sock = wrapped
-            self._prefix = bytearray(ia)
-            hs = self._recv_exact(68)
-        if hs[1:20] != HANDSHAKE_PSTR or hs[28:48] != self._listener.info_hash:
-            return
-        self.remote_peer_id = hs[48:68]
-        remote_supports_ext = bool(hs[25] & 0x10)
-        self.remote_supports_fast = bool(hs[27] & 0x04)  # BEP 6
-        reserved = bytearray(8)
-        reserved[5] |= 0x10  # BEP 10
-        reserved[7] |= 0x04  # BEP 6
-        with self._send_lock:
-            self._sock.sendall(
-                bytes([len(HANDSHAKE_PSTR)])
-                + HANDSHAKE_PSTR
-                + bytes(reserved)
-                + self._listener.info_hash
-                + self._listener.peer_id
-            )
-        store, info_bytes = self._listener.snapshot()
-        sent_have: list[bool] = []
-        if store is not None:
-            # availability goes out post-attach, even when empty: an
-            # absent bitfield reads as "seeder" to permissive clients
-            # (including our own claim heuristic). BEP 6 remotes get
-            # the compact HAVE_ALL/HAVE_NONE forms.
-            sent_have = list(store.have)
-            if self.remote_supports_fast and all(sent_have):
-                self._send(MSG_HAVE_ALL)
-            elif self.remote_supports_fast and not any(sent_have):
-                self._send(MSG_HAVE_NONE)
-            else:
-                self._send(MSG_BITFIELD, pack_bitfield(sent_have))
-            self._grant_allowed_fast(store.num_pieces, enqueue=False)
-        elif self.remote_supports_fast:
-            # pre-attach (metadata/resume still running): BEP 6 demands
-            # an availability message first; HAVE_NONE is the truthful
-            # one, and the attach catch-up upgrades it with HAVEs
-            self._send(MSG_HAVE_NONE)
-        if remote_supports_ext:
-            # only to peers that advertised BEP 10 — a vanilla client
-            # would drop us over an unknown message id
-            ext = {b"m": {b"ut_metadata": UT_METADATA, b"ut_pex": UT_PEX}}
-            if info_bytes is not None:
-                ext[b"metadata_size"] = len(info_bytes)
-            self._send(MSG_EXTENDED, bytes([0]) + bencode.encode(ext))
-        # open the async channel, then catch up on anything that
-        # completed (or an attach that landed) while the handshake was
-        # in flight — those broadcasts were suppressed by _ready
-        self._ready.set()
-        store, _ = self._listener.snapshot()
-        if store is not None:
-            for index, done in enumerate(store.have):
-                if done and (index >= len(sent_have) or not sent_have[index]):
-                    self.notify_have(index)
-            # an attach that landed mid-handshake could not grant yet
-            # (arm() skips pre-ready connections); idempotent
-            self._grant_allowed_fast(store.num_pieces, enqueue=True)
-
-        while True:
-            length = struct.unpack(">I", self._recv_exact(4))[0]
-            if length == 0:
-                continue  # keepalive
-            if length > (1 << 20) + 9:
-                raise PeerProtocolError(f"oversized frame: {length}")
-            body = self._recv_exact(length)
-            msg_id, payload = body[0], body[1:]
-            if msg_id == MSG_INTERESTED:
-                self.interested = True
-                self.ever_interested = True
-                self._maybe_unchoke()
-            elif msg_id == MSG_NOT_INTERESTED:
-                self.interested = False
-                # a finished leecher frees its slot; let a waiting one in
-                self._listener.poke_choker()
-            elif msg_id == MSG_REQUEST and len(payload) == 12:
-                self._serve_request(payload)
-            elif msg_id == MSG_EXTENDED and payload:
-                self._serve_extended(payload)
-            # HAVE/BITFIELD from the remote and CANCEL need no action:
-            # leeching happens on outbound connections only, and serving
-            # is synchronous so a CANCEL always arrives too late.
-
-    def _serve_request(self, payload: bytes) -> None:
-        index, begin, length = struct.unpack(">III", payload)
-        if length > MAX_REQUEST_LENGTH:
-            raise PeerProtocolError(f"oversized block request: {length}")
-        block = None
-        # spec: requests while choked are dropped — EXCEPT the BEP 6
-        # allowed-fast grants, which exist to be served while choked
-        if self._unchoked or index in self._fast_grants:
-            store, _ = self._listener.snapshot()
-            block = store.read_block(index, begin, length) if store else None
-        if block is None:
-            # BEP 6 remotes get an explicit REJECT so they re-request
-            # elsewhere now; legacy remotes get the silent drop
-            if self.remote_supports_fast:
-                self._send(MSG_REJECT, payload)
-            return
-        # count before the send: a reader that saw the PIECE frame must
-        # also see it counted (the reverse order races observers)
-        self.bytes_to_peer += len(block)
-        self._listener.count_block(len(block))
-        self._send(MSG_PIECE, struct.pack(">II", index, begin) + block)
-
-    def _serve_extended(self, payload: bytes) -> None:
-        ext_id, body = payload[0], payload[1:]
-        if ext_id == 0:  # remote's extended handshake: learn their ids
-            try:
-                info = bencode.decode(body)
-            except bencode.BencodeError:
-                return
-            if isinstance(info, dict) and isinstance(info.get(b"m"), dict):
-                # one-byte ids only: bytes([v]) on a crafted id > 255
-                # would raise and kill this serving thread
-                self._remote_ext = {
-                    k: v
-                    for k, v in info[b"m"].items()
-                    if isinstance(v, int) and 0 < v < 256
-                }
-            if isinstance(info, dict):
-                # BEP 10 "p": the remote's own listening port — the
-                # only dialable address an inbound (serve-only)
-                # connection yields, and what lets us leech BACK from
-                # a peer that discovered us first (LSD/PEX asymmetry)
-                p = info.get(b"p")
-                if isinstance(p, int) and 0 < p < 65536:
-                    self._listener.peer_heard((self.addr[0], p))
-            self._maybe_send_pex()
-            return
-        if ext_id != UT_METADATA:
-            return
-        _, info_bytes = self._listener.snapshot()
-        remote_id = self._remote_ext.get(b"ut_metadata")
-        if info_bytes is None or not remote_id:
-            return
-        try:
-            request, _ = bencode._decode(body, 0)
-        except bencode.BencodeError:
-            return
-        if not isinstance(request, dict) or request.get(b"msg_type") != 0:
-            return
-        piece = request.get(b"piece")
-        if not isinstance(piece, int) or piece < 0:
-            return
-        start = piece * BLOCK_SIZE
-        chunk = info_bytes[start : start + BLOCK_SIZE]
-        header = bencode.encode(
-            {b"msg_type": 1, b"piece": piece, b"total_size": len(info_bytes)}
-        )
-        self._send(MSG_EXTENDED, bytes([remote_id]) + header + chunk)
-
-    def _maybe_send_pex(self) -> None:
-        """One-shot BEP 11 ut_pex after the extended handshakes: share
-        the peers this job knows about with a leecher that asked to
-        gossip. IPv4 compact only (added6 when the job ever sees v6
-        swarms); flags bytes are zeros."""
-        remote_id = self._remote_ext.get(b"ut_pex")
-        peers = self._listener.known_peers()
-        if not remote_id or not peers:
-            return
-        compact = bytearray()
-        for host, port in peers:
-            try:
-                compact += socket.inet_aton(host) + struct.pack(">H", port)
-            except (OSError, struct.error):
-                continue  # hostname or v6 literal: not compact-v4-able
-        if not compact:
-            return
-        payload = bencode.encode(
-            {b"added": bytes(compact), b"added.f": bytes(len(compact) // 6)}
-        )
-        self._send(MSG_EXTENDED, bytes([remote_id]) + payload)
-
-
-class PeerListener:
-    """The inbound half of the peer: a live TCP listener on the port the
-    trackers are told about.
-
-    The reference's anacrolix client is a full peer — it listens on its
-    announced port, serves REQUESTs, and reciprocates while leeching
-    (torrent.go:44). This class puts a real socket behind the announce:
-    constructed (bound) before the first announce so the advertised port
-    is live from the start, ``attach``-ed once metadata and the
-    PieceStore exist, closed when the job ends — optionally draining so
-    remote leechers mid-transfer can finish (two downloaders completing
-    a torrent from each other must not cut the slower one off when the
-    faster finishes).
-    """
-
-    def __init__(
-        self,
-        info_hash: bytes,
-        peer_id: bytes,
-        host: str = "0.0.0.0",
-        port: int = 0,
-        max_inbound: int = 32,
-        max_unchoked: int = 8,
-        rechoke_interval: float = 10.0,
-        encryption: str = "allow",
-    ):
-        self.info_hash = info_hash
-        self.peer_id = peer_id
-        self._max_inbound = max_inbound
-        # MSE policy (ENCRYPTION_MODES keys): every policy but "off"
-        # auto-detects and accepts obfuscated inbound connections;
-        # "require" additionally rejects plaintext ones
-        self.encryption = encryption
-        # upload-slot choker (see _rechoke): at most this many inbound
-        # leechers are unchoked at once
-        self._max_unchoked = max_unchoked
-        self._rechoke_interval = rechoke_interval
-        self._choker_wake = threading.Event()
-        self._store: PieceStore | None = None
-        self._info_bytes: bytes | None = None
-        self._peer_source = None  # ut_pex gossip source (attach)
-        self._peer_sink = None  # inbound-learned peers flow here (attach)
-        self._pending_heard: list[tuple[str, int]] = []  # pre-attach buffer
-        self._lock = threading.Lock()
-        self._conns: set[_InboundPeer] = set()
-        self._finished_leecher_ids: set[bytes] = set()
-        self._closed = False
-        self.blocks_served = 0
-        self.bytes_served = 0
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        try:
-            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            self._sock.bind((host, port))
-            self._sock.listen(16)
-        except OSError:
-            self._sock.close()
-            raise
-        self.port = self._sock.getsockname()[1]
-        # uTP (BEP 29) rides UDP on the SAME number as the announced
-        # TCP port — that is where remotes will try it. Bind failure
-        # (port race) degrades to TCP-only, quietly.
-        self.utp_mux: "utp.UTPMultiplexer | None" = None
-        try:
-            self.utp_mux = utp.UTPMultiplexer(
-                host=host, port=self.port, on_accept=self._accept_utp
-            )
-        except OSError:
-            pass
-        threading.Thread(
-            target=self._accept_loop,
-            daemon=True,
-            name=f"peer-listen-{self.port}",
-        ).start()
-        threading.Thread(
-            target=self._choker_loop,
-            daemon=True,
-            name=f"peer-choker-{self.port}",
-        ).start()
-
-    def _accept_loop(self) -> None:
-        while True:
-            try:
-                sock, addr = self._sock.accept()
-            except OSError:
-                return  # listener closed
-            self._admit(sock, addr)
-
-    def _accept_utp(self, stream: "utp.UTPSocket") -> None:
-        # uTP streams enter the exact same serving path as TCP ones:
-        # _InboundPeer only needs the socket duck-type, so plaintext
-        # detection, MSE, the choker, and block serving all just work
-        self._admit(stream, stream.addr)
-
-    def _admit(self, sock, addr) -> None:
-        with self._lock:
-            if self._closed or len(self._conns) >= self._max_inbound:
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-                return
-            conn = _InboundPeer(self, sock, addr)
-            self._conns.add(conn)
-        threading.Thread(
-            target=conn.run,
-            daemon=True,
-            name=f"peer-inbound-{addr[0]}:{addr[1]}",
-        ).start()
-
-    # -- choker ----------------------------------------------------------
-    #
-    # Upload slots are rationed the way anacrolix's choking algorithm
-    # does for the reference (torrent.go:44): at most ``max_unchoked``
-    # inbound leechers hold a slot. Regular slots go to the interested
-    # peers served the LEAST so far (max-min fairness — a swarm's tail
-    # catches up instead of starving), and when oversubscribed one slot
-    # is optimistic: rotated randomly each interval so newcomers get
-    # bandwidth and a chance to prove themselves, per the canonical
-    # BitTorrent choking design.
-
-    def request_unchoke(self, conn: _InboundPeer) -> None:
-        """Immediate grant when a slot is free, so small swarms (and the
-        common single-leecher case) never wait out a rechoke interval;
-        oversubscribed arrivals stay choked until rotation. Decision and
-        flag flip are atomic under the lock — two racing INTERESTED
-        arrivals must not both take the last slot."""
-        with self._lock:
-            if self._closed or self._store is None:
-                return
-            holders = sum(1 for c in self._conns if c._unchoked)
-            if holders >= self._max_unchoked:
-                return
-            conn.grant_unchoke()
-
-    def poke_choker(self) -> None:
-        """Wake the choker now (slot freed: NOT_INTERESTED/disconnect)."""
-        self._choker_wake.set()
-
-    def _choker_loop(self) -> None:
-        while True:
-            self._choker_wake.wait(timeout=self._rechoke_interval)
-            self._choker_wake.clear()
-            with self._lock:
-                if self._closed:
-                    return
-            self._rechoke()
-
-    def _rechoke(self) -> None:
-        # the whole redistribution runs under the lock so the slot count
-        # can never transiently exceed the cap against request_unchoke
-        with self._lock:
-            if self._store is None:
-                return
-            conns = list(self._conns)
-            if self._max_unchoked <= 0:
-                # uploading disabled: the slicing below would invert the
-                # cap (ranked[:-1] + choice = everyone wins)
-                for conn in conns:
-                    if conn._unchoked:
-                        conn.revoke_unchoke()
-                return
-            candidates = [c for c in conns if c.interested]
-            if len(candidates) <= self._max_unchoked:
-                winners = set(candidates)
-            else:
-                ranked = sorted(candidates, key=lambda c: c.bytes_to_peer)
-                winners = set(ranked[: self._max_unchoked - 1])
-                # the optimistic slot: uniform over the rest
-                winners.add(random.choice(ranked[self._max_unchoked - 1 :]))
-            for conn in conns:
-                if conn in winners:
-                    conn.grant_unchoke()
-                elif conn._unchoked:
-                    # lost the slot (or went NOT_INTERESTED while unchoked)
-                    conn.revoke_unchoke()
-
-    # -- serving state ---------------------------------------------------
-
-    def snapshot(self) -> tuple["PieceStore | None", bytes | None]:
-        with self._lock:
-            return self._store, self._info_bytes
-
-    def known_peers(self) -> list[tuple[str, int]]:
-        """Peers to gossip via ut_pex; empty until attach provides a
-        source (and on any source failure — gossip is best-effort)."""
-        source = self._peer_source
-        if source is None:
-            return []
-        try:
-            return list(source())[:50]
-        except Exception:  # pragma: no cover - defensive
-            return []
-
-    def attach(
-        self,
-        store: PieceStore,
-        info_bytes: bytes | None,
-        peer_source=None,
-        peer_sink=None,
-    ) -> None:
-        """Arm serving once metadata + store exist. Connections accepted
-        during the metadata/resume phase are caught up (HAVE frames +
-        deferred UNCHOKE); the store observer keeps every connection
-        fed with HAVE as new pieces complete. ``peer_source`` feeds
-        outgoing ut_pex gossip; ``peer_sink(peer)`` receives dialable
-        addresses learned FROM inbound connections (BEP 10 "p")."""
-        store.add_observer(self.notify_have)
-        with self._lock:
-            self._store = store
-            self._info_bytes = info_bytes
-            self._peer_source = peer_source
-            self._peer_sink = peer_sink
-            heard, self._pending_heard = self._pending_heard, []
-            conns = list(self._conns)
-        if peer_sink is not None:
-            for peer in heard:  # replay addresses heard before attach
-                try:
-                    peer_sink(peer)
-                except Exception:  # pragma: no cover - sink owns errors
-                    pass
-        have = [i for i, done in enumerate(store.have) if done]
-        for conn in conns:
-            conn.arm(have)
-
-    def peer_heard(self, peer: tuple[str, int]) -> None:
-        """A dialable address learned from an inbound connection's
-        extended handshake; best-effort hand-off to the swarm. Heard
-        before attach() (metadata/resume still running) it is buffered
-        — the handshake is sent once per connection, so dropping it
-        would lose that peer's only dialable address."""
-        with self._lock:
-            sink = self._peer_sink
-            if sink is None:
-                if len(self._pending_heard) < 64:
-                    self._pending_heard.append(peer)
-                return
-        try:
-            sink(peer)
-        except Exception:  # pragma: no cover - sink owns its errors
-            pass
-
-    def notify_have(self, index: int) -> None:
-        with self._lock:
-            conns = list(self._conns)
-        for conn in conns:
-            conn.notify_have(index)
-
-    def count_block(self, size: int) -> None:
-        with self._lock:
-            self.blocks_served += 1
-            self.bytes_served += size
-
-    def discard(self, conn: _InboundPeer) -> None:
-        with self._lock:
-            self._conns.discard(conn)
-            if conn.ever_interested:
-                # a leecher that connected, leeched, and went away has
-                # had its chance — the drain in close() keys off this
-                # (sticky flag: a compliant client sends NOT_INTERESTED
-                # once complete, which must still count as served).
-                # Keyed by peer_id, not ip: several leechers can sit
-                # behind one NAT/host and must be counted separately.
-                self._finished_leecher_ids.add(conn.remote_peer_id)
-        # a departing peer may have held an upload slot
-        self.poke_choker()
-
-    def active_leechers(self) -> int:
-        with self._lock:
-            return sum(1 for conn in self._conns if conn.interested)
-
-    # -- lifecycle -------------------------------------------------------
-
-    def close(
-        self,
-        drain_timeout: float = 0.0,
-        expected_leechers: "set[bytes] | frozenset[bytes]" = frozenset(),
-    ) -> None:
-        """Tear down; with ``drain_timeout`` > 0, keep accepting and
-        serving that long until every currently-interested remote AND
-        every ``expected_leechers`` peer_id (peers this job observed
-        with incomplete bitfields — they will want our pieces) has
-        connected, leeched, and disconnected. This is what lets two
-        downloaders complete a torrent from each other: the faster one
-        must not slam its listener shut before the slower one has
-        caught up."""
-        if drain_timeout > 0:
-            deadline = time.monotonic() + drain_timeout
-            while time.monotonic() < deadline:
-                with self._lock:
-                    unserved = set(expected_leechers) - self._finished_leecher_ids
-                if not unserved and not self.active_leechers():
-                    break
-                time.sleep(0.05)
-        with self._lock:
-            if self._closed and self._sock.fileno() < 0:
-                return  # idempotent
-            self._closed = True
-        self._choker_wake.set()  # let the choker thread observe _closed
-        try:
-            self._sock.close()
-        except OSError:
-            pass
-        if self.utp_mux is not None:
-            self.utp_mux.close()
-        with self._lock:
-            conns = list(self._conns)
-        for conn in conns:
-            conn.close()
 
 
 # ---------------------------------------------------------------------------
 # swarm download
+
+
 
 
 class SwarmDownloader:
@@ -3198,279 +1130,3 @@ class SwarmDownloader:
                     swarm.last_error = exc
                     log.warning(f"flush while unwinding failed: {exc}")
             swarm.tick_progress()
-
-
-class _PieceBatch:
-    """Downloaded-but-unverified pieces from ONE peer, verified through
-    the digest engine in batches.
-
-    The round-1 hot path hashed every arriving piece with per-piece
-    hashlib, so the batched engine only ever ran for resume; routing the
-    live path through :meth:`DigestEngine.verify_pieces` lets the
-    engine's measured offload policy apply to swarm traffic too, and
-    still collapses to per-piece hashlib for trickle flushes (engine
-    min_batch). Batching per worker keeps bad-peer attribution: every
-    piece in a batch came from this worker's current peer, so a failed
-    verdict indicts that peer exactly as per-piece hashing did.
-
-    Flush points: ``max_bytes`` reached, the worker idling (WAIT), or
-    worker exit. A crash loses at most ``max_bytes`` of unwritten
-    download per worker — the resume scan re-fetches those pieces.
-    """
-
-    def __init__(
-        self,
-        swarm: "_SwarmState",
-        engine: DigestEngine | None = None,
-        max_bytes: int = 8 * 1024 * 1024,
-        owner=None,
-    ):
-        self._swarm = swarm
-        self._engine = engine or default_engine()
-        self._max_bytes = max_bytes
-        # the conn whose claims these pieces ride on (release scoping)
-        self._owner = owner
-        self._items: list[tuple[int, bytes]] = []
-        self._bytes = 0
-
-    def add(self, index: int, data: bytes) -> None:
-        self._items.append((index, data))
-        self._bytes += len(data)
-        if self._bytes >= self._max_bytes:
-            self.flush()
-
-    def flush(self) -> None:
-        """Verify and write everything pending. Raises
-        PeerProtocolError naming the failed pieces (claims released so
-        other workers re-fetch them); verified pieces are always written
-        first, so one bad piece cannot discard its good batch-mates."""
-        if not self._items:
-            return
-        items, self._items, self._bytes = self._items, [], 0
-        store = self._swarm.store
-        verdicts = self._engine.verify_pieces(
-            [data for _, data in items],
-            [store.piece_hashes[index] for index, _ in items],
-        )
-        bad: list[int] = []
-        for (index, data), good in zip(items, verdicts):
-            if good:
-                if not store.have[index]:  # endgame: a duplicate may have won
-                    store.write_verified(index, data)
-            else:
-                self._swarm.release(index, self._owner)
-                bad.append(index)
-        if bad:
-            raise PeerProtocolError(
-                f"pieces {bad} failed SHA-1 verification"
-            )
-
-
-class _SwarmState:
-    """Shared state for the concurrent peer workers: the peer queue, the
-    claimed-piece set, and throttled progress reporting."""
-
-    WAIT = object()  # claim(): all missing pieces are claimed elsewhere
-
-    def __init__(self, store: PieceStore, progress, progress_interval: float):
-        self.store = store
-        self.peer_queue: list[tuple[str, int]] = []
-        # a short error history, not a single slot: an unwinding batch
-        # flush records its verification failure moments before the
-        # worker records the error that triggered the unwind, and the
-        # job's failure message must keep both diagnostics
-        self._errors: "collections.deque[Exception]" = collections.deque(maxlen=3)
-        # piece -> the conn that holds the original (exclusive) claim.
-        # Conn OBJECTS, not id(conn): holding the reference pins the
-        # object so a recycled id can never alias a dead connection's
-        # bookkeeping, and release() can tell an owner from a stranger.
-        self._claimed: dict[int, object] = {}
-        # endgame bookkeeping: piece -> conns already duplicating it, so
-        # one idle worker doesn't re-download the same in-flight piece
-        # in a tight loop
-        self._dup_claims: dict[int, set] = {}
-        self.endgame = False  # sticky; flips when the first dup is handed out
-        # connected peers' bitfields drive rarest-first availability
-        self._conns: set = set()
-        # every peer address ever enqueued (dedupes PEX gossip and
-        # feeds the listener's own outgoing PEX messages)
-        self.seen_peers: set[tuple[str, int]] = set()
-        self._rng = random.Random()
-        self._lock = threading.Lock()
-        self._progress = progress
-        self._progress_interval = progress_interval
-        self._last_tick = time.monotonic()
-        # scan cursor: everything below it is permanently complete, so
-        # claims stay O(total) over the torrent instead of O(n^2)
-        self._scan_start = 0
-
-    def register(self, conn) -> None:
-        """Track a live connection; its (HAVE-updated) bitfield feeds
-        rarest-first availability ranking."""
-        with self._lock:
-            self._conns.add(conn)
-
-    def unregister(self, conn) -> None:
-        with self._lock:
-            self._conns.discard(conn)
-
-    def broadcast_have(self, index: int) -> None:
-        """Store observer: queue a HAVE for every live outbound
-        connection (each conn's owner thread flushes — queue only, so
-        a stalled remote can never block the completing worker)."""
-        with self._lock:
-            conns = list(self._conns)
-        for conn in conns:
-            conn.queue_have(index)
-
-    def done(self) -> bool:
-        return all(self.store.have)
-
-    @property
-    def last_error(self) -> Exception | None:
-        return self._errors[-1] if self._errors else None
-
-    @last_error.setter
-    def last_error(self, exc: Exception) -> None:
-        self._errors.append(exc)
-
-    def error_summary(self) -> str:
-        if not self._errors:
-            return "None"
-        return "; ".join(str(exc) for exc in self._errors)
-
-    def next_peer(self) -> tuple[str, int] | None:
-        with self._lock:
-            return self.peer_queue.pop(0) if self.peer_queue else None
-
-    def add_peers(self, peers) -> None:
-        """Fold gossiped (PEX) peers into the queue, each at most once
-        for the life of the job — tracker/DHT rediscovery handles
-        deliberate retries; gossip must not re-queue dead peers
-        forever."""
-        with self._lock:
-            for peer in peers:
-                if peer not in self.seen_peers:
-                    self.seen_peers.add(peer)
-                    self.peer_queue.append(peer)
-
-    def known_peers(self) -> list[tuple[str, int]]:
-        """Snapshot of every peer this job has seen (the listener's
-        outgoing PEX payload)."""
-        with self._lock:
-            return list(self.seen_peers)
-
-    def enqueue_discovered(self, peers) -> None:
-        """Tracker/DHT (re)discovery: (re)queue anything not already
-        queued — deliberate retries are the point — and register in
-        seen_peers under the lock (listener threads snapshot that set
-        concurrently for PEX gossip)."""
-        with self._lock:
-            for peer in peers:
-                self.seen_peers.add(peer)
-                if peer not in self.peer_queue:
-                    self.peer_queue.append(peer)
-
-    def claim(self, conn: PeerConnection, only=None):
-        """The RAREST unclaimed missing piece this peer advertises
-        (availability ranked across registered connections' live
-        bitfields, ties broken randomly — anacrolix's selection order
-        behind DownloadAll, reference torrent.go:79; lowest-index
-        serialises real swarms on hot pieces).
-
-        Endgame: when every missing piece is already claimed, hand out
-        a DUPLICATE claim for an in-flight piece this peer has (each
-        conn at most once per piece) — first verified write wins and
-        the losers abandon via the store.have check in the download
-        loop. This is what keeps the tail from stalling behind one slow
-        peer. Returns WAIT when the peer could help later but not now;
-        None when the torrent is done or this peer has nothing useful.
-
-        With ``only`` (a set of indices), claims are restricted to it —
-        the BEP 6 allowed-fast case, where a still-choked peer may be
-        asked for exactly those pieces.
-
-        O(pieces × conns) per claim; fine for the handful of
-        connections a job runs (reference effective concurrency is 1)."""
-        store = self.store
-        with self._lock:
-            while self._scan_start < store.num_pieces and store.have[
-                self._scan_start
-            ]:
-                self._scan_start += 1
-            if self._scan_start >= store.num_pieces:
-                return None  # torrent complete
-            candidates: list[int] = []
-            in_flight: list[int] = []  # claimed by ANOTHER conn, missing, peer has
-            for index in range(self._scan_start, store.num_pieces):
-                if store.have[index]:
-                    self._dup_claims.pop(index, None)
-                    continue
-                if only is not None and index not in only:
-                    continue
-                peer_has = not conn.bitfield or conn.has_piece(index)
-                if index in self._claimed:
-                    # never duplicate a piece this conn itself claimed:
-                    # its unflushed batch may already hold the bytes
-                    if peer_has and self._claimed[index] is not conn:
-                        in_flight.append(index)
-                    continue
-                if peer_has:
-                    candidates.append(index)
-
-            def pick_rarest(indices: list[int]) -> int:
-                avail = {
-                    i: sum(
-                        1
-                        for c in self._conns
-                        if not c.bitfield or c.has_piece(i)
-                    )
-                    for i in indices
-                }
-                best = min(avail.values())
-                return self._rng.choice(
-                    [i for i in indices if avail[i] == best]
-                )
-
-            if candidates:
-                index = pick_rarest(candidates)
-                self._claimed[index] = conn
-                return index
-            # endgame: nothing unclaimed, but this peer could race an
-            # in-flight piece it hasn't already duplicated
-            fresh = [
-                i
-                for i in in_flight
-                if conn not in self._dup_claims.get(i, ())
-            ]
-            if fresh:
-                index = pick_rarest(fresh)
-                self._dup_claims.setdefault(index, set()).add(conn)
-                self.endgame = True
-                return index
-            return self.WAIT if in_flight else None
-
-    def release(self, index: int, owner=None) -> None:
-        """Give a claim back. With ``owner`` (the conn the claim was
-        handed to), only that conn's stake is released: a failed endgame
-        DUPLICATE clears its dup record — letting another conn race the
-        piece — without yanking the original downloader's still-active
-        claim out from under it. ``owner=None`` (direct callers, tests)
-        releases the original claim unconditionally."""
-        with self._lock:
-            if owner is not None:
-                dups = self._dup_claims.get(index)
-                if dups is not None:
-                    dups.discard(owner)
-                if self._claimed.get(index) is not owner:
-                    return  # we only held (at most) a duplicate
-            self._claimed.pop(index, None)
-
-    def tick_progress(self) -> None:
-        store = self.store
-        with self._lock:
-            now = time.monotonic()
-            if now - self._last_tick < self._progress_interval:
-                return
-            self._last_tick = now
-        self._progress(store.bytes_completed() / store.total_length * 100)

@@ -76,15 +76,18 @@ def _compile_source(src_path: str, final: str) -> str | None:
 
 
 def _compile() -> str | None:
-    """Normal (on-disk) install: build _rc4.c next to itself, falling
-    back to the per-user cache when the package dir is read-only."""
-    if not os.path.exists(_C_PATH):
+    """Normal (on-disk) install: build _rc4.c next to itself, or into
+    the per-user cache when the package dir is read-only. One compile
+    attempt either way — a failed compile would fail identically on a
+    retry, and probing the cache dir on compiler-less hosts would
+    create an empty directory for nothing."""
+    if not os.path.exists(_C_PATH) or _find_compiler() is None:
         return None
-    for final in (_SO_PATH, os.path.join(_cache_dir(), "_rc4-local.so")):
-        path = _compile_source(_C_PATH, final)
-        if path is not None:
-            return path
-    return None
+    if os.access(os.path.dirname(_SO_PATH), os.W_OK):
+        return _compile_source(_C_PATH, _SO_PATH)
+    return _compile_source(
+        _C_PATH, os.path.join(_cache_dir(), "_rc4-local.so")
+    )
 
 
 def _cache_dir() -> str:
