@@ -35,7 +35,7 @@ from ..queue import QueueClient
 from ..queue.delivery import Delivery
 from ..scan import scan_dir
 from ..store import Uploader, UploadError
-from ..utils import configure_from_env, get_logger
+from ..utils import metrics, configure_from_env, get_logger
 from ..utils.cancel import Cancelled, CancelToken
 from ..wire import Convert, Download, WireError
 from .config import Config
@@ -81,6 +81,7 @@ class Daemon:
     # -- job pipeline ----------------------------------------------------
 
     def process_delivery(self, delivery: Delivery) -> None:
+        started = time.monotonic()
         try:
             job = Download.unmarshal(delivery.body)
         except WireError as exc:
@@ -160,6 +161,13 @@ class Daemon:
         job_log.info("finished processing")
         delivery.ack()
         self.stats.bump(processed=1)
+        # completed-job latency histogram (consume -> ack, including
+        # the confirm-gated Convert hand-off); failed/retried attempts
+        # are deliberately not mixed in — they would bimodalize the
+        # distribution an operator alerts on
+        metrics.GLOBAL.observe(
+            "job_duration_seconds", time.monotonic() - started
+        )
 
     # -- worker loop -----------------------------------------------------
 

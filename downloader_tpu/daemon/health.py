@@ -111,5 +111,39 @@ class HealthServer:
         metric = "downloader_broker_connected"
         lines.append(f"# TYPE {metric} gauge")
         lines.append(f"{metric} {1 if self._connected() else 0}")
+        # live levels (active swarms / peer connections) — the level
+        # series exist from the first scrape (value 0), not from the
+        # first torrent job: dashboards and absent()-style alerts need
+        # the series present before traffic
+        gauges = {
+            "torrent_active_swarms": 0.0,
+            "torrent_active_peers": 0.0,
+            **metrics.GLOBAL.gauges(),
+        }
+        for name, value in sorted(gauges.items()):
+            metric = f"downloader_{name}"
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {value:g}")
+        # fixed-bucket histograms (job latency), Prometheus exposition:
+        # cumulative le-buckets + _sum + _count. Seeded like the gauges:
+        # the series must exist from the first scrape — an idle (or
+        # only-failing) daemon must read as zero completions, not as
+        # "no data"
+        histograms = {
+            "job_duration_seconds": (
+                [0] * len(metrics.LATENCY_BUCKETS), 0.0, 0,
+            ),
+            **metrics.GLOBAL.histograms(),
+        }
+        for name, (counts, total, count) in sorted(histograms.items()):
+            metric = f"downloader_{name}"
+            lines.append(f"# TYPE {metric} histogram")
+            for le, bucket_count in zip(metrics.LATENCY_BUCKETS, counts):
+                lines.append(
+                    f'{metric}_bucket{{le="{le:g}"}} {bucket_count}'
+                )
+            lines.append(f'{metric}_bucket{{le="+Inf"}} {count}')
+            lines.append(f"{metric}_sum {total:.6f}")
+            lines.append(f"{metric}_count {count}")
         body = ("\n".join(lines) + "\n").encode()
         return 200, body, "text/plain; version=0.0.4"

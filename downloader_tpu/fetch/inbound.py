@@ -234,11 +234,13 @@ class _InboundPeer:
             name=f"peer-send-{self.addr[0]}:{self.addr[1]}",
         )
         sender.start()
+        metrics.GLOBAL.gauge_add("torrent_active_peers", 1)
         try:
             self._serve()
         except (OSError, PeerProtocolError, struct.error):
             pass  # remote gone or misbehaving: reap quietly
         finally:
+            metrics.GLOBAL.gauge_add("torrent_active_peers", -1)
             self.close()
             self._listener.discard(self)
 

@@ -380,6 +380,13 @@ class SwarmDownloader:
         return peers
 
     def run(self, token: CancelToken, progress) -> None:
+        metrics.GLOBAL.gauge_add("torrent_active_swarms", 1)
+        try:
+            self._run_guarded(token, progress)
+        finally:
+            metrics.GLOBAL.gauge_add("torrent_active_swarms", -1)
+
+    def _run_guarded(self, token: CancelToken, progress) -> None:
         listener: PeerListener | None = None
         if self._listen:
             try:

@@ -17,7 +17,7 @@ import socket
 import struct
 import time
 
-from ..utils import get_logger
+from ..utils import get_logger, metrics
 from ..utils.netio import SocketWaiter
 from . import bencode, mse, utp
 from .http import TransferError
@@ -274,6 +274,8 @@ class PeerConnection:
                             self._sock, self.info_hash, crypto_provide=provide
                         )
                     self._handshake(peer_id)
+                    self._gauge_counted = True
+                    metrics.GLOBAL.gauge_add("torrent_active_peers", 1)
                     return
                 except PeerIdentityError:
                     # the remote proved its identity wrong for this job
@@ -600,6 +602,12 @@ class PeerConnection:
             self.read_message()
 
     def close(self) -> None:
+        # gauge decrement exactly once: close is called from the cancel
+        # hook AND __exit__, possibly concurrently, so the test-and-
+        # clear must be one atomic operation — dict.pop is a single C
+        # call under the GIL, where a read-then-assign pair is not
+        if self.__dict__.pop("_gauge_counted", None):
+            metrics.GLOBAL.gauge_add("torrent_active_peers", -1)
         waiter, self._poll_waiter = self._poll_waiter, None
         if waiter is not None:
             waiter.close()

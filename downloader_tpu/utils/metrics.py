@@ -7,8 +7,11 @@ registry the health endpoint folds into ``/metrics``. The reference
 has no metrics at all (SURVEY.md §5); this is part of the rebuild's
 observability additions (SURVEY.md §7 step 9).
 
-Counters only (monotonic); callers pick snake_case names that read as
-Prometheus metrics once prefixed, e.g. ``torrent_bytes_served`` →
+Three shapes, all folded into ``/metrics`` by the health endpoint:
+counters (monotonic ``add``), gauges (``gauge_add``/``gauge_set`` —
+live levels like active swarms/peers), and fixed-bucket histograms
+(``observe`` — job latency). Callers pick snake_case names that read
+as Prometheus metrics once prefixed, e.g. ``torrent_bytes_served`` →
 ``downloader_torrent_bytes_served``.
 """
 
@@ -17,24 +20,66 @@ from __future__ import annotations
 import threading
 from collections import defaultdict
 
+# histogram buckets (seconds) for job-scale latencies: sub-second jobs
+# land in the fine buckets, torrent jobs in the coarse tail
+LATENCY_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0,
+                   120.0, 300.0, 600.0)
+
 
 class Counters:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._values: "defaultdict[str, int]" = defaultdict(int)
+        self._gauges: "defaultdict[str, float]" = defaultdict(float)
+        # name -> (bucket counts parallel to LATENCY_BUCKETS, sum, count)
+        self._hists: dict[str, tuple[list[int], float, int]] = {}
 
     def add(self, name: str, value: int = 1) -> None:
         with self._lock:
             self._values[name] += value
 
+    def gauge_add(self, name: str, delta: float) -> None:
+        """Move a live level up or down (e.g. a swarm starting/ending)."""
+        with self._lock:
+            self._gauges[name] += delta
+
+    def gauge_set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        """Record one sample into the fixed-bucket histogram ``name``
+        (cumulative le-buckets, Prometheus semantics)."""
+        with self._lock:
+            counts, total, count = self._hists.get(
+                name, ([0] * len(LATENCY_BUCKETS), 0.0, 0)
+            )
+            for i, le in enumerate(LATENCY_BUCKETS):
+                if value <= le:
+                    counts[i] += 1
+            self._hists[name] = (counts, total + value, count + 1)
+
     def snapshot(self) -> dict[str, int]:
         with self._lock:
             return dict(self._values)
+
+    def gauges(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._gauges)
+
+    def histograms(self) -> dict[str, tuple[list[int], float, int]]:
+        with self._lock:
+            return {
+                name: (list(counts), total, count)
+                for name, (counts, total, count) in self._hists.items()
+            }
 
     def reset(self) -> None:
         """Test isolation only; production counters are monotonic."""
         with self._lock:
             self._values.clear()
+            self._gauges.clear()
+            self._hists.clear()
 
 
 GLOBAL = Counters()
