@@ -751,6 +751,14 @@ class PeerListener:
             self._closed = True
         self._choker_wake.set()  # let the choker thread observe _closed
         try:
+            # shutdown BEFORE close: close() alone only drops the fd
+            # and leaves the accept thread blocked in accept() forever
+            # (one leaked thread per job); shutdown wakes it with an
+            # error and the loop exits
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass

@@ -216,3 +216,83 @@ def test_daemon_soak_fd_thread_rss_flat(tmp_path):
     assert end_rss <= baseline_rss + 25_000, (
         f"rss growth: {baseline_rss} KB -> {end_rss} KB"
     )
+
+
+@pytest.mark.slow
+def test_torrent_job_soak_no_socket_or_thread_leaks(tmp_path):
+    """The torrent stack is the process's heaviest socket/thread user
+    (listener + uTP mux + DHT + per-peer threads per job). Run a
+    string of jobs through ONE backend with a shared process-lifetime
+    DHT node — half completing, half losing their seeder mid-swarm and
+    failing — and assert fd/thread flatness afterward: failed jobs
+    must release everything too."""
+    from downloader_tpu.fetch import TransferError
+    from downloader_tpu.fetch.dht import DHTNode
+    from downloader_tpu.fetch.seeder import Seeder
+    from downloader_tpu.fetch.torrent import TorrentBackend
+
+    hub = DHTNode()
+    backend = TorrentBackend(
+        progress_interval=0.05,
+        dht_bootstrap=(("127.0.0.1", hub.port),),
+        shared_dht=True,
+    )
+    payload = os.urandom(256 * 1024)
+
+    def run_job(n: int, kill_mid_job: bool) -> bool:
+        job_dir = tmp_path / f"job-{n}"
+        job_dir.mkdir()
+        # kill jobs use the seeder's die-mid-download fixture: the
+        # serve counter is GLOBAL, so after 6 blocks every connection
+        # (including reconnects from retry rounds) drops immediately —
+        # a deterministic mid-swarm peer death
+        seeder = Seeder(
+            f"media-{n}.mkv",
+            payload,
+            serve_limit=6 if kill_mid_job else None,
+        ).__enter__()
+        try:
+            backend.download(
+                CancelToken(),
+                str(job_dir),
+                lambda url, pct: None,
+                seeder.magnet_uri,
+            )
+            completed = True
+        except TransferError:
+            completed = False
+        finally:
+            seeder.__exit__(None, None, None)
+        if kill_mid_job:
+            assert seeder.served_requests, "kill job never transferred"
+        return completed
+
+    # warmup: first job pays lazy imports/engine calibration
+    assert run_job(0, kill_mid_job=False)
+    baseline_fds = _fd_count()
+    baseline_threads = threading.active_count()
+
+    completed = failed = 0
+    try:
+        for n in range(1, 9):
+            if run_job(n, kill_mid_job=(n % 2 == 0)):  # 4 of each
+                completed += 1
+            else:
+                failed += 1
+    finally:
+        backend.close()
+        hub.close()
+
+    assert completed >= 4, f"only {completed} jobs completed"
+    assert failed >= 1, "no job exercised the seeder-death path"
+    # flatness: per-job listeners/muxes/DHT clients/peer threads all
+    # released, for failed jobs exactly like completed ones
+    assert wait_for(
+        lambda: _fd_count() <= baseline_fds + 8, timeout=15
+    ), f"fd leak: {baseline_fds} -> {_fd_count()}"
+    assert wait_for(
+        lambda: threading.active_count() <= baseline_threads + 4, timeout=15
+    ), (
+        f"thread leak: {baseline_threads} -> {threading.active_count()}: "
+        f"{sorted(thread.name for thread in threading.enumerate())}"
+    )
