@@ -54,13 +54,19 @@ def measure(
     try:
         import jax
 
-        from downloader_tpu.parallel.engine import DigestEngine
+        from downloader_tpu.parallel.engine import (
+            DigestEngine,
+            _devices_with_timeout,
+        )
         from downloader_tpu.parallel.pack import (
             digests_from_tiled,
             pack_pieces_tiled,
         )
 
-        device = jax.devices()[0]
+        # watchdog-guarded: a wedged device runtime (dead TPU tunnel)
+        # hangs a bare jax.devices() forever; the bench must degrade to
+        # a reported failure, not stall the whole driver run
+        device = _devices_with_timeout()[0]
         engine = DigestEngine()
         hashlib_bps, transfer_bps, sync_s = engine._calibrate()
         result["transfer_MBps"] = round(transfer_bps / 1e6, 1)

@@ -757,7 +757,15 @@ class PeerListener:
             # error and the loop exits
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:
-            pass
+            # BSD/macOS: shutdown on a LISTENING socket is ENOTCONN —
+            # wake the accept with a loopback self-connect instead
+            # (_admit sees _closed and drops the poke connection)
+            try:
+                socket.create_connection(
+                    ("127.0.0.1", self.port), timeout=1.0
+                ).close()
+            except OSError:
+                pass
         try:
             self._sock.close()
         except OSError:

@@ -53,6 +53,68 @@ log = get_logger("parallel")
 _DEFAULT_MIN_BATCH = 8
 _CALIBRATE_BYTES = 4 * 1024 * 1024
 
+# accelerator-init watchdog: jax backend initialisation (the first
+# jax.devices() call) blocks INDEFINITELY when the device runtime is
+# wedged — observed with a dead TPU tunnel — and a media job must fall
+# back to hashlib, not hang. The probe runs once per process in a
+# daemon thread; a timeout latches "unavailable" for the process (the
+# abandoned thread finishing later is harmless).
+_probe_lock = threading.Lock()
+_probe_state: "tuple[str, object] | None" = None  # ("ok", devices)|("err", exc)
+
+
+def _devices_with_timeout():
+    global _probe_state
+    with _probe_lock:
+        if _probe_state is None:
+            timeout = float(os.environ.get("DIGEST_INIT_TIMEOUT", "30"))
+            result: list = []
+            error: list = []
+
+            def probe() -> None:
+                try:
+                    import jax
+
+                    result.append(jax.devices())
+                except Exception as exc:  # pragma: no cover - env-dep
+                    error.append(exc)
+
+            thread = threading.Thread(
+                target=probe, daemon=True, name="digest-device-probe"
+            )
+            thread.start()
+            thread.join(timeout)
+            if result:
+                _probe_state = ("ok", result[0])
+            elif error:
+                _probe_state = ("err", (type(error[0]), error[0].args))
+            else:
+                _probe_state = (
+                    "err",
+                    (
+                        TimeoutError,
+                        (
+                            f"accelerator backend init exceeded {timeout:g}s "
+                            "(wedged device runtime?)",
+                        ),
+                    ),
+                )
+    kind, value = _probe_state
+    if kind == "err":
+        # a FRESH instance per raise: re-raising one latched object
+        # would grow (and race on) its __traceback__ forever in a
+        # long-lived daemon that probes once per job
+        exc_type, exc_args = value  # type: ignore[misc]
+        raise exc_type(*exc_args)
+    return value
+
+
+def _reset_device_probe() -> None:
+    """Test isolation only."""
+    global _probe_state
+    with _probe_lock:
+        _probe_state = None
+
 
 def _timed(fn) -> float:
     start = time.monotonic()
@@ -131,7 +193,7 @@ class DigestEngine:
                 from . import mesh as mesh_mod
                 from .sha1 import sha1_blocks_jit
 
-                devices = self._devices or jax.devices()
+                devices = self._devices or _devices_with_timeout()
                 if len(devices) > 1:
                     device_mesh = mesh_mod.default_mesh(devices)
                     verify_fn = mesh_mod.sharded_verify_fn(device_mesh)
@@ -171,7 +233,7 @@ class DigestEngine:
             try:
                 import jax
 
-                devices = self._devices or jax.devices()
+                devices = self._devices or _devices_with_timeout()
                 if len(devices) != 1 or devices[0].platform != "tpu":
                     raise RuntimeError(
                         "pallas digest path needs exactly one TPU device"
@@ -241,7 +303,7 @@ class DigestEngine:
         try:
             import jax
 
-            device = (self._devices or jax.devices())[0]
+            device = (self._devices or _devices_with_timeout())[0]
             tiny = np.zeros(64, dtype=np.uint32)
             np.asarray(jax.device_put(tiny, device))  # warm the runtime
             sync_s = min(
@@ -271,7 +333,7 @@ class DigestEngine:
             try:
                 import jax
 
-                devices = self._devices or jax.devices()
+                devices = self._devices or _devices_with_timeout()
                 self._tiled_possible = (
                     len(devices) == 1 and devices[0].platform == "tpu"
                 )

@@ -9,6 +9,8 @@ block pieces, ragged batches). The sharded path runs on the virtual
 
 import hashlib
 import os
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -369,3 +371,61 @@ class TestReviewRegressions:
         from downloader_tpu.parallel.sha1 import sha1_blocks_jit
 
         assert digest_fn is not sha1_blocks_jit
+
+
+class TestDeviceProbeWatchdog:
+    """A wedged accelerator runtime (observed: a dead TPU tunnel) hangs
+    jax backend init indefinitely; the engine must fall back to hashlib
+    within DIGEST_INIT_TIMEOUT instead of hanging media jobs."""
+
+    def test_hung_backend_init_falls_back_to_hashlib(self, monkeypatch):
+        import jax
+
+        from downloader_tpu.parallel import engine as engine_mod
+
+        release = threading.Event()
+
+        def hang():
+            release.wait()  # never set until teardown
+            return []
+
+        engine_mod._reset_device_probe()
+        monkeypatch.setattr(jax, "devices", hang)
+        monkeypatch.setenv("DIGEST_INIT_TIMEOUT", "0.2")
+        try:
+            engine = engine_mod.DigestEngine(backend="auto")
+            pieces = [bytes([i]) * 2048 for i in range(32)]
+            start = time.monotonic()
+            digests = engine.sha1_many(pieces)
+            elapsed = time.monotonic() - start
+            assert digests == [hashlib.sha1(p).digest() for p in pieces]
+            assert elapsed < 5, f"engine hung {elapsed:.1f}s on wedged init"
+            # forced device backend fails loud instead of hanging
+            forced = engine_mod.DigestEngine(backend="jax")
+            with pytest.raises(Exception):
+                forced.sha1_many(pieces)
+        finally:
+            release.set()
+            engine_mod._reset_device_probe()
+
+    def test_probe_latches_per_process(self, monkeypatch):
+        """One timed-out probe must not cost every later engine another
+        DIGEST_INIT_TIMEOUT wait."""
+        import jax
+
+        from downloader_tpu.parallel import engine as engine_mod
+
+        release = threading.Event()
+        engine_mod._reset_device_probe()
+        monkeypatch.setattr(jax, "devices", lambda: (release.wait(), [])[1])
+        monkeypatch.setenv("DIGEST_INIT_TIMEOUT", "0.2")
+        try:
+            with pytest.raises(Exception):
+                engine_mod._devices_with_timeout()
+            start = time.monotonic()
+            with pytest.raises(Exception):
+                engine_mod._devices_with_timeout()
+            assert time.monotonic() - start < 0.1  # latched, no re-wait
+        finally:
+            release.set()
+            engine_mod._reset_device_probe()
