@@ -758,11 +758,18 @@ class PeerListener:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:
             # BSD/macOS: shutdown on a LISTENING socket is ENOTCONN —
-            # wake the accept with a loopback self-connect instead
-            # (_admit sees _closed and drops the poke connection)
+            # wake the accept with a self-connect to the BOUND address
+            # (loopback only substitutes for the wildcard; a listener
+            # bound elsewhere isn't reachable at 127.0.0.1) — _admit
+            # sees _closed and drops the poke connection
             try:
+                bound_host = self._sock.getsockname()[0]
+                if bound_host in ("0.0.0.0", ""):
+                    bound_host = "127.0.0.1"
+                elif bound_host == "::":
+                    bound_host = "::1"
                 socket.create_connection(
-                    ("127.0.0.1", self.port), timeout=1.0
+                    (bound_host, self.port), timeout=1.0
                 ).close()
             except OSError:
                 pass

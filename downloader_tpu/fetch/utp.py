@@ -141,9 +141,14 @@ class UTPSocket:
         recv_id: int,
         congestion: str = "ledbat",
         emit_sack: bool = True,
+        wire_addr=None,
     ):
         self._mux = mux
+        # addr is the DISPLAY/identity form (v4-mapped v6 collapsed to
+        # dotted quad); wire_addr is what sendto needs on the mux's
+        # socket family (the mapped form on a dual-stack socket)
         self.addr = addr
+        self._wire_addr = wire_addr or addr
         self._send_id = send_id
         self._recv_id = recv_id
         self._congestion = congestion
@@ -217,7 +222,7 @@ class UTPSocket:
 
     def _send_raw(self, data: bytes) -> None:
         try:
-            self._mux.sock.sendto(data, self.addr)
+            self._mux.sock.sendto(data, self._wire_addr)
         except OSError:
             pass  # transient; retransmit machinery covers loss
 
@@ -728,12 +733,43 @@ class UTPMultiplexer:
         if sock is not None:
             self.sock = sock
         else:
-            self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-            try:
-                self.sock.bind((host, port))
-            except OSError:
-                self.sock.close()
-                raise
+            # dual-stack when listening on the any-address: one
+            # AF_INET6 socket with V6ONLY off takes v4 peers as
+            # ::ffff:a.b.c.d AND real v6 peers (anacrolix's uTP is
+            # dual-stack too). Explicit hosts pin the family; v6-less
+            # stacks fall back to plain AF_INET.
+            if host in ("", "0.0.0.0", "::"):
+                attempts = [
+                    (socket.AF_INET6, "::"),
+                    (socket.AF_INET, "0.0.0.0"),
+                ]
+            elif ":" in host:
+                attempts = [(socket.AF_INET6, host)]
+            else:
+                attempts = [(socket.AF_INET, host)]
+            last_exc: OSError | None = None
+            bound = None
+            for family, bind_host in attempts:
+                try:
+                    candidate = socket.socket(family, socket.SOCK_DGRAM)
+                except OSError as exc:
+                    last_exc = exc
+                    continue
+                try:
+                    if family == socket.AF_INET6 and bind_host == "::":
+                        candidate.setsockopt(
+                            socket.IPPROTO_IPV6, socket.IPV6_V6ONLY, 0
+                        )
+                    candidate.bind((bind_host, port))
+                except OSError as exc:
+                    candidate.close()
+                    last_exc = exc
+                    continue
+                bound = candidate
+                break
+            if bound is None:
+                raise last_exc or OSError("uTP mux could not bind")
+            self.sock = bound
         # tick granularity: retransmit checks AND the gap
         # re-advertisement cadence — a window-stalled sender recovers
         # one loss per gap re-advert, so the tick bounds per-loss
@@ -748,34 +784,72 @@ class UTPMultiplexer:
         )
         self._thread.start()
 
-    def connect(self, addr, timeout: float = CONNECT_TIMEOUT) -> UTPSocket:
-        """Initiate a stream to ``addr``; blocks until the SYN is acked.
+    @staticmethod
+    def _display_form(addr) -> tuple[str, int]:
+        """Stable identity for a peer address: v4-mapped v6
+        (::ffff:a.b.c.d, how a dual-stack socket reports v4 peers)
+        collapses to the dotted quad, and recvfrom's v6 4-tuples drop
+        flowinfo/scope — so conn keys and ``conn.addr`` look the same
+        regardless of the mux's socket family."""
+        host, port = addr[0], addr[1]
+        if host.startswith("::ffff:") and "." in host:
+            host = host[7:]
+        return (host, port)
 
-        IPv4 only (the mux socket is AF_INET): an IPv6 peer raises
-        gaierror immediately, which the caller's transport fallback
-        treats as this transport failing — v6 peers are reached over
-        TCP (PeerConnection dials them fine). Dual-stack uTP would
-        need an AF_INET6 mux socket; deliberate scope cut, documented
-        here."""
-        addr = (socket.gethostbyname(addr[0]), addr[1])
+    def _resolve(self, addr) -> tuple[tuple[str, int], tuple[str, int]]:
+        """(display, wire) forms of a dial target for THIS socket's
+        family. On a v4-only mux a v6 target raises gaierror, which the
+        caller's transport fallback treats as uTP failing — those
+        peers are reached over TCP instead."""
+        family = self.sock.family
+        flags = socket.AI_V4MAPPED if family == socket.AF_INET6 else 0
+        try:
+            info = socket.getaddrinfo(
+                addr[0], addr[1], family=family,
+                type=socket.SOCK_DGRAM, flags=flags,
+            )
+        except socket.gaierror:
+            if family != socket.AF_INET6:
+                raise
+            # musl libc ignores AI_V4MAPPED: resolve family-agnostic
+            # and hand-map a v4 result so Alpine containers can still
+            # dial v4 peers from the dual-stack socket
+            info = socket.getaddrinfo(
+                addr[0], addr[1], type=socket.SOCK_DGRAM
+            )
+            for entry_family, _, _, _, sockaddr in info:
+                if entry_family == socket.AF_INET:
+                    wire = (f"::ffff:{sockaddr[0]}", sockaddr[1])
+                    return self._display_form(wire), wire
+            raise
+        wire = info[0][4][:2]
+        return self._display_form(wire), wire
+
+    def connect(self, addr, timeout: float = CONNECT_TIMEOUT) -> UTPSocket:
+        """Initiate a stream to ``addr``; blocks until the SYN is
+        acked. Dual-stack: an any-address mux reaches v4 and v6 peers
+        alike; an explicitly v4-bound mux raises gaierror for v6
+        targets (the caller's transport fallback then dials TCP)."""
+        display, wire = self._resolve(addr)
         with self._lock:
             if self._closed:
                 raise UTPError("multiplexer closed")
             while True:
                 recv_id = secrets.randbelow(0xFFFE)
-                if (addr, recv_id) not in self._conns:
+                if (display, recv_id) not in self._conns:
                     break
             # spec: the SYN carries our RECEIVE id; we send data with
             # recv_id + 1 and the remote replies labeled recv_id
             conn = UTPSocket(
                 self,
-                addr,
+                display,
                 send_id=(recv_id + 1) & 0xFFFF,
                 recv_id=recv_id,
                 congestion=self.congestion,
                 emit_sack=self.emit_sack,
+                wire_addr=wire,
             )
-            self._conns[(addr, recv_id)] = conn
+            self._conns[(display, recv_id)] = conn
         conn._connect(timeout)
         return conn
 
@@ -829,11 +903,12 @@ class UTPMultiplexer:
                     payload = data[offset:]
                 except IndexError:
                     continue  # malformed extension chain
+            display = self._display_form(addr)
             if ptype == ST_SYN:
-                self._on_syn(addr, conn_id, seq)
+                self._on_syn(display, addr, conn_id, seq)
                 continue
             with self._lock:
-                conn = self._conns.get((addr, conn_id))
+                conn = self._conns.get((display, conn_id))
             if conn is not None:
                 conn._on_packet(
                     ptype, seq, ack, ts, ts_diff, wnd, payload, sack
@@ -847,14 +922,16 @@ class UTPMultiplexer:
                 except OSError:
                     pass
 
-    def _on_syn(self, addr, conn_id: int, seq: int) -> None:
+    def _on_syn(self, display, raw_addr, conn_id: int, seq: int) -> None:
         if self.on_accept is None:
             try:
-                self.sock.sendto(_pack(ST_RESET, conn_id, 0, 0, 0, seq), addr)
+                self.sock.sendto(
+                    _pack(ST_RESET, conn_id, 0, 0, 0, seq), raw_addr
+                )
             except OSError:
                 pass
             return
-        key = (addr, (conn_id + 1) & 0xFFFF)
+        key = (display, (conn_id + 1) & 0xFFFF)
         with self._lock:
             if self._closed:
                 return
@@ -871,11 +948,12 @@ class UTPMultiplexer:
             # on conn_id + 1
             conn = UTPSocket(
                 self,
-                addr,
+                display,
                 send_id=conn_id,
                 recv_id=(conn_id + 1) & 0xFFFF,
                 congestion=self.congestion,
                 emit_sack=self.emit_sack,
+                wire_addr=raw_addr[:2],
             )
             self._conns[key] = conn
         conn._accept(seq)

@@ -821,3 +821,79 @@ class TestLedbatAndSack:
             mux.close()
         finally:
             del os.environ["UTP_CONGESTION"]
+
+
+class TestDualStack:
+    """Round 5: the mux is dual-stack (one AF_INET6 any-socket with
+    V6ONLY off takes v4 peers as mapped addresses AND real v6 peers),
+    closing the v4-only scope cut — anacrolix's uTP is dual-stack."""
+
+    def _v6_available(self) -> bool:
+        try:
+            probe = socket.socket(socket.AF_INET6, socket.SOCK_DGRAM)
+            probe.bind(("::1", 0))
+            probe.close()
+            return True
+        except OSError:
+            return False
+
+    def test_v6_loopback_stream(self):
+        if not self._v6_available():
+            pytest.skip("no IPv6 on this host")
+        accepted: list = []
+        server = utp.UTPMultiplexer(host="::", on_accept=accepted.append)
+        client = utp.UTPMultiplexer(host="::")
+        try:
+            conn = client.connect(("::1", server.port), timeout=5)
+            conn.settimeout(10)
+            deadline = time.monotonic() + 5
+            while not accepted and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert accepted, "v6 SYN never accepted"
+            peer = accepted[0]
+            peer.settimeout(10)
+            conn.sendall(b"v6-bytes")
+            assert _recv_all(peer, 8) == b"v6-bytes"
+            peer.sendall(b"v6-back")
+            assert _recv_all(conn, 7) == b"v6-back"
+            assert peer.addr[0] == "::1"
+        finally:
+            server.close()
+            client.close()
+
+    def test_v4_peer_through_dual_stack_listener(self):
+        """A plain v4 client reaches a dual-stack (any-address) mux;
+        the accepted conn's identity is the dotted quad, not the
+        ::ffff: mapped form (allowed-fast derivation and logs depend
+        on that)."""
+        if not self._v6_available():
+            pytest.skip("no IPv6 on this host")
+        accepted: list = []
+        server = utp.UTPMultiplexer(host="", on_accept=accepted.append)
+        assert server.sock.family == socket.AF_INET6  # dual-stack bound
+        client = utp.UTPMultiplexer(host="127.0.0.1")
+        try:
+            conn = client.connect(("127.0.0.1", server.port), timeout=5)
+            conn.settimeout(10)
+            deadline = time.monotonic() + 5
+            while not accepted and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert accepted, "v4 SYN never reached the dual-stack mux"
+            peer = accepted[0]
+            peer.settimeout(10)
+            assert peer.addr[0] == "127.0.0.1"  # collapsed, not ::ffff:
+            conn.sendall(b"mapped")
+            assert _recv_all(peer, 6) == b"mapped"
+            peer.sendall(b"ok")
+            assert _recv_all(conn, 2) == b"ok"
+        finally:
+            server.close()
+            client.close()
+
+    def test_v4_only_mux_rejects_v6_target(self):
+        client = utp.UTPMultiplexer(host="127.0.0.1")
+        try:
+            with pytest.raises(OSError):
+                client.connect(("::1", 9), timeout=1)
+        finally:
+            client.close()
