@@ -31,6 +31,7 @@ import time
 from ..utils import get_logger, metrics
 from ..utils.cancel import CancelToken
 from . import bencode
+from .dualstack import bind_dual_stack_udp, display_form, wire_form
 from .http import TransferError
 
 log = get_logger("fetch.dht")
@@ -61,14 +62,29 @@ def _decode_compact_nodes(blob: bytes) -> list[tuple[bytes, str, int]]:
     return nodes
 
 
+def _decode_compact_nodes6(blob: bytes) -> list[tuple[bytes, str, int]]:
+    """BEP 32 ``nodes6``: 38 bytes per node (id + IPv6 + port)."""
+    nodes = []
+    for i in range(0, len(blob) - 37, 38):
+        node_id = blob[i : i + 20]
+        host = str(ipaddress.IPv6Address(blob[i + 20 : i + 36]))
+        port = struct.unpack(">H", blob[i + 36 : i + 38])[0]
+        nodes.append((node_id, host, port))
+    return nodes
+
+
 def _decode_compact_values(values) -> list[tuple[str, int]]:
-    """BEP 5 ``values``: list of 6-byte compact peer addresses."""
+    """BEP 5 ``values``: compact peer addresses — 6-byte IPv4 entries,
+    and per BEP 32 also 18-byte IPv6 entries in the same list."""
     peers = []
     if isinstance(values, list):
         for value in values:
             if isinstance(value, bytes) and len(value) == 6:
                 host = str(ipaddress.IPv4Address(value[:4]))
                 peers.append((host, struct.unpack(">H", value[4:6])[0]))
+            elif isinstance(value, bytes) and len(value) == 18:
+                host = str(ipaddress.IPv6Address(value[:16]))
+                peers.append((host, struct.unpack(">H", value[16:18])[0]))
     return peers
 
 
@@ -290,7 +306,12 @@ class DHTClient:
                     break  # converged: everything near the target queried
                 queried.update(candidates)
                 replies = self._query_round(
-                    pool, candidates, b"get_peers", {b"info_hash": info_hash}
+                    pool,
+                    candidates,
+                    b"get_peers",
+                    # BEP 32: ask dual-stack nodes for both families;
+                    # v4-only nodes ignore the key
+                    {b"info_hash": info_hash, b"want": [b"n4", b"n6"]},
                 )
                 if replies:
                     self.responded = True
@@ -317,16 +338,21 @@ class DHTClient:
                         if peer not in peers:
                             peers.append(peer)
                             progressed = True
+                    decoded_nodes: list[tuple[bytes, str, int]] = []
                     nodes = reply.get(b"nodes")
                     if isinstance(nodes, bytes):
-                        for node_id, host, port in _decode_compact_nodes(nodes):
-                            entry = (distance(node_id), node_id, host, port)
-                            if (
-                                entry not in shortlist
-                                and (host, port) not in queried
-                            ):
-                                shortlist.append(entry)
-                                progressed = True
+                        decoded_nodes.extend(_decode_compact_nodes(nodes))
+                    nodes6 = reply.get(b"nodes6")
+                    if isinstance(nodes6, bytes):  # BEP 32
+                        decoded_nodes.extend(_decode_compact_nodes6(nodes6))
+                    for node_id, host, port in decoded_nodes:
+                        entry = (distance(node_id), node_id, host, port)
+                        if (
+                            entry not in shortlist
+                            and (host, port) not in queried
+                        ):
+                            shortlist.append(entry)
+                            progressed = True
                 if len(peers) >= max_peers:
                     break
                 if not progressed:
@@ -371,8 +397,37 @@ def _compact_nodes(entries) -> bytes:
         try:
             blob += node_id + socket.inet_aton(host) + struct.pack(">H", port)
         except (OSError, struct.error):
-            continue  # non-v4 addr: not representable in compact form
+            continue  # non-v4 addr: lives in the nodes6 answer instead
     return bytes(blob)
+
+
+def _compact_nodes6(entries) -> bytes:
+    """BEP 32 compact node info: 38 bytes per (node_id, ip, port)."""
+    blob = bytearray()
+    for node_id, host, port in entries:
+        if ":" not in host:
+            continue
+        try:
+            blob += (
+                node_id
+                + socket.inet_pton(socket.AF_INET6, host)
+                + struct.pack(">H", port)
+            )
+        except (OSError, struct.error):
+            continue
+    return bytes(blob)
+
+
+def _compact_peer(host: str, port: int) -> bytes | None:
+    """6-byte (v4) or 18-byte (v6, BEP 32) compact peer entry."""
+    try:
+        if ":" in host:
+            return socket.inet_pton(socket.AF_INET6, host) + struct.pack(
+                ">H", port
+            )
+        return socket.inet_aton(host) + struct.pack(">H", port)
+    except (OSError, struct.error):
+        return None
 
 
 PEER_TTL = 30 * 60.0  # announce_peer registrations expire after 30 min
@@ -424,12 +479,10 @@ class DHTNode:
         self._secrets = [secrets.token_bytes(8), secrets.token_bytes(8)]
         self._rotated = time.monotonic()
         self._closed = False
-        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        try:
-            self.sock.bind((host, port))
-        except OSError:
-            self.sock.close()
-            raise
+        # dual-stack when serving on the any-address (BEP 32: answer
+        # v6 queriers too); explicit hosts pin the family, v6-less
+        # stacks fall back to plain AF_INET
+        self.sock = bind_dual_stack_udp(host, port)
         self.sock.settimeout(1.0)  # close() can't interrupt recvfrom
         self.port = self.sock.getsockname()[1]
         threading.Thread(
@@ -565,7 +618,20 @@ class DHTNode:
 
     # -- serving ---------------------------------------------------------
 
+    @staticmethod
+    def _display_addr(addr) -> tuple[str, int]:
+        """Identity form (dualstack.display_form): tokens, the routing
+        table, and peer registrations must see the same address
+        whether the packet came in over v4 or the dual-stack socket."""
+        return display_form(addr)
+
+    def _wire_addr(self, addr) -> tuple[str, int]:
+        """sendto form for THIS socket's family — resolves hostname
+        bootstrap targets before mapping (dualstack.wire_form)."""
+        return wire_form(self.sock.family, addr)
+
     def _send_ping(self, addr) -> None:
+        addr = self._wire_addr(addr)
         try:
             self.sock.sendto(
                 bencode.encode(
@@ -587,7 +653,7 @@ class DHTNode:
                 bencode.encode(
                     {b"t": tid, b"y": b"r", b"r": {b"id": self.node_id, **args}}
                 ),
-                addr,
+                self._wire_addr(addr),
             )
         except OSError:
             pass
@@ -596,7 +662,7 @@ class DHTNode:
         try:
             self.sock.sendto(
                 bencode.encode({b"t": tid, b"y": b"e", b"e": [code, text]}),
-                addr,
+                self._wire_addr(addr),
             )
         except OSError:
             pass
@@ -613,6 +679,9 @@ class DHTNode:
                 continue
             except OSError:
                 return  # closed
+            # identity form everywhere below (tokens, table, peers);
+            # _reply/_error re-map to the socket's wire form
+            addr = self._display_addr(addr)
             try:
                 msg = bencode.decode(datagram)
             except bencode.BencodeError:
@@ -655,12 +724,25 @@ class DHTNode:
             except Exception:  # pragma: no cover - hostile input guard
                 self._error(addr, tid, 202, b"server error")
 
+    @staticmethod
+    def _wants_v6(addr, args) -> bool:
+        """BEP 32: include nodes6 when the querier asked (want n6) or
+        is itself a v6 node (its own family is its implied want)."""
+        want = args.get(b"want")
+        if isinstance(want, list) and b"n6" in want:
+            return True
+        return ":" in addr[0]
+
     def _on_find_node(self, addr, tid, args) -> None:
         target = args.get(b"target")
         if not isinstance(target, bytes) or len(target) != 20:
             self._error(addr, tid, 203, b"bad target")
             return
-        self._reply(addr, tid, {b"nodes": _compact_nodes(self._closest(target))})
+        closest = self._closest(target)
+        answer: dict = {b"nodes": _compact_nodes(closest)}
+        if self._wants_v6(addr, args):
+            answer[b"nodes6"] = _compact_nodes6(closest)
+        self._reply(addr, tid, answer)
 
     def _on_get_peers(self, addr, tid, args) -> None:
         info_hash = args.get(b"info_hash")
@@ -685,24 +767,26 @@ class DHTNode:
                 )
             ]
         if live:
+            # BEP 32: 6-byte v4 and 18-byte v6 entries share the list;
+            # v6 registrations only go to queriers that can use them
+            wants_v6 = self._wants_v6(addr, args)
+            # family-filter BEFORE the cap: v6 registrations must not
+            # consume a v4-only querier's 50 slots
+            usable = [
+                peer for peer in live if wants_v6 or ":" not in peer[0]
+            ]
             values = []
-            for host, port in live[:50]:
-                try:
-                    values.append(
-                        socket.inet_aton(host) + struct.pack(">H", port)
-                    )
-                except (OSError, struct.error):
-                    continue
+            for host, port in usable[:50]:
+                entry = _compact_peer(host, port)
+                if entry is not None:
+                    values.append(entry)
             self._reply(addr, tid, {b"token": token, b"values": values})
         else:
-            self._reply(
-                addr,
-                tid,
-                {
-                    b"token": token,
-                    b"nodes": _compact_nodes(self._closest(info_hash)),
-                },
-            )
+            closest = self._closest(info_hash)
+            answer = {b"token": token, b"nodes": _compact_nodes(closest)}
+            if self._wants_v6(addr, args):
+                answer[b"nodes6"] = _compact_nodes6(closest)
+            self._reply(addr, tid, answer)
 
     def _on_announce(self, addr, tid, args) -> None:
         info_hash = args.get(b"info_hash")

@@ -44,6 +44,8 @@ import struct
 import threading
 import time
 
+from .dualstack import bind_dual_stack_udp, display_form
+
 ST_DATA = 0
 ST_FIN = 1
 ST_STATE = 2
@@ -738,38 +740,7 @@ class UTPMultiplexer:
             # ::ffff:a.b.c.d AND real v6 peers (anacrolix's uTP is
             # dual-stack too). Explicit hosts pin the family; v6-less
             # stacks fall back to plain AF_INET.
-            if host in ("", "0.0.0.0", "::"):
-                attempts = [
-                    (socket.AF_INET6, "::"),
-                    (socket.AF_INET, "0.0.0.0"),
-                ]
-            elif ":" in host:
-                attempts = [(socket.AF_INET6, host)]
-            else:
-                attempts = [(socket.AF_INET, host)]
-            last_exc: OSError | None = None
-            bound = None
-            for family, bind_host in attempts:
-                try:
-                    candidate = socket.socket(family, socket.SOCK_DGRAM)
-                except OSError as exc:
-                    last_exc = exc
-                    continue
-                try:
-                    if family == socket.AF_INET6 and bind_host == "::":
-                        candidate.setsockopt(
-                            socket.IPPROTO_IPV6, socket.IPV6_V6ONLY, 0
-                        )
-                    candidate.bind((bind_host, port))
-                except OSError as exc:
-                    candidate.close()
-                    last_exc = exc
-                    continue
-                bound = candidate
-                break
-            if bound is None:
-                raise last_exc or OSError("uTP mux could not bind")
-            self.sock = bound
+            self.sock = bind_dual_stack_udp(host, port)
         # tick granularity: retransmit checks AND the gap
         # re-advertisement cadence — a window-stalled sender recovers
         # one loss per gap re-advert, so the tick bounds per-loss
@@ -786,15 +757,10 @@ class UTPMultiplexer:
 
     @staticmethod
     def _display_form(addr) -> tuple[str, int]:
-        """Stable identity for a peer address: v4-mapped v6
-        (::ffff:a.b.c.d, how a dual-stack socket reports v4 peers)
-        collapses to the dotted quad, and recvfrom's v6 4-tuples drop
-        flowinfo/scope — so conn keys and ``conn.addr`` look the same
-        regardless of the mux's socket family."""
-        host, port = addr[0], addr[1]
-        if host.startswith("::ffff:") and "." in host:
-            host = host[7:]
-        return (host, port)
+        """Stable identity for a peer address (dualstack.display_form):
+        conn keys and ``conn.addr`` look the same regardless of the
+        mux's socket family."""
+        return display_form(addr)
 
     def _resolve(self, addr) -> tuple[tuple[str, int], tuple[str, int]]:
         """(display, wire) forms of a dial target for THIS socket's

@@ -3814,3 +3814,185 @@ class TestDHTNode:
                 assert (d / "movie.mkv").read_bytes() == data
         finally:
             hub.close()
+
+
+class TestDHTIPv6:
+    """BEP 32: the serving node is dual-stack and answers want=n6 with
+    nodes6/18-byte values; the client asks for both families and folds
+    nodes6 into its lookup (anacrolix's dht is dual-stack too)."""
+
+    def _v6_available(self) -> bool:
+        try:
+            probe = socket.socket(socket.AF_INET6, socket.SOCK_DGRAM)
+            probe.bind(("::1", 0))
+            probe.close()
+            return True
+        except OSError:
+            return False
+
+    def _krpc(self, sock, addr, method, args, tid=b"66"):
+        from downloader_tpu.fetch.bencode import decode, encode
+
+        sock.sendto(
+            encode({b"t": tid, b"y": b"q", b"q": method, b"a": args}), addr
+        )
+        reply = decode(sock.recvfrom(65536)[0])
+        assert reply[b"t"] == tid
+        return reply
+
+    def test_v6_querier_gets_nodes6_and_v6_values(self):
+        if not self._v6_available():
+            pytest.skip("no IPv6 on this host")
+        from downloader_tpu.fetch.dht import DHTNode
+
+        node = DHTNode()  # any-address: dual-stack
+        assert node.sock.family == socket.AF_INET6
+        info_hash = hashlib.sha1(b"bep32").digest()
+        v6 = socket.socket(socket.AF_INET6, socket.SOCK_DGRAM)
+        v6.settimeout(5)
+        try:
+            addr = ("::1", node.port)
+            # the v6 querier is learned into the table...
+            reply = self._krpc(v6, addr, b"ping", {b"id": b"\x11" * 20})
+            assert reply[b"y"] == b"r"
+            # ...and comes back in nodes6 (38-byte records), not nodes
+            reply = self._krpc(
+                v6,
+                addr,
+                b"find_node",
+                {b"id": b"\x11" * 20, b"target": b"\x11" * 20,
+                 b"want": [b"n4", b"n6"]},
+            )
+            nodes6 = reply[b"r"][b"nodes6"]
+            assert len(nodes6) % 38 == 0 and b"\x11" * 20 in nodes6
+            # v4-compact must NOT contain the v6 querier
+            assert b"\x11" * 20 not in reply[b"r"].get(b"nodes", b"")
+
+            # announce from a v6 source; read back an 18-byte value
+            reply = self._krpc(
+                v6, addr, b"get_peers",
+                {b"id": b"\x11" * 20, b"info_hash": info_hash},
+            )
+            token = reply[b"r"][b"token"]
+            ok = self._krpc(
+                v6, addr, b"announce_peer",
+                {b"id": b"\x11" * 20, b"info_hash": info_hash,
+                 b"port": 7331, b"token": token},
+            )
+            assert ok[b"y"] == b"r"
+            reply = self._krpc(
+                v6, addr, b"get_peers",
+                {b"id": b"\x22" * 20, b"info_hash": info_hash,
+                 b"want": [b"n6"]},
+            )
+            values = reply[b"r"][b"values"]
+            assert any(len(v) == 18 for v in values)
+            host = str(ipaddress.ip_address(values[0][:16]))
+            assert host == "::1"
+            assert struct.unpack(">H", values[0][16:])[0] == 7331
+        finally:
+            v6.close()
+            node.close()
+
+    def test_v4_querier_unaffected_by_v6_registrations(self):
+        if not self._v6_available():
+            pytest.skip("no IPv6 on this host")
+        from downloader_tpu.fetch.bencode import decode, encode
+        from downloader_tpu.fetch.dht import DHTNode
+
+        node = DHTNode()
+        info_hash = hashlib.sha1(b"bep32-v4").digest()
+        v6 = socket.socket(socket.AF_INET6, socket.SOCK_DGRAM)
+        v4 = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        v6.settimeout(5)
+        v4.settimeout(5)
+        try:
+            # register one v6 peer
+            reply = self._krpc(
+                v6, ("::1", node.port), b"get_peers",
+                {b"id": b"\x33" * 20, b"info_hash": info_hash},
+            )
+            self._krpc(
+                v6, ("::1", node.port), b"announce_peer",
+                {b"id": b"\x33" * 20, b"info_hash": info_hash,
+                 b"port": 7332, b"token": reply[b"r"][b"token"]},
+            )
+            # a plain v4 querier with no want: no 18-byte entries leak
+            reply = self._krpc(
+                v4, ("127.0.0.1", node.port), b"get_peers",
+                {b"id": b"\x44" * 20, b"info_hash": info_hash},
+            )
+            values = reply[b"r"].get(b"values", [])
+            assert all(len(v) == 6 for v in values)
+        finally:
+            v6.close()
+            v4.close()
+            node.close()
+
+    def test_client_lookup_traverses_v6_topology(self):
+        if not self._v6_available():
+            pytest.skip("no IPv6 on this host")
+        from downloader_tpu.fetch.dht import DHTClient, DHTNode
+
+        info_hash = hashlib.sha1(b"bep32-lookup").digest()
+        router = DHTNode(host="::1")
+        keeper = DHTNode(host="::1", bootstrap=(("::1", router.port),))
+
+        def wait(pred, t=5):
+            deadline = time.monotonic() + t
+            while time.monotonic() < deadline:
+                if pred():
+                    return True
+                time.sleep(0.02)
+            return pred()
+
+        try:
+            assert wait(lambda: keeper.routing_nodes())
+            assert wait(lambda: router.routing_nodes())
+            # register a peer on the keeper only (first-round token)
+            DHTClient(
+                bootstrap=(("::1", keeper.port),)
+            ).get_peers(info_hash, announce_port=7333, max_rounds=1)
+            # fresh lookup from the router: must traverse nodes6 to
+            # reach the keeper and decode the 18-byte value
+            peers = DHTClient(
+                bootstrap=(("::1", router.port),)
+            ).get_peers(info_hash)
+            assert ("::1", 7333) in peers
+        finally:
+            keeper.close()
+            router.close()
+
+
+class TestDualStackWireForm:
+    def test_hostname_bootstrap_resolved_not_mangled(self):
+        """Regression: a dual-stack node's ping to a HOSTNAME bootstrap
+        router (the DEFAULT_BOOTSTRAP shape) must resolve the name —
+        blindly prefixing ::ffff: onto 'router.bittorrent.com' made
+        every default bootstrap ping fail silently."""
+        from downloader_tpu.fetch.dualstack import wire_form
+
+        assert wire_form(socket.AF_INET6, ("1.2.3.4", 6881)) == (
+            "::ffff:1.2.3.4",
+            6881,
+        )
+        assert wire_form(socket.AF_INET6, ("::1", 9)) == ("::1", 9)
+        assert wire_form(socket.AF_INET, ("1.2.3.4", 1)) == ("1.2.3.4", 1)
+        resolved = wire_form(socket.AF_INET6, ("localhost", 6881))
+        assert resolved[0] in ("::ffff:127.0.0.1", "::1")
+
+    def test_dual_stack_node_pings_v4_literal_bootstrap(self):
+        """The daemon's shared node (dual-stack) bootstrapping at a v4
+        hub — the round-5 wiring — must actually reach it."""
+        from downloader_tpu.fetch.dht import DHTNode
+
+        hub = DHTNode(host="127.0.0.1")
+        node = DHTNode(bootstrap=(("127.0.0.1", hub.port),))
+        try:
+            deadline = time.monotonic() + 5
+            while not node.routing_nodes() and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert ("127.0.0.1", hub.port) in node.routing_nodes()
+        finally:
+            node.close()
+            hub.close()
