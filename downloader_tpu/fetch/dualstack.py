@@ -57,6 +57,34 @@ def bind_dual_stack_udp(host: str, port: int) -> socket.socket:
     raise last_exc or OSError("could not bind a UDP socket")
 
 
+def bind_dual_stack_tcp(host: str, port: int, backlog: int = 16) -> socket.socket:
+    """Bind + listen a TCP socket with the same family policy as
+    :func:`bind_dual_stack_udp` (dual-stack on the any-address via
+    ``create_server(dualstack_ipv6=True)``, family pinned by explicit
+    hosts, AF_INET fallback)."""
+    if host in ("", "0.0.0.0", "::") and socket.has_dualstack_ipv6():
+        try:
+            return socket.create_server(
+                ("::", port),
+                family=socket.AF_INET6,
+                backlog=backlog,
+                reuse_port=False,
+                dualstack_ipv6=True,
+            )
+        except OSError:
+            pass  # fall through to the single-family path
+    family = socket.AF_INET6 if ":" in host else socket.AF_INET
+    listener = socket.socket(family, socket.SOCK_STREAM)
+    try:
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("0.0.0.0" if host in ("", "::") else host, port))
+        listener.listen(backlog)
+    except OSError:
+        listener.close()
+        raise
+    return listener
+
+
 def display_form(addr) -> tuple[str, int]:
     """Stable peer identity (see module docstring)."""
     host, port = addr[0], addr[1]

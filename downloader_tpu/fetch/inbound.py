@@ -22,6 +22,7 @@ import time
 
 from ..utils import get_logger, metrics
 from . import bencode, mse, utp
+from .dualstack import bind_dual_stack_tcp, display_form
 from .peerwire import (
     ALLOWED_FAST_K,
     BLOCK_SIZE,
@@ -501,14 +502,11 @@ class PeerListener:
         self._closed = False
         self.blocks_served = 0
         self.bytes_served = 0
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        try:
-            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            self._sock.bind((host, port))
-            self._sock.listen(16)
-        except OSError:
-            self._sock.close()
-            raise
+        # dual-stack TCP when listening on the any-address: v6 peers
+        # can dial our announced port too (uTP below already takes
+        # both); explicit hosts pin the family, v6-less stacks fall
+        # back to plain AF_INET
+        self._sock = bind_dual_stack_tcp(host, port)
         self.port = self._sock.getsockname()[1]
         # uTP (BEP 29) rides UDP on the SAME number as the announced
         # TCP port — that is where remotes will try it. Bind failure
@@ -537,7 +535,9 @@ class PeerListener:
                 sock, addr = self._sock.accept()
             except OSError:
                 return  # listener closed
-            self._admit(sock, addr)
+            # identity form: mapped-v4 collapses so the allowed-fast
+            # derivation, PEX, and logs see the real v4 address
+            self._admit(sock, display_form(addr))
 
     def _accept_utp(self, stream: "utp.UTPSocket") -> None:
         # uTP streams enter the exact same serving path as TCP ones:

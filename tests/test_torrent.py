@@ -3996,3 +3996,68 @@ class TestDualStackWireForm:
         finally:
             node.close()
             hub.close()
+
+
+class TestDualStackTCPListener:
+    """Round 5: the TCP half of the announced port is dual-stack too
+    (uTP already was) — v6 peers can dial in, and v4 peers through the
+    dual-stack socket keep their real dotted-quad identity (the BEP 6
+    allowed-fast derivation is v4-only by spec)."""
+
+    PIECE = 32 * 1024
+
+    def _v6_available(self) -> bool:
+        try:
+            probe = socket.socket(socket.AF_INET6, socket.SOCK_STREAM)
+            probe.bind(("::1", 0))
+            probe.close()
+            return True
+        except OSError:
+            return False
+
+    def test_v6_peer_fetches_block_over_tcp(self, tmp_path):
+        if not self._v6_available() or not socket.has_dualstack_ipv6():
+            pytest.skip("no dual-stack IPv6 on this host")
+        from downloader_tpu.fetch.peer import (
+            MSG_INTERESTED,
+            MSG_PIECE,
+            MSG_REQUEST,
+            PeerConnection,
+        )
+
+        data = bytes(range(256)) * 300
+        info, _, _ = make_torrent("movie.mkv", data, self.PIECE)
+        store = PieceStore(info, str(tmp_path))
+        for i in range(store.num_pieces):
+            store.write_piece(
+                i, data[i * self.PIECE : i * self.PIECE + store.piece_size(i)]
+            )
+        info_bytes = encode(info)
+        info_hash = hashlib.sha1(info_bytes).digest()
+        listener = PeerListener(info_hash, generate_peer_id())
+        listener.attach(store, info_bytes)
+        try:
+            with PeerConnection(
+                "::1",
+                listener.port,
+                info_hash,
+                generate_peer_id(),
+                CancelToken(),
+                timeout=5,
+            ) as conn:
+                while not conn.remote_have_all:
+                    conn.read_message()
+                conn.send_message(MSG_INTERESTED)
+                while conn.choked:
+                    conn.read_message()
+                conn.send_message(
+                    MSG_REQUEST, struct.pack(">III", 0, 0, 4096)
+                )
+                while True:
+                    msg_id, payload = conn.read_message()
+                    if msg_id == MSG_PIECE:
+                        break
+                assert payload[8:] == data[:4096]
+        finally:
+            listener.close()
+        assert listener.blocks_served == 1
