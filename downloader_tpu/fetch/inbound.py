@@ -433,24 +433,43 @@ class _InboundPeer:
     def _maybe_send_pex(self) -> None:
         """One-shot BEP 11 ut_pex after the extended handshakes: share
         the peers this job knows about with a leecher that asked to
-        gossip. IPv4 compact only (added6 when the job ever sees v6
-        swarms); flags bytes are zeros."""
+        gossip: v4 compact in ``added``, v6 in ``added6`` (BEP 11);
+        flags bytes are zeros."""
         remote_id = self._remote_ext.get(b"ut_pex")
         peers = self._listener.known_peers()
         if not remote_id or not peers:
             return
         compact = bytearray()
+        compact6 = bytearray()
         for host, port in peers:
-            try:
-                compact += socket.inet_aton(host) + struct.pack(">H", port)
-            except (OSError, struct.error):
-                continue  # hostname or v6 literal: not compact-v4-able
-        if not compact:
+            # v4-mapped literals (a v6 tracker's added6, uTP wire
+            # forms) are v4 peers: normalize so v4-only receivers
+            # still learn them from the added list
+            host = display_form((host, port))[0]
+            if ":" in host:
+                try:
+                    compact6 += socket.inet_pton(
+                        socket.AF_INET6, host
+                    ) + struct.pack(">H", port)
+                except (OSError, struct.error):
+                    continue
+            else:
+                try:
+                    compact += socket.inet_aton(host) + struct.pack(
+                        ">H", port
+                    )
+                except (OSError, struct.error):
+                    continue  # hostname: not compact-able
+        if not compact and not compact6:
             return
-        payload = bencode.encode(
-            {b"added": bytes(compact), b"added.f": bytes(len(compact) // 6)}
-        )
-        self._send(MSG_EXTENDED, bytes([remote_id]) + payload)
+        message = {
+            b"added": bytes(compact),
+            b"added.f": bytes(len(compact) // 6),
+        }
+        if compact6:  # BEP 11: v6 peers gossip in added6
+            message[b"added6"] = bytes(compact6)
+            message[b"added6.f"] = bytes(len(compact6) // 18)
+        self._send(MSG_EXTENDED, bytes([remote_id]) + bencode.encode(message))
 
 
 class PeerListener:

@@ -190,9 +190,29 @@ def announce_udp(
         # there is no meaningful default port for UDP trackers; guessing
         # one buys a silent full-timeout stall instead of a clear error
         raise TransferError(f"udp tracker url has no port: {tracker_url}")
-    addr = (parsed.hostname, tracker_port)
+    # family-aware dialing: v6-only trackers exist; prefer v4 answers
+    # (BEP 15's compact peer format is v4 there, 18-byte over v6)
+    try:
+        info = socket.getaddrinfo(
+            parsed.hostname, tracker_port, type=socket.SOCK_DGRAM
+        )
+    except OSError as exc:
+        raise TransferError(
+            f"udp tracker resolve failed: {tracker_url}: {exc}"
+        ) from exc
+    info.sort(key=lambda entry: entry[0] != socket.AF_INET)
+    family = info[0][0]
+    addr = info[0][4][:2]
 
-    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as sock:
+    try:
+        sock = socket.socket(family, socket.SOCK_DGRAM)
+    except OSError as exc:
+        # e.g. an AAAA-only tracker on a v6-less host: one bad tracker
+        # must be a recorded TransferError, not an announce-round abort
+        raise TransferError(
+            f"udp tracker socket failed: {tracker_url}: {exc}"
+        ) from exc
+    with sock:
         try:
             tid = struct.unpack(">I", secrets.token_bytes(4))[0]
             reply = _udp_roundtrip(
@@ -228,6 +248,10 @@ def announce_udp(
             reply = _udp_roundtrip(sock, addr, request, tid, timeout, retries)
             if len(reply) < 20 or struct.unpack(">I", reply[:4])[0] != 1:
                 raise TransferError("malformed announce reply from tracker")
+            if family == socket.AF_INET6:
+                # BEP 15 over v6: the announce reply carries 18-byte
+                # compact entries
+                return decode_compact_peers6(reply[20:])
             return decode_compact_peers(reply[20:])
         except OSError as exc:
             raise TransferError(f"tracker announce failed: {exc}") from exc

@@ -4061,3 +4061,62 @@ class TestDualStackTCPListener:
         finally:
             listener.close()
         assert listener.blocks_served == 1
+
+
+class TestV6Gossip:
+    def test_pex_emits_added6_for_v6_peers(self, tmp_path):
+        """BEP 11: v6 peers the listener knows gossip in added6 (18-byte
+        compact), alongside the v4 added list."""
+        from downloader_tpu.fetch.peer import (
+            MSG_EXTENDED,
+            PeerConnection,
+            UT_PEX,
+            decode_compact_peers,
+            decode_compact_peers6,
+        )
+
+        data = bytes(range(256)) * 200
+        info, _, _ = make_torrent("movie.mkv", data, 32 * 1024)
+        store = PieceStore(info, str(tmp_path))
+        for i in range(store.num_pieces):
+            store.write_piece(
+                i, data[i * 32 * 1024 : i * 32 * 1024 + store.piece_size(i)]
+            )
+        info_bytes = encode(info)
+        info_hash = hashlib.sha1(info_bytes).digest()
+        listener = PeerListener(info_hash, generate_peer_id())
+        gossip = [
+            ("1.2.3.4", 6881),
+            ("2001:db8::7", 6882),
+            # mapped-v4: must normalize into the v4 added list
+            ("::ffff:5.6.7.8", 6883),
+        ]
+        listener.attach(store, info_bytes, peer_source=lambda: gossip)
+        try:
+            with PeerConnection(
+                "127.0.0.1",
+                listener.port,
+                info_hash,
+                generate_peer_id(),
+                CancelToken(),
+                timeout=5,
+            ) as conn:
+                deadline = time.monotonic() + 5
+                pex_payload = None
+                while time.monotonic() < deadline and pex_payload is None:
+                    msg_id, payload = conn.read_message()
+                    if (
+                        msg_id == MSG_EXTENDED
+                        and payload
+                        and payload[0] == UT_PEX
+                    ):
+                        pex_payload = decode(payload[1:])
+                assert pex_payload is not None, "no ut_pex gossip arrived"
+                v4 = pex_payload.get(b"added", b"")
+                v6 = pex_payload.get(b"added6", b"")
+                decoded_v4 = decode_compact_peers(v4)
+                assert ("1.2.3.4", 6881) in decoded_v4
+                assert ("5.6.7.8", 6883) in decoded_v4  # de-mapped
+                assert ("2001:db8::7", 6882) in decode_compact_peers6(v6)
+        finally:
+            listener.close()
