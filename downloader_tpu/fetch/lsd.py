@@ -1,8 +1,9 @@
 """Local Service Discovery (BEP 14): find swarm peers on the local
 network via UDP multicast, no tracker or DHT required.
 
-Announces ``BT-SEARCH`` messages to the BEP 14 IPv4 group
-(239.192.152.143:6771) and listens for other hosts' announces; a
+Announces ``BT-SEARCH`` messages to the BEP 14 groups — IPv4
+(239.192.152.143:6771) and, when the host can join it, IPv6
+([ff15::efc0:988f]:6771) — and listens for other hosts' announces; a
 matching info-hash from a foreign cookie yields a peer for the swarm.
 Per the spec, hearing a matching announce also triggers a (rate-
 limited) responsive announce of our own, so two hosts that start
@@ -29,6 +30,7 @@ from ..utils import get_logger
 log = get_logger("fetch.lsd")
 
 GROUP_V4 = "239.192.152.143"
+GROUP_V6 = "ff15::efc0:988f"  # BEP 14's site-local v6 group
 MCAST_PORT = 6771
 # floor between announces. BEP 14 asks for at most ~1/min steady-state;
 # the one deliberate divergence is an immediate responsive announce the
@@ -104,7 +106,6 @@ class LSD:
         self._port = port
         self._on_peer = on_peer
         self._interval = interval
-        self._group = group
         self._mcast_port = mcast_port
         self._announce_gap = announce_gap
         # the cookie filters our own multicast echoes (the group loops
@@ -116,72 +117,128 @@ class LSD:
         self._pending_responsive = False
         self._lock = threading.Lock()
 
-        self._rx = socket.socket(
-            socket.AF_INET, socket.SOCK_DGRAM, socket.IPPROTO_UDP
-        )
-        self._rx.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # one leg per address family: (rx, tx, host-header, sendto
+        # dest). v4 and v6 degrade independently — a host that can
+        # join only one group still discovers on that one; the
+        # constructor raises only when NEITHER is joinable (callers
+        # treat LSD as optional).
+        self._legs: list[tuple[socket.socket, socket.socket, str, tuple]] = []
+        errors: list[OSError] = []
+        try:
+            self._legs.append(self._make_v4_leg(group, mcast_port))
+        except OSError as exc:
+            errors.append(exc)
+        if group == GROUP_V4:
+            # the v6 leg joins the WELL-KNOWN v6 group; tests that use
+            # a custom v4 group stay single-leg and hermetic
+            try:
+                self._legs.append(self._make_v6_leg(GROUP_V6, mcast_port))
+            except OSError as exc:
+                errors.append(exc)
+        if not self._legs:
+            raise errors[0]
+
+        for index, leg in enumerate(self._legs):
+            threading.Thread(
+                target=self._listen_loop,
+                args=(leg[0],),
+                daemon=True,
+                name=f"lsd-listen-{index}",
+            ).start()
+        threading.Thread(
+            target=self._announce_loop, daemon=True, name="lsd-announce"
+        ).start()
+
+    @staticmethod
+    def _make_leg(family: int, join, tx_setup, host_header: str, dest):
+        """One multicast leg: bound+joined rx (1 s timeout — close()
+        cannot interrupt a thread already blocked in recvfrom, so the
+        timeout bounds how long the listen thread outlives close() on
+        a quiet LAN), LAN-scoped tx. ``join``/``tx_setup`` hold the
+        only family-specific parts."""
+        rx = socket.socket(family, socket.SOCK_DGRAM, socket.IPPROTO_UDP)
+        rx.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         if hasattr(socket, "SO_REUSEPORT"):
             # several jobs (or processes) share the well-known port
             try:
-                self._rx.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+                rx.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
             except OSError:
                 pass
         try:
-            self._rx.bind(("", mcast_port))
-            self._rx.setsockopt(
+            join(rx)
+        except OSError:
+            rx.close()
+            raise
+        rx.settimeout(1.0)
+        try:
+            tx = socket.socket(family, socket.SOCK_DGRAM, socket.IPPROTO_UDP)
+            tx_setup(tx)
+        except OSError:
+            # the bound rx (port + group membership) must not outlive
+            # a failed constructor
+            rx.close()
+            raise
+        return rx, tx, host_header, dest
+
+    @classmethod
+    def _make_v4_leg(cls, group: str, mcast_port: int):
+        def join(rx: socket.socket) -> None:
+            rx.bind(("", mcast_port))
+            rx.setsockopt(
                 socket.IPPROTO_IP,
                 socket.IP_ADD_MEMBERSHIP,
                 struct.pack("4sl", socket.inet_aton(group), socket.INADDR_ANY),
             )
-        except OSError:
-            self._rx.close()
-            raise
-        # close() cannot interrupt a thread already blocked in
-        # recvfrom (the in-flight syscall keeps the kernel socket
-        # alive); a short timeout bounds how long the listen thread
-        # outlives close() on a quiet LAN
-        self._rx.settimeout(1.0)
-        try:
-            self._tx = socket.socket(
-                socket.AF_INET, socket.SOCK_DGRAM, socket.IPPROTO_UDP
-            )
-            # local scope: BEP 14 discovery must not leak past the LAN
-            self._tx.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_TTL, 1)
-            self._tx.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_LOOP, 1)
-        except OSError:
-            # the bound rx (port + group membership) must not outlive
-            # a failed constructor
-            tx = getattr(self, "_tx", None)
-            if tx is not None:
-                tx.close()
-            self._rx.close()
-            raise
 
-        threading.Thread(
-            target=self._listen_loop, daemon=True, name="lsd-listen"
-        ).start()
-        threading.Thread(
-            target=self._announce_loop, daemon=True, name="lsd-announce"
-        ).start()
+        def tx_setup(tx: socket.socket) -> None:
+            # local scope: BEP 14 discovery must not leak past the LAN
+            tx.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_TTL, 1)
+            tx.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_LOOP, 1)
+
+        return cls._make_leg(
+            socket.AF_INET, join, tx_setup, group, (group, mcast_port)
+        )
+
+    @classmethod
+    def _make_v6_leg(cls, group: str, mcast_port: int):
+        def join(rx: socket.socket) -> None:
+            rx.setsockopt(socket.IPPROTO_IPV6, socket.IPV6_V6ONLY, 1)
+            rx.bind(("", mcast_port))
+            rx.setsockopt(
+                socket.IPPROTO_IPV6,
+                socket.IPV6_JOIN_GROUP,
+                socket.inet_pton(socket.AF_INET6, group)
+                + struct.pack("@I", 0),  # 0 = default interface
+            )
+
+        def tx_setup(tx: socket.socket) -> None:
+            tx.setsockopt(socket.IPPROTO_IPV6, socket.IPV6_MULTICAST_HOPS, 1)
+            tx.setsockopt(socket.IPPROTO_IPV6, socket.IPV6_MULTICAST_LOOP, 1)
+
+        # BEP 14: the Host header carries the bracketed v6 group
+        return cls._make_leg(
+            socket.AF_INET6, join, tx_setup, f"[{group}]", (group, mcast_port)
+        )
 
     # -- announcing ------------------------------------------------------
 
     def _announce(self) -> None:
         with self._lock:
             self._last_announce = time.monotonic()
-        try:
-            self._tx.sendto(
-                build_announce(
-                    self._group,
-                    self._mcast_port,
-                    self._port,
-                    self._info_hash,
-                    self._cookie,
-                ),
-                (self._group, self._mcast_port),
-            )
-        except OSError:
-            pass  # transient; the periodic loop retries
+        for _, tx, host_header, dest in self._legs:
+            try:
+                tx.sendto(
+                    build_announce(
+                        host_header,
+                        self._mcast_port,
+                        self._port,
+                        self._info_hash,
+                        self._cookie,
+                    ),
+                    dest,
+                )
+            except OSError:
+                pass  # transient; the periodic loop retries
 
     def _announce_loop(self) -> None:
         self._announce()  # immediate presence
@@ -202,10 +259,10 @@ class LSD:
         if due:
             self._announce()
 
-    def _listen_loop(self) -> None:
+    def _listen_loop(self, rx: socket.socket) -> None:
         while not self._closed.is_set():
             try:
-                data, addr = self._rx.recvfrom(1400)
+                data, addr = rx.recvfrom(1400)
             except socket.timeout:
                 self._flush_pending_responsive()
                 continue  # periodic _closed re-check
@@ -241,8 +298,9 @@ class LSD:
 
     def close(self) -> None:
         self._closed.set()
-        for sock in (self._rx, self._tx):
-            try:
-                sock.close()
-            except OSError:
-                pass
+        for rx, tx, _, _ in self._legs:
+            for sock in (rx, tx):
+                try:
+                    sock.close()
+                except OSError:
+                    pass

@@ -126,7 +126,7 @@ class TestDiscovery:
         mine = [
             t
             for t in threading.enumerate()
-            if t not in before and t.name == "lsd-listen"
+            if t not in before and t.name.startswith("lsd-listen")
         ]
         assert mine, "listen thread never started"
         client.close()
@@ -305,3 +305,45 @@ class TestSwarmViaLSD:
         _run_swarm(downloaders)
         for d in dirs:
             assert (d / "movie.mkv").read_bytes() == data
+
+
+class TestV6Leg:
+    def test_v6_only_mutual_discovery(self):
+        """BEP 14's IPv6 group ([ff15::efc0:988f]:6771): with the v4
+        legs removed, two instances still find each other over v6 —
+        the announce carries the bracketed Host and the heard peer is
+        a v6 address."""
+        found_a: list = []
+        found_b: list = []
+        a = lsd.LSD(INFO_HASH, 43001, found_a.append, announce_gap=0.0)
+        b = lsd.LSD(INFO_HASH, 43002, found_b.append, announce_gap=0.0)
+        try:
+            if not any(leg[2].startswith("[") for leg in a._legs):
+                pytest.skip("no joinable IPv6 multicast on this host")
+            for client in (a, b):
+                for rx, tx, header, _ in list(client._legs):
+                    if not header.startswith("["):
+                        rx.close()
+                        tx.close()
+                client._legs = [
+                    leg for leg in client._legs if leg[2].startswith("[")
+                ]
+            found_a.clear()
+            found_b.clear()
+            a._announce()
+            b._announce()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and not (
+                any(":" in host for host, _ in found_a)
+                and any(":" in host for host, _ in found_b)
+            ):
+                time.sleep(0.05)
+            assert any(
+                ":" in host and port == 43002 for host, port in found_a
+            ), found_a
+            assert any(
+                ":" in host and port == 43001 for host, port in found_b
+            ), found_b
+        finally:
+            a.close()
+            b.close()
