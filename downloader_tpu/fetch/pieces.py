@@ -46,6 +46,13 @@ class PieceStore:
         self.files: list[tuple[str, int]] = []  # (path, length)
         # torrent-relative path segments per file (webseed URL building)
         self.relative_paths: list[tuple[str, ...]] = []
+        # BEP 47: pad entries (attr contains 'p', or the legacy
+        # .pad/-directory convention) exist only to align the next real
+        # file to a piece boundary. Their bytes are all zeros BY SPEC:
+        # never written to disk (no junk files for the media scanner /
+        # uploader to trip on), read back as zeros for verification and
+        # serving, zero-filled instead of fetched from webseeds.
+        self.pad_file: list[bool] = []
         self.single_file = b"files" not in info
         if not self.single_file:  # multi-file: base_dir/name/<path...>
             for entry in info[b"files"]:
@@ -57,13 +64,19 @@ class PieceStore:
                 safe_parts = [os.path.basename(p) for p in parts if p not in ("", ".", "..")]
                 if not safe_parts:
                     raise TransferError("torrent file entry has no usable path")
+                attr = entry.get(b"attr", b"")
+                is_pad = (
+                    isinstance(attr, bytes) and b"p" in attr
+                ) or parts[:1] == [".pad"]
                 self.files.append(
                     (os.path.join(base_dir, name, *safe_parts), int(entry[b"length"]))
                 )
                 self.relative_paths.append((name, *safe_parts))
+                self.pad_file.append(is_pad)
         else:  # single file: base_dir/name
             self.files.append((os.path.join(base_dir, name), int(info[b"length"])))
             self.relative_paths.append((name,))
+            self.pad_file.append(False)
 
         self.total_length = sum(length for _, length in self.files)
         expected_pieces = (
@@ -103,19 +116,26 @@ class PieceStore:
 
     def piece_file_ranges(
         self, index: int
-    ) -> list[tuple[tuple[str, ...], int, int]]:
+    ) -> list[tuple[tuple[str, ...] | None, int, int]]:
         """[(relative_path_parts, offset_in_file, length)] covering one
-        piece — the per-file ranges a webseed fetch must request."""
+        piece — the per-file ranges a webseed fetch must request.
+        ``parts`` is None for a BEP 47 pad range: those bytes are zeros
+        by spec and are not on the webseed — callers zero-fill them
+        locally instead of requesting them."""
         offset = index * self.piece_length
         size = self.piece_size(index)
         out = []
         file_start = 0
-        for (path, length), parts in zip(self.files, self.relative_paths):
+        for (path, length), parts, is_pad in zip(
+            self.files, self.relative_paths, self.pad_file
+        ):
             file_end = file_start + length
             lo = max(offset, file_start)
             hi = min(offset + size, file_end)
             if lo < hi:
-                out.append((parts, lo - file_start, hi - lo))
+                # BEP 47: pad ranges are all zeros and are NOT on the
+                # webseed — parts=None tells the fetch to zero-fill
+                out.append((None if is_pad else parts, lo - file_start, hi - lo))
             file_start = file_end
         return out
 
@@ -146,11 +166,13 @@ class PieceStore:
     ) -> bytes | None:
         out = bytearray()
         file_start = 0
-        for path, length in self.files:
+        for (path, length), is_pad in zip(self.files, self.pad_file):
             file_end = file_start + length
             lo = max(offset, file_start)
             hi = min(offset + size, file_end)
-            if lo < hi:
+            if lo < hi and is_pad:
+                out += bytes(hi - lo)  # BEP 47: zeros, never on disk
+            elif lo < hi:
                 if handles is not None and path in handles:
                     src = handles[path]
                 else:
@@ -248,15 +270,16 @@ class PieceStore:
         cursor = 0
         file_start = 0
         with self._write_lock:
-            for path, length in self.files:
+            for (path, length), is_pad in zip(self.files, self.pad_file):
                 file_end = file_start + length
                 if offset + cursor < file_end and offset + len(data) > file_start:
                     begin_in_file = max(offset + cursor - file_start, 0)
                     take = min(file_end - (offset + cursor), len(data) - cursor)
-                    os.makedirs(os.path.dirname(path), exist_ok=True)
-                    with open(path, "r+b" if os.path.exists(path) else "wb") as sink:
-                        sink.seek(begin_in_file)
-                        sink.write(data[cursor : cursor + take])
+                    if not is_pad:  # BEP 47: padding never reaches disk
+                        os.makedirs(os.path.dirname(path), exist_ok=True)
+                        with open(path, "r+b" if os.path.exists(path) else "wb") as sink:
+                            sink.seek(begin_in_file)
+                            sink.write(data[cursor : cursor + take])
                     cursor += take
                     if cursor == len(data):
                         break

@@ -316,9 +316,15 @@ class _WebSeedClient:
 def _fetch_webseed_piece(
     client: _WebSeedClient, url: str, store: PieceStore, index: int
 ) -> bytes:
-    """One piece via HTTP Range requests (one per file the piece spans)."""
+    """One piece via HTTP Range requests (one per file the piece spans).
+
+    BEP 47 pad ranges (parts=None) are zero-filled locally — padding is
+    all zeros by spec and is not served by webseeds."""
     out = bytearray()
     for parts, offset, length in store.piece_file_ranges(index):
+        if parts is None:
+            out += bytes(length)
+            continue
         file_url = _webseed_file_url(url, parts, store.single_file)
         out += client.fetch_range(file_url, offset, length)
     return bytes(out)
