@@ -179,6 +179,13 @@ class SwarmDownloader:
         # trackers that have accepted an announce this job — the only
         # ones lifecycle events (completed/stopped) should bother
         self._announced: dict[str, None] = {}
+        # per-tracker failure backoff for the tiered walk: a dead
+        # tracker in a HIGH tier would otherwise cost its full timeout
+        # (up to ~15 s) at the top of EVERY discovery round before the
+        # walk reaches the tier that works (anacrolix/libtorrent track
+        # per-tracker failure state the same way). tracker ->
+        # (retry_after_monotonic, current_delay)
+        self._tracker_backoff: dict[str, tuple[float, float]] = {}
         # populated by run(): the live announced port and upload stats
         self.listen_port: int | None = None
         self.blocks_served = 0
@@ -283,24 +290,58 @@ class SwarmDownloader:
             # responds, promoting it to the tier's front so later
             # announces go straight to the tracker that works. Lower
             # tiers are touched only when every higher tier failed.
+            def attempt(tracker: str) -> bool:
+                backoff = self._tracker_backoff.get(tracker)
+                try:
+                    found = one_announce(tracker)
+                except TransferError as exc:
+                    # deadline from a FRESH clock: a timing-out tracker
+                    # must not consume its own backoff window during
+                    # the failing call (urlopen's 15 s would expire a
+                    # 15 s window exactly as it is recorded)
+                    failed_at = time.monotonic()
+                    delay = min(backoff[1] * 2 if backoff else 15.0, 300.0)
+                    self._tracker_backoff[tracker] = (
+                        failed_at + delay,
+                        delay,
+                    )
+                    errors.append(f"{tracker}: {exc}")
+                    return False
+                self._tracker_backoff.pop(tracker, None)
+                record_success(tracker, found)
+                return True
+
+            skipped: list[tuple[str, float]] = []
             for tier in self._tiers:
                 succeeded: str | None = None
                 for tracker in list(tier):
                     if token is not None:
                         token.raise_if_cancelled()
-                    try:
-                        found = one_announce(tracker)
-                    except TransferError as exc:
-                        errors.append(f"{tracker}: {exc}")
-                        continue
-                    record_success(tracker, found)
-                    succeeded = tracker
-                    break
+                    backoff = self._tracker_backoff.get(tracker)
+                    if (
+                        backoff is not None
+                        and time.monotonic() < backoff[0]
+                    ):
+                        skipped.append((tracker, backoff[0]))
+                        errors.append(f"{tracker}: backing off")
+                        continue  # recently failed: skip, no timeout
+                    if attempt(tracker):
+                        succeeded = tracker
+                        break
                 if succeeded is not None:
                     if tier[0] != succeeded:
                         tier.remove(succeeded)
                         tier.insert(0, succeeded)
                     break
+            if not tracker_responded and skipped:
+                # every candidate sat inside its backoff window: a round
+                # with ZERO actual attempts must not read as "all
+                # trackers dead" (a private job with no DHT/LSD would
+                # abort while a recovered tracker waits out its window).
+                # Try the one closest to its retry time anyway.
+                if token is not None:
+                    token.raise_if_cancelled()
+                attempt(min(skipped, key=lambda item: item[1])[0])
 
         dht_responded = False
         if (

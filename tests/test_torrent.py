@@ -4210,3 +4210,69 @@ class TestPadFiles:
         assert (tmp_path / "padded" / "b.mkv").read_bytes() == file_b
         assert not (tmp_path / "padded" / ".pad").exists()
         assert not any(".pad" in r[0] for r in server.requests)
+
+
+class TestTrackerBackoff:
+    """A dead tracker in a HIGH tier must not cost its full timeout at
+    the top of every discovery round: failures back off exponentially
+    (reset on success), so later rounds skip straight to the tier that
+    works — the per-tracker failure state anacrolix/libtorrent keep."""
+
+    def test_dead_high_tier_skipped_after_first_failure(self, seeder, monkeypatch):
+        from downloader_tpu.fetch import peer as peer_mod
+        from downloader_tpu.fetch.magnet import TorrentJob
+
+        dead = "http://127.0.0.1:1/announce"
+        attempts: list[str] = []
+        real_announce = peer_mod.announce
+
+        def counting(tracker_url, *args, **kwargs):
+            attempts.append(tracker_url)
+            return real_announce(tracker_url, *args, **kwargs)
+
+        monkeypatch.setattr(peer_mod, "announce", counting)
+        job = TorrentJob(
+            info_hash=hashlib.sha1(b"backoff").digest(),
+            trackers=(dead, seeder.tracker_url),
+            tracker_tiers=((dead,), (seeder.tracker_url,)),
+        )
+        downloader = peer_mod.SwarmDownloader(job, "/tmp", dht_bootstrap=())
+        downloader._discover_peers(left=100, allow_empty=True)
+        assert attempts.count(dead) == 1
+        # round 2, inside the backoff window: the dead tier is skipped
+        # outright and the working tier answers immediately
+        downloader._discover_peers(left=100, allow_empty=True, event="")
+        assert attempts.count(dead) == 1  # not retried
+        assert attempts.count(seeder.tracker_url) == 2
+        # a clocked-out backoff retries (and doubles on failure)
+        retry_at, delay = downloader._tracker_backoff[dead]
+        assert delay == 15.0
+        downloader._tracker_backoff[dead] = (0.0, delay)
+        downloader._discover_peers(left=100, allow_empty=True, event="")
+        assert attempts.count(dead) == 2
+        assert downloader._tracker_backoff[dead][1] == 30.0
+
+    def test_all_backed_off_round_still_attempts_one(self, seeder):
+        """A round where every tracker sits in its backoff window must
+        not read as 'all trackers dead' (a private job would abort):
+        the tracker closest to retry is attempted anyway."""
+        from downloader_tpu.fetch.magnet import TorrentJob
+        from downloader_tpu.fetch.peer import SwarmDownloader
+
+        dead = "http://127.0.0.1:1/announce"
+        job = TorrentJob(
+            info_hash=hashlib.sha1(b"backoff2").digest(),
+            trackers=(dead, seeder.tracker_url),
+            tracker_tiers=((dead,), (seeder.tracker_url,)),
+        )
+        downloader = SwarmDownloader(job, "/tmp", dht_bootstrap=())
+        far = time.monotonic() + 1000
+        downloader._tracker_backoff = {
+            dead: (far + 500, 15.0),  # further from retry
+            seeder.tracker_url: (far, 15.0),  # closest: gets the shot
+        }
+        peers = downloader._discover_peers(left=100, allow_empty=True)
+        assert seeder.peer_address in peers
+        # success cleared the live tracker's backoff; the dead one kept its
+        assert seeder.tracker_url not in downloader._tracker_backoff
+        assert dead in downloader._tracker_backoff
