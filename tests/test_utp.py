@@ -897,3 +897,71 @@ class TestDualStack:
                 client.connect(("::1", 9), timeout=1)
         finally:
             client.close()
+
+
+class TestDualStackFallback:
+    """v6-less hosts (containers with ipv6 disabled) must fall back to
+    plain AF_INET binds — simulated by denying AF_INET6 sockets."""
+
+    def _deny_v6(self, monkeypatch):
+        from downloader_tpu.fetch import dualstack
+
+        real_socket = socket.socket
+
+        def no_v6(family=socket.AF_INET, *args, **kwargs):
+            if family == socket.AF_INET6:
+                raise OSError(97, "Address family not supported")
+            return real_socket(family, *args, **kwargs)
+
+        monkeypatch.setattr(dualstack.socket, "socket", no_v6)
+
+    def test_udp_any_address_falls_back_to_v4(self, monkeypatch):
+        from downloader_tpu.fetch.dualstack import bind_dual_stack_udp
+
+        self._deny_v6(monkeypatch)
+        sock = bind_dual_stack_udp("", 0)
+        try:
+            assert sock.family == socket.AF_INET
+        finally:
+            sock.close()
+
+    def test_tcp_any_address_falls_back_to_v4(self, monkeypatch):
+        from downloader_tpu.fetch import dualstack
+
+        self._deny_v6(monkeypatch)
+        # create_server would bypass the denial; force the fallback
+        # branch the way a dual-stack-less platform reports it
+        monkeypatch.setattr(
+            dualstack.socket, "has_dualstack_ipv6", lambda: False
+        )
+        sock = dualstack.bind_dual_stack_tcp("", 0)
+        try:
+            assert sock.family == socket.AF_INET
+            assert sock.getsockname()[1] > 0
+        finally:
+            sock.close()
+
+    def test_mux_works_v4_only(self, monkeypatch):
+        """The whole uTP stream path still works when only v4 binds."""
+        from downloader_tpu.fetch import dualstack
+
+        self._deny_v6(monkeypatch)
+        monkeypatch.setattr(utp, "bind_dual_stack_udp", dualstack.bind_dual_stack_udp)
+        accepted: list = []
+        server = utp.UTPMultiplexer(host="", on_accept=accepted.append)
+        client = utp.UTPMultiplexer(host="")
+        try:
+            assert server.sock.family == socket.AF_INET
+            conn = client.connect(("127.0.0.1", server.port), timeout=5)
+            conn.settimeout(10)
+            deadline = time.monotonic() + 5
+            while not accepted and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert accepted
+            peer = accepted[0]
+            peer.settimeout(10)
+            conn.sendall(b"v4-only")
+            assert _recv_all(peer, 7) == b"v4-only"
+        finally:
+            server.close()
+            client.close()
