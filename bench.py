@@ -372,12 +372,26 @@ def run_ablation(
     }
 
 
-def run_latency(site: str, samples: int, concurrency: int) -> float:
+def run_latency(
+    site: str, samples: int, concurrency: int
+) -> tuple[float, dict]:
     """Per-job overhead: enqueue → Convert hand-off consumed, for a tiny
-    payload, one job in flight at a time. Returns the median in ms
+    payload, one job in flight at a time. Returns (median ms, per-stage
+    attribution) — the attribution comes from the span traces
+    (utils/tracing.py, enabled as in production), so a future overhead
+    regression names the stage that moved instead of printing one
+    unexplainable number (round 5's 2.3 → 4.3 ms had no attribution;
+    the A/B hunt showed it was host noise, but only after the fact).
     (BASELINE.md's "job-overhead latency (enqueue→ack for a tiny file)";
     the Convert arrives right after the ack-gating publish confirm, so it
     bounds the same path and is observable without daemon hooks)."""
+    from downloader_tpu.utils import tracing
+
+    tracing.TRACER.clear()  # drop traces from the throughput runs
+    # the attribution must describe the SAME sample set as the headline
+    # median: size the ring to hold every sample (default 64 would
+    # silently keep only the tail of a longer run)
+    tracing.TRACER.set_capacity(max(samples, tracing.DEFAULT_RING))
     pipeline = _Pipeline(concurrency, concurrency, site, payload="tiny.bin")
     try:
         laps: list[float] = []
@@ -387,7 +401,26 @@ def run_latency(site: str, samples: int, concurrency: int) -> float:
             pipeline.wait_converts(i + 1, timeout=60.0)
             laps.append((time.monotonic() - start) * 1000.0)
         laps.sort()
-        return laps[len(laps) // 2]
+        # the Convert can be consumed a beat before the job's trace
+        # completes (publish → sink callback races the ack + trace
+        # hand-off): give the final trace a moment to land
+        deadline = time.monotonic() + 2.0
+        while (
+            len(tracing.TRACER.recent()) < samples
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.005)
+        stages: dict[str, list[float]] = {}
+        for trace in tracing.TRACER.recent():
+            for child in trace["spans"].get("children", []):
+                stages.setdefault(child["name"], []).append(
+                    child["duration_ms"]
+                )
+        attribution = {
+            name: sorted(values)[len(values) // 2]
+            for name, values in sorted(stages.items())
+        }
+        return laps[len(laps) // 2], attribution
     finally:
         pipeline.close()
 
@@ -504,14 +537,26 @@ def main() -> None:
         tiny = os.path.join(site, "tiny.bin")
         with open(tiny, "wb") as sink:
             sink.write(os.urandom(64 * 1024))
-        latency_ms = run_latency(site, latency_samples, concurrency)
-        _log(f"bench: job overhead latency {latency_ms:.1f} ms (median)")
+        latency_ms, stage_attribution = run_latency(
+            site, latency_samples, concurrency
+        )
+        _log(
+            f"bench: job overhead latency {latency_ms:.1f} ms (median); "
+            f"stage medians {json.dumps(stage_attribution)}"
+        )
 
         extra_metrics = [
             {
                 "metric": "job_overhead_latency_ms",
                 "value": round(latency_ms, 1),
                 "unit": "ms",
+                # per-stage medians from the span traces: fetch is the
+                # source round trip, publish the confirm-gated Convert
+                # hand-off; dequeue/decode/ack (+ inter-stage gaps) are
+                # the framework's own overhead. A drift in the headline
+                # must show up in a named stage here.
+                "stages_ms": stage_attribution,
+                "tracing": "enabled",
             },
             {
                 # per-pair evidence for the contract number: one noisy

@@ -8,19 +8,23 @@ pipeline (cmd/downloader/downloader.go:116-147 without the AMQP wrapper).
 The reference's single CLI flag is ``-cpuprofile`` writing a pprof CPU
 profile (cmd/downloader/downloader.go:26,32-43); ``--cpuprofile`` here
 writes a cProfile dump readable with ``python -m pstats``.
+``--trace-out FILE`` dumps the per-job span trees (utils/tracing.py) as
+Chrome trace-event JSON on exit — load it in chrome://tracing or
+Perfetto to see where each job's wall-clock went.
 """
 
 from __future__ import annotations
 
 import argparse
 import cProfile
+import json
 import os
 import sys
 
 from .fetch import DispatchClient, HTTPBackend
 from .scan import scan_dir
 from .store import Uploader
-from .utils import configure_from_env, get_logger
+from .utils import configure_from_env, get_logger, tracing
 from .utils.cancel import CancelToken
 
 log = get_logger("cli")
@@ -32,6 +36,12 @@ def _build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="downloader_tpu")
     parser.add_argument(
         "--cpuprofile", default="", help="write a cProfile dump to this file"
+    )
+    parser.add_argument(
+        "--trace-out",
+        default="",
+        help="write per-job span traces as Chrome trace-event JSON "
+        "(chrome://tracing / Perfetto) to this file on exit",
     )
     sub = parser.add_subparsers(dest="command", required=True)
 
@@ -71,20 +81,29 @@ def _download_once(args: argparse.Namespace) -> int:
     base_dir = os.path.abspath(args.base_dir)
     dispatcher = DispatchClient(token, base_dir, _default_backends())
 
-    job_dir = dispatcher.download(args.id, args.url)
-    files = scan_dir(job_dir)
-    log.with_fields(count=len(files)).info("found media files")
-    for path in files:
-        print(path)
+    # one-shot runs get the same span tree as daemon jobs (minus the
+    # queue stages), so --trace-out answers "where did the time go"
+    # for a single job without standing up the broker
+    with tracing.TRACER.job(args.id) as trace:
+        with tracing.span("fetch", url=tracing.redact_url(args.url)):
+            job_dir = dispatcher.download(args.id, args.url)
+        with tracing.span("scan"):
+            files = scan_dir(job_dir)
+        log.with_fields(count=len(files)).info("found media files")
+        for path in files:
+            print(path)
 
-    if args.skip_upload:
-        return 0
+        if args.skip_upload:
+            trace.set_status("ok")
+            return 0
 
-    uploader = Uploader.from_env(args.bucket)
-    result = uploader.upload_files(token, args.id, files)
-    log.with_fields(
-        uploaded=len(result.uploaded), failed=len(result.failed)
-    ).info("upload complete")
+        uploader = Uploader.from_env(args.bucket)
+        with tracing.span("upload", files=len(files)):
+            result = uploader.upload_files(token, args.id, files)
+        log.with_fields(
+            uploaded=len(result.uploaded), failed=len(result.failed)
+        ).info("upload complete")
+        trace.set_status("ok" if not result.failed else "failed")
     return 0 if not result.failed else 1
 
 
@@ -198,6 +217,17 @@ def main(argv: list[str] | None = None) -> int:
     configure_from_env()
     args = _build_parser().parse_args(argv)
 
+    # honor the documented tracing knobs on EVERY command — serve()
+    # re-applies them from Config, but one-shot runs come through here
+    from .utils import flag_from_env
+
+    tracing.TRACER.enabled = flag_from_env("TRACE")
+    tracing.TRACER.set_capacity(
+        tracing.ring_from_value(
+            os.environ.get("TRACE_RING"), tracing.DEFAULT_RING
+        )
+    )
+
     profiler = None
     if args.cpuprofile:
         profiler = cProfile.Profile()
@@ -231,6 +261,13 @@ def main(argv: list[str] | None = None) -> int:
             profiler.disable()
             profiler.dump_stats(args.cpuprofile)
             log.info(f"wrote cpu profile to {args.cpuprofile}")
+        if args.trace_out:
+            try:
+                with open(args.trace_out, "w") as sink:
+                    json.dump(tracing.TRACER.chrome_trace(), sink)
+                log.info(f"wrote chrome trace to {args.trace_out}")
+            except OSError as exc:
+                log.error("failed to write trace file", exc=exc)
 
 
 if __name__ == "__main__":

@@ -35,7 +35,7 @@ from ..queue import QueueClient
 from ..queue.delivery import Delivery
 from ..scan import scan_dir
 from ..store import Uploader, UploadError
-from ..utils import metrics, configure_from_env, get_logger
+from ..utils import metrics, configure_from_env, get_logger, tracing
 from ..utils.cancel import Cancelled, CancelToken
 from ..wire import Convert, Download, WireError
 from .config import Config
@@ -82,23 +82,44 @@ class Daemon:
 
     def process_delivery(self, delivery: Delivery) -> None:
         started = time.monotonic()
-        try:
-            job = Download.unmarshal(delivery.body)
-        except WireError as exc:
-            log.with_field("event", "decode-message").error(
-                "failed to unmarshal message into protobuf format", exc=exc
+        # span tree per job: dequeue → decode → fetch → scan → upload →
+        # publish → ack, rooted here; backend internals (tracker
+        # announces, peer connects, webseed ranges, multipart parts)
+        # attach as descendants. Lands on /debug/jobs and feeds the
+        # per-stage latency histograms on completion.
+        with tracing.TRACER.job() as trace:
+            trace.record(
+                "dequeue", delivery.received_at, started,
+                queue=delivery.queue_name,
             )
-            delivery.nack()  # reference cmd:108: drop malformed
-            self.stats.bump(dropped=1)
-            return
+            self._process_traced(delivery, trace, started)
+
+    def _process_traced(
+        self, delivery: Delivery, trace, started: float
+    ) -> None:
+        with tracing.span("decode"):
+            try:
+                job = Download.unmarshal(delivery.body)
+            except WireError as exc:
+                log.with_field("event", "decode-message").error(
+                    "failed to unmarshal message into protobuf format", exc=exc
+                )
+                delivery.nack()  # reference cmd:108: drop malformed
+                self.stats.bump(dropped=1)
+                trace.set_status("dropped")
+                return
 
         if job.media is None or not job.media.id or not job.media.source_uri:
             log.error("download job has no usable media block; dropping")
             delivery.nack()
             self.stats.bump(dropped=1)
+            trace.set_status("dropped")
             return
 
         media = job.media
+        trace.annotate(
+            job_id=media.id, url=tracing.redact_url(media.source_uri)
+        )
         job_log = log.with_fields(id=media.id, url=media.source_uri)
         job_log.info("got message")
 
@@ -106,27 +127,38 @@ class Daemon:
             # pace retried jobs (the reference slept 10 s on the worker
             # before republishing, delivery.go:75; we delay on consume so
             # the broker, not a timer, owns the in-flight message)
-            if self._token.wait(self._config.retry_delay):
+            with tracing.span("retry-delay", retries=delivery.retries):
+                cancelled = self._token.wait(self._config.retry_delay)
+            if cancelled:
                 delivery.nack(requeue=True)  # shutting down; give it back
+                trace.set_status("requeued")
                 return
 
         try:
-            job_dir = self._dispatcher.download(media.id, media.source_uri)
-            files = scan_dir(job_dir)
+            with tracing.span(
+                "fetch", url=tracing.redact_url(media.source_uri)
+            ):
+                job_dir = self._dispatcher.download(media.id, media.source_uri)
+            with tracing.span("scan"):
+                files = scan_dir(job_dir)
             job_log.with_field("count", len(files)).info("found media files")
-            self._uploader.upload_files(self._token, media.id, files)
+            with tracing.span("upload", files=len(files)):
+                self._uploader.upload_files(self._token, media.id, files)
         except UnsupportedJobError as exc:
             job_log.error("unsupported job; dropping", exc=exc)
             delivery.nack()
             self.stats.bump(dropped=1)
+            trace.set_status("dropped")
             return
         except (TransferError, UploadError, OSError) as exc:
             if delivery.retries < self._config.max_job_retries:
                 job_log.with_field("retries", delivery.retries).error(
                     "job failed; scheduling retry", exc=exc
                 )
-                delivery.error()
+                with tracing.span("retry-republish"):
+                    delivery.error()
                 self.stats.bump(retried=1)
+                trace.set_status("retried")
             else:
                 job_log.error(
                     f"job failed after {delivery.retries} retries; dropping",
@@ -134,21 +166,24 @@ class Daemon:
                 )
                 delivery.nack()
                 self.stats.bump(failed=1)
+                trace.set_status("failed")
             return
         except Cancelled:
             # shutdown mid-job: requeue so another instance picks it up
             delivery.nack(requeue=True)
+            trace.set_status("requeued")
             return
 
         log.info("creating v1.convert message")
         convert = Convert(
             created_at=time.strftime("%Y-%m-%d %H:%M:%S %z"), media=media
         )
-        confirmed = self._client.publish(
-            self._config.publish_topic,
-            convert.marshal(),
-            wait=self._config.publish_confirm_timeout,
-        )
+        with tracing.span("publish"):
+            confirmed = self._client.publish(
+                self._config.publish_topic,
+                convert.marshal(),
+                wait=self._config.publish_confirm_timeout,
+            )
         if not confirmed:
             # the Convert hand-off is the job's whole point: never ack a
             # download whose pipeline hand-off is not durably on the
@@ -157,10 +192,13 @@ class Daemon:
             job_log.error("convert publish unconfirmed; requeueing job")
             delivery.nack(requeue=True)
             self.stats.bump(retried=1)
+            trace.set_status("requeued")
             return
         job_log.info("finished processing")
-        delivery.ack()
+        with tracing.span("ack"):
+            delivery.ack()
         self.stats.bump(processed=1)
+        trace.set_status("ok")
         # completed-job latency histogram (consume -> ack, including
         # the confirm-gated Convert hand-off); failed/retried attempts
         # are deliberately not mixed in — they would bimodalize the
@@ -267,6 +305,9 @@ def serve(
         config.bucket = bucket
     if concurrency:
         config.concurrency = concurrency
+
+    tracing.TRACER.enabled = config.trace
+    tracing.TRACER.set_capacity(config.trace_ring)
 
     token = token or CancelToken()
     if install_signal_handlers:

@@ -47,6 +47,8 @@ class Config:
     publish_confirm_timeout: float = 30.0  # Convert hand-off confirmation
     health_port: int = 0  # 0 = disabled
     health_host: str = "127.0.0.1"  # bind loopback unless told otherwise
+    trace: bool = True  # per-job span tracing (TRACE=off disables)
+    trace_ring: int = 64  # completed span trees kept for /debug/jobs
 
     @classmethod
     def from_env(cls, environ: Mapping[str, str] | None = None) -> "Config":
@@ -78,4 +80,11 @@ class Config:
         )
         config.health_port = int(env.get("HEALTH_PORT", config.health_port))
         config.health_host = env.get("HEALTH_HOST", config.health_host)
+        from ..utils import flag_from_env
+        from ..utils.tracing import ring_from_value
+
+        config.trace = flag_from_env("TRACE", env)
+        config.trace_ring = ring_from_value(
+            env.get("TRACE_RING"), config.trace_ring
+        )
         return config

@@ -11,6 +11,11 @@ server exposes:
   a wedged instance).
 - ``GET /metrics`` — Prometheus text exposition of the daemon and queue
   counters (no client library needed; the format is plain text).
+- ``GET /debug/jobs`` — per-job span trees (utils/tracing.py): the ring
+  of recently completed jobs plus a live in-flight view, so "where did
+  this job's time go" is answerable from a running daemon without a
+  profiler. ``GET /debug/trace`` serves the same data as Chrome
+  trace-event JSON (load in chrome://tracing or Perfetto).
 
 Enabled by ``HEALTH_PORT`` (0 = disabled, the default); binds loopback
 unless ``HEALTH_HOST`` says otherwise.
@@ -22,7 +27,7 @@ import http.server
 import json
 import threading
 
-from ..utils import get_logger, metrics
+from ..utils import get_logger, metrics, tracing
 
 log = get_logger("daemon.health")
 
@@ -38,12 +43,22 @@ class HealthServer:
                 pass
 
             def do_GET(self):
-                if self.path == "/healthz":
-                    code, body, ctype = health._healthz()
-                elif self.path == "/metrics":
-                    code, body, ctype = health._metrics()
-                else:
-                    code, body, ctype = 404, b"not found\n", "text/plain"
+                try:
+                    if self.path == "/healthz":
+                        code, body, ctype = health._healthz()
+                    elif self.path == "/metrics":
+                        code, body, ctype = health._metrics()
+                    elif self.path == "/debug/jobs":
+                        code, body, ctype = health._debug_jobs()
+                    elif self.path == "/debug/trace":
+                        code, body, ctype = health._debug_trace()
+                    else:
+                        code, body, ctype = 404, b"not found\n", "text/plain"
+                except Exception as exc:  # a view bug must answer, not abort
+                    log.error("health view failed", exc=exc)
+                    code, body, ctype = (
+                        500, b"internal error\n", "text/plain"
+                    )
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
@@ -102,6 +117,25 @@ class HealthServer:
         code = 200 if connected else 503
         return code, (json.dumps(payload) + "\n").encode(), "application/json"
 
+    def _debug_jobs(self) -> tuple[int, bytes, str]:
+        payload = {
+            "tracing_enabled": tracing.TRACER.enabled,
+            "in_flight": tracing.TRACER.in_flight(),
+            "recent": tracing.TRACER.recent(),
+        }
+        return (
+            200,
+            (json.dumps(payload, indent=1) + "\n").encode(),
+            "application/json",
+        )
+
+    def _debug_trace(self) -> tuple[int, bytes, str]:
+        return (
+            200,
+            (json.dumps(tracing.TRACER.chrome_trace()) + "\n").encode(),
+            "application/json",
+        )
+
     def _metrics(self) -> tuple[int, bytes, str]:
         lines = []
         for name, value in self._counters().items():
@@ -124,21 +158,36 @@ class HealthServer:
             metric = f"downloader_{name}"
             lines.append(f"# TYPE {metric} gauge")
             lines.append(f"{metric} {value:g}")
-        # fixed-bucket histograms (job latency), Prometheus exposition:
-        # cumulative le-buckets + _sum + _count. Seeded like the gauges:
-        # the series must exist from the first scrape — an idle (or
-        # only-failing) daemon must read as zero completions, not as
-        # "no data"
+        # fixed-bucket histograms, Prometheus exposition: cumulative
+        # le-buckets + _sum + _count, per-series bucket bounds (job
+        # latency uses job-scale buckets; the tracing layer's
+        # overhead_seconds uses ms-scale ones — see metrics.py).
+        # Seeded like the gauges: the series must exist from the first
+        # scrape — an idle (or only-failing) daemon must read as zero
+        # completions, not as "no data"
         histograms = {
-            "job_duration_seconds": (
-                [0] * len(metrics.LATENCY_BUCKETS), 0.0, 0,
+            **{
+                name: (
+                    metrics.LATENCY_BUCKETS,
+                    [0] * len(metrics.LATENCY_BUCKETS), 0.0, 0,
+                )
+                for name in (
+                    "job_duration_seconds", "fetch_seconds",
+                    "scan_seconds", "upload_seconds", "publish_seconds",
+                )
+            },
+            "overhead_seconds": (
+                metrics.OVERHEAD_BUCKETS,
+                [0] * len(metrics.OVERHEAD_BUCKETS), 0.0, 0,
             ),
             **metrics.GLOBAL.histograms(),
         }
-        for name, (counts, total, count) in sorted(histograms.items()):
+        for name, (bounds, counts, total, count) in sorted(
+            histograms.items()
+        ):
             metric = f"downloader_{name}"
             lines.append(f"# TYPE {metric} histogram")
-            for le, bucket_count in zip(metrics.LATENCY_BUCKETS, counts):
+            for le, bucket_count in zip(bounds, counts):
                 lines.append(
                     f'{metric}_bucket{{le="{le:g}"}} {bucket_count}'
                 )

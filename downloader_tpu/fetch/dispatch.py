@@ -35,7 +35,7 @@ import urllib.parse
 from dataclasses import dataclass, field
 from typing import Callable, Protocol
 
-from ..utils import get_logger
+from ..utils import get_logger, tracing
 from ..utils.cancel import CancelToken
 
 log = get_logger("fetch")
@@ -168,7 +168,12 @@ class DispatchClient:
         os.makedirs(job_dir, exist_ok=True)
 
         try:
-            backend.download(self._token, job_dir, self._progress.update, url)
+            with tracing.span(
+                "backend", backend=backend.register().name
+            ):
+                backend.download(
+                    self._token, job_dir, self._progress.update, url
+                )
         finally:
             # whatever happened, stop displaying this URL
             self._progress.update(url, 100.0)

@@ -77,7 +77,15 @@ def bind_dual_stack_tcp(host: str, port: int, backlog: int = 16) -> socket.socke
     listener = socket.socket(family, socket.SOCK_STREAM)
     try:
         listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        listener.bind(("0.0.0.0" if host in ("", "::") else host, port))
+        if host in ("", "0.0.0.0", "::"):
+            # any-address fallback: the bind host must match the socket
+            # family — an AF_INET6 socket cannot bind the v4 literal
+            # '0.0.0.0' (gaierror), it degrades to a v6-only listener
+            # on '::' instead
+            bind_host = "::" if family == socket.AF_INET6 else "0.0.0.0"
+        else:
+            bind_host = host
+        listener.bind((bind_host, port))
         listener.listen(backlog)
     except OSError:
         listener.close()

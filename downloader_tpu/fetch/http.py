@@ -32,7 +32,7 @@ try:
 except ImportError:  # non-Unix: the splice path is gated off with it
     fcntl = None  # type: ignore[assignment]
 
-from ..utils import get_logger, metrics
+from ..utils import get_logger, metrics, tracing
 from ..utils.netio import SocketWaiter
 from ..utils.cancel import Cancelled, CancelToken
 from .dispatch import BackendRegistration, ProgressFn
@@ -263,7 +263,8 @@ class HTTPBackend:
         while True:
             token.raise_if_cancelled()
             try:
-                response, offset = self._open(url, offset)
+                with tracing.span("http-request", offset=offset):
+                    response, offset = self._open(url, offset)
             except urllib.error.HTTPError as exc:
                 if exc.code < 500 and exc.code != 429:
                     # a deterministic 4xx answer: retrying won't change it
@@ -335,8 +336,12 @@ class HTTPBackend:
                             if total:
                                 progress(url, min(offset / total * 100, 99.9))
 
+                    body_span = tracing.span("http-body", offset=offset)
+                    span_start_offset = offset
                     try:
-                        with open(part_path, "r+b" if offset else "wb") as sink:
+                        with body_span, open(
+                            part_path, "r+b" if offset else "wb"
+                        ) as sink:
                             sink.seek(offset)
                             sock = _plain_socket_of(response)
                             if (
@@ -351,6 +356,7 @@ class HTTPBackend:
                                 # zero-copy path: drain the bytes the
                                 # header parse buffered, then splice the
                                 # rest kernel-side
+                                body_span.annotate(mode="splice")
                                 head = response.read1(_CHUNK_SIZE)
                                 if head:
                                     sink.write(head)
@@ -378,10 +384,18 @@ class HTTPBackend:
                                         response.length = max(
                                             0, response.length - unsup.moved
                                         )
+                                    body_span.annotate(mode="splice+userspace")
                                     sink.seek(offset)
                                     _copy_body(response, sink, token, tick)
                             else:
+                                body_span.annotate(mode="userspace")
                                 _copy_body(response, sink, token, tick)
+                            # bytes THIS attempt moved — a resumed
+                            # transfer's later spans must not re-count
+                            # the earlier attempts' bytes
+                            body_span.annotate(
+                                bytes=offset - span_start_offset
+                            )
                     except (urllib.error.URLError, OSError, TimeoutError) as exc:
                         token.raise_if_cancelled()  # closed by the cancel hook
                         attempts += 1

@@ -43,7 +43,7 @@ import struct
 import threading
 import time
 
-from ..utils import get_logger, metrics
+from ..utils import get_logger, metrics, tracing
 from ..utils.cancel import Cancelled, CancelToken
 from . import bencode, utp
 from .http import TransferError
@@ -190,6 +190,8 @@ class SwarmDownloader:
         self.listen_port: int | None = None
         self.blocks_served = 0
         self.bytes_served = 0
+        # job-thread span worker threads adopt (set by run())
+        self._trace_parent = None
 
     def _discover_peers(
         self,
@@ -267,12 +269,20 @@ class SwarmDownloader:
             # serially that is minutes before DHT fires. The cost is
             # more tracker traffic; the win is bounded discovery
             # latency.
+            announce_parent = tracing.current_span()
+
+            def pooled_announce(tracker: str) -> list[tuple[str, int]]:
+                # pool threads have no thread-local trace; attach their
+                # tracker-announce spans to the job that spawned them
+                with tracing.adopt(announce_parent):
+                    return one_announce(tracker)
+
             with concurrent.futures.ThreadPoolExecutor(
                 max_workers=min(8, len(self._job.trackers)),
                 thread_name_prefix="announce",
             ) as pool:
                 futures = {
-                    pool.submit(one_announce, tracker): tracker
+                    pool.submit(pooled_announce, tracker): tracker
                     for tracker in self._job.trackers
                 }
                 for future in concurrent.futures.as_completed(futures):
@@ -421,6 +431,11 @@ class SwarmDownloader:
         return peers
 
     def run(self, token: CancelToken, progress) -> None:
+        # the job thread's open span (the dispatcher's backend span, or
+        # None outside a traced job): worker threads spawned below
+        # adopt it so their spans (announces, peer connects, piece
+        # rounds, webseed ranges) attach to the job's trace
+        self._trace_parent = tracing.current_span()
         metrics.GLOBAL.gauge_add("torrent_active_swarms", 1)
         try:
             self._run_guarded(token, progress)
@@ -906,6 +921,12 @@ class SwarmDownloader:
     def _web_seed_worker(
         self, url: str, swarm: "_SwarmState", token: CancelToken
     ) -> None:
+        with tracing.adopt(self._trace_parent):
+            self._web_seed_worker_traced(url, swarm, token)
+
+    def _web_seed_worker_traced(
+        self, url: str, swarm: "_SwarmState", token: CancelToken
+    ) -> None:
         """One BEP 19 webseed: claim pieces like any worker, fetch them
         over HTTP Range, verify through the same batch path. Tolerates
         transient fetch failures (peers get retried via re-announce
@@ -970,6 +991,12 @@ class SwarmDownloader:
             swarm.tick_progress()
 
     def _peer_worker(self, swarm: "_SwarmState", token: CancelToken) -> None:
+        with tracing.adopt(self._trace_parent):
+            self._peer_worker_traced(swarm, token)
+
+    def _peer_worker_traced(
+        self, swarm: "_SwarmState", token: CancelToken
+    ) -> None:
         """One swarm worker: pull peers off the shared queue and serve
         claimable pieces from each until the swarm is done."""
         while not token.cancelled() and not swarm.done():
@@ -978,17 +1005,21 @@ class SwarmDownloader:
                 return  # no peers left to try
             host, port = peer
             try:
-                with PeerConnection(
-                    host,
-                    port,
-                    self._job.info_hash,
-                    self._peer_id,
-                    token,
-                    encryption=self._encryption,
-                    transport=self._transport,
-                    utp_mux=self._utp_mux,
-                    listen_port=self._advertise_port,
-                ) as conn:
+                # span covers the dial + handshake only; piece traffic
+                # gets its own spans in _serve_pieces
+                with tracing.span("peer-connect", peer=f"{host}:{port}"):
+                    conn = PeerConnection(
+                        host,
+                        port,
+                        self._job.info_hash,
+                        self._peer_id,
+                        token,
+                        encryption=self._encryption,
+                        transport=self._transport,
+                        utp_mux=self._utp_mux,
+                        listen_port=self._advertise_port,
+                    )
+                with conn:
                     swarm.register(conn)
                     try:
                         self._serve_pieces(conn, swarm, token)
@@ -1146,7 +1177,11 @@ class SwarmDownloader:
                         # endgame win on this piece frees us promptly
                         while conn.choked and not store.have[index]:
                             conn.poll_messages(0.05)
-                    data = self._download_piece(conn, store, index)
+                    # piece rounds: chatty on real torrents, so the
+                    # trace's span cap (MAX_SPANS_PER_TRACE) bounds
+                    # them; overflow is counted, not accumulated
+                    with tracing.span("piece", index=index):
+                        data = self._download_piece(conn, store, index)
                     if data is not None:
                         batch.add(index, data)
                         if swarm.endgame:

@@ -24,6 +24,7 @@ wheel install.
 
 from __future__ import annotations
 
+import atexit
 import ctypes
 import hashlib
 import os
@@ -120,8 +121,14 @@ def _cache_dir() -> str:
         except OSError:
             continue
     # last resort: a fresh private directory (0700 by construction);
-    # per-process, so the cache is cold every run — safe over fast
-    return tempfile.mkdtemp(prefix="downloader_tpu-")
+    # per-process, so the cache is cold every run — safe over fast.
+    # Removed at interpreter exit: on hosts whose $HOME/XDG cache is
+    # permanently unusable this path runs EVERY process, and without
+    # cleanup each run would strand one directory (plus a compiled
+    # .so) in the tempdir forever
+    path = tempfile.mkdtemp(prefix="downloader_tpu-")
+    atexit.register(shutil.rmtree, path, ignore_errors=True)
+    return path
 
 
 def _resource_bytes(name: str) -> bytes | None:

@@ -16,7 +16,8 @@ import time
 import urllib.parse
 import urllib.request
 
-from ..utils import get_logger
+from ..utils import get_logger, tracing
+from ..utils.tracing import redact_url
 from . import bencode
 from .http import TransferError
 
@@ -60,7 +61,9 @@ def announce(
     separator = "&" if "?" in tracker_url else "?"
     url = f"{tracker_url}{separator}{query}"
     try:
-        with urllib.request.urlopen(url, timeout=timeout) as response:
+        with tracing.span(
+            "tracker-announce", tracker=redact_url(tracker_url), event=event
+        ), urllib.request.urlopen(url, timeout=timeout) as response:
             body = response.read()
     except (urllib.error.URLError, OSError) as exc:
         raise TransferError(f"tracker announce failed: {exc}") from exc
@@ -212,7 +215,9 @@ def announce_udp(
         raise TransferError(
             f"udp tracker socket failed: {tracker_url}: {exc}"
         ) from exc
-    with sock:
+    with sock, tracing.span(
+        "tracker-announce", tracker=redact_url(tracker_url), event=event
+    ):
         try:
             tid = struct.unpack(">I", secrets.token_bytes(4))[0]
             reply = _udp_roundtrip(

@@ -272,6 +272,7 @@ class QueueClient:
             publisher=self.publish,
             publish_confirm_timeout=self._publish_confirm_timeout,
         )
+        delivery.queue_name = shard.queue_name  # for the job trace root
         shard.sink.put(delivery)
 
     def _on_settled(self, delivery: Delivery) -> None:

@@ -19,6 +19,7 @@ header (delivery.go:31-42, tolerating missing/garbage values) and exposes:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable
 
 from ..utils import get_logger
@@ -40,6 +41,13 @@ class Delivery:
     ):
         self.message = message
         self.body = message.body
+        # when this delivery entered the consumer (monotonic): the gap
+        # to worker pickup is the job trace's "dequeue" span — queueing
+        # delay inside this process, invisible to end-to-end timing
+        self.received_at = time.monotonic()
+        # the shard queue it arrived on; the queue client stamps this
+        # right after construction (observability only)
+        self.queue_name = ""
         retries = message.headers.get(RETRY_HEADER, 0)
         self.retries = retries if isinstance(retries, int) else 0
         self._channel = channel

@@ -21,6 +21,8 @@ import re
 from pathlib import Path
 from typing import Iterable, List
 
+from ..utils import tracing
+
 MEDIA_EXTENSIONS = frozenset({".mp4", ".mkv", ".mov", ".webm"})
 
 _ALLOWED_DIR_SUBSTRINGS = ("season",)
@@ -42,30 +44,34 @@ def scan_dir(path: str | os.PathLike[str]) -> List[str]:
     ReadDir error.
     """
     root = Path(path)
-    # follow_symlinks=False throughout: the reference's filepath.Walk lstats
-    # entries and never follows directory symlinks, so a symlink loop inside
-    # a download cannot hang or crash the scan.
-    top_level_dirs = [
-        entry.name
-        for entry in os.scandir(root)
-        if entry.is_dir(follow_symlinks=False)
-    ]
+    with tracing.span("scan-walk") as walk_span:
+        # follow_symlinks=False throughout: the reference's filepath.Walk
+        # lstats entries and never follows directory symlinks, so a symlink
+        # loop inside a download cannot hang or crash the scan.
+        top_level_dirs = [
+            entry.name
+            for entry in os.scandir(root)
+            if entry.is_dir(follow_symlinks=False)
+        ]
 
-    # A single top-level directory is treated as allowed, so archives that
-    # unpack into "Title/..." still get scanned (process.go:49-52).
-    extra_allowed = tuple(top_level_dirs) if len(top_level_dirs) == 1 else ()
+        # A single top-level directory is treated as allowed, so archives
+        # that unpack into "Title/..." still get scanned (process.go:49-52).
+        extra_allowed = (
+            tuple(top_level_dirs) if len(top_level_dirs) == 1 else ()
+        )
 
-    found: List[str] = []
+        found: List[str] = []
 
-    def walk(directory: Path) -> None:
-        for entry in sorted(os.scandir(directory), key=lambda e: e.name):
-            entry_path = directory / entry.name
-            if entry.is_dir(follow_symlinks=False):
-                if _dir_allowed(entry.name, extra_allowed):
-                    walk(entry_path)
-                continue
-            if os.path.splitext(entry.name)[1] in MEDIA_EXTENSIONS:
-                found.append(str(entry_path))
+        def walk(directory: Path) -> None:
+            for entry in sorted(os.scandir(directory), key=lambda e: e.name):
+                entry_path = directory / entry.name
+                if entry.is_dir(follow_symlinks=False):
+                    if _dir_allowed(entry.name, extra_allowed):
+                        walk(entry_path)
+                    continue
+                if os.path.splitext(entry.name)[1] in MEDIA_EXTENSIONS:
+                    found.append(str(entry_path))
 
-    walk(root)
+        walk(root)
+        walk_span.annotate(found=len(found))
     return found

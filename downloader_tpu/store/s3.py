@@ -26,7 +26,7 @@ import time
 import urllib.parse
 from typing import BinaryIO, Mapping
 
-from ..utils import zero_copy_from_env
+from ..utils import tracing, zero_copy_from_env
 from ..utils.cancel import CancelToken
 from ..utils.netio import SocketWaiter
 from . import sigv4
@@ -399,15 +399,16 @@ class S3Client:
                     # pass over the window, same trade as the single-PUT
                     # sign_payload path
                     payload_hash = self._part_hash(stream, base + offset, length)
-                status, body, headers = self._request(
-                    "PUT",
-                    path,
-                    query={"partNumber": str(number), "uploadId": upload_id},
-                    body=stream,
-                    content_length=length,
-                    payload_hash=payload_hash,
-                    token=token,
-                )
+                with tracing.span("s3-part", part=number, bytes=length):
+                    status, body, headers = self._request(
+                        "PUT",
+                        path,
+                        query={"partNumber": str(number), "uploadId": upload_id},
+                        body=stream,
+                        content_length=length,
+                        payload_hash=payload_hash,
+                        token=token,
+                    )
                 if status not in (200, 201, 204):
                     raise S3Error(
                         status,

@@ -25,14 +25,24 @@ from collections import defaultdict
 LATENCY_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0,
                    120.0, 300.0, 600.0)
 
+# buckets (seconds) for ms-scale per-job framework overhead: the whole
+# point of the overhead_seconds series is alerting on a 2.3 → 4.3 ms
+# drift (round-5 verdict), which the job-scale buckets above would fold
+# entirely into their first le=0.01 bucket — percentiles pinned, alert
+# blind
+OVERHEAD_BUCKETS = (0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                    0.025, 0.05, 0.1, 0.5, 1.0, 5.0)
+
 
 class Counters:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._values: "defaultdict[str, int]" = defaultdict(int)
         self._gauges: "defaultdict[str, float]" = defaultdict(float)
-        # name -> (bucket counts parallel to LATENCY_BUCKETS, sum, count)
-        self._hists: dict[str, tuple[list[int], float, int]] = {}
+        # name -> (le-bucket bounds, counts parallel to them, sum, count)
+        self._hists: dict[
+            str, tuple[tuple[float, ...], list[int], float, int]
+        ] = {}
 
     def add(self, name: str, value: int = 1) -> None:
         with self._lock:
@@ -47,17 +57,25 @@ class Counters:
         with self._lock:
             self._gauges[name] = value
 
-    def observe(self, name: str, value: float) -> None:
+    def observe(
+        self,
+        name: str,
+        value: float,
+        buckets: tuple[float, ...] = LATENCY_BUCKETS,
+    ) -> None:
         """Record one sample into the fixed-bucket histogram ``name``
-        (cumulative le-buckets, Prometheus semantics)."""
+        (cumulative le-buckets, Prometheus semantics). ``buckets`` is
+        fixed at the first observation; later calls reuse the stored
+        bounds (mixing bucket layouts per series is undefined in
+        Prometheus anyway)."""
         with self._lock:
-            counts, total, count = self._hists.get(
-                name, ([0] * len(LATENCY_BUCKETS), 0.0, 0)
+            bounds, counts, total, count = self._hists.get(
+                name, (buckets, [0] * len(buckets), 0.0, 0)
             )
-            for i, le in enumerate(LATENCY_BUCKETS):
+            for i, le in enumerate(bounds):
                 if value <= le:
                     counts[i] += 1
-            self._hists[name] = (counts, total + value, count + 1)
+            self._hists[name] = (bounds, counts, total + value, count + 1)
 
     def snapshot(self) -> dict[str, int]:
         with self._lock:
@@ -67,11 +85,14 @@ class Counters:
         with self._lock:
             return dict(self._gauges)
 
-    def histograms(self) -> dict[str, tuple[list[int], float, int]]:
+    def histograms(
+        self,
+    ) -> dict[str, tuple[tuple[float, ...], list[int], float, int]]:
         with self._lock:
             return {
-                name: (list(counts), total, count)
-                for name, (counts, total, count) in self._hists.items()
+                name: (bounds, list(counts), total, count)
+                for name, (bounds, counts, total, count)
+                in self._hists.items()
             }
 
     def reset(self) -> None:

@@ -141,3 +141,51 @@ def test_zipapp_ships_and_extracts_native_rc4(tmp_path):
     )
     assert result.returncode == 0
     assert list((cache / "downloader_tpu").glob("_rc4-*.so")) == extracted
+
+
+def test_cache_dir_last_resort_mkdtemp_is_cleaned_at_exit(tmp_path, monkeypatch):
+    """Hosts whose $HOME/XDG cache AND per-uid tempdir candidate are
+    unusable fall back to a fresh mkdtemp per process; pre-fix that
+    directory (plus any compiled .so inside) leaked on every run
+    (advisor finding, rc4_native.py:143). The fallback must register
+    the directory for removal at interpreter exit."""
+    import os
+    import shutil as shutil_mod
+    import tempfile
+
+    from downloader_tpu.fetch import rc4_native
+
+    # candidate 1 (XDG cache) fails: parent is not a directory
+    blocker = tmp_path / "blocker"
+    blocker.write_text("not a dir")
+    monkeypatch.setenv("XDG_CACHE_HOME", str(blocker / "cache"))
+    # candidate 2 (tempdir/downloader_tpu-<uid>) fails the permission
+    # check: pre-created group/other-writable (squat simulation)
+    fake_tmp = tmp_path / "tmp"
+    fake_tmp.mkdir()
+    uid = os.getuid()
+    squatted = fake_tmp / f"downloader_tpu-{uid}"
+    squatted.mkdir(mode=0o700)
+    squatted.chmod(0o777)
+    monkeypatch.setattr(tempfile, "gettempdir", lambda: str(fake_tmp))
+
+    registered = []
+    monkeypatch.setattr(
+        rc4_native.atexit, "register", lambda fn, *a, **kw: registered.append((fn, a, kw))
+    )
+    path = rc4_native._cache_dir()
+    try:
+        # fell through to the mkdtemp fallback inside the fake tempdir
+        assert os.path.dirname(path) == str(fake_tmp)
+        assert os.path.basename(path).startswith("downloader_tpu-")
+        assert path != str(squatted)
+        # and the directory is registered for cleanup at exit
+        assert registered, "mkdtemp fallback not registered with atexit"
+        fn, args, kwargs = registered[0]
+        assert fn is shutil_mod.rmtree
+        assert args[0] == path
+        assert kwargs.get("ignore_errors") is True
+        fn(*args, **kwargs)  # run the cleanup: directory goes away
+        assert not os.path.exists(path)
+    finally:
+        shutil_mod.rmtree(path, ignore_errors=True)

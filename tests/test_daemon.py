@@ -226,7 +226,14 @@ def test_serve_end_to_end_over_amqp(file_server, tmp_path, monkeypatch):
         producer = AmqpConnection.dial(amqp.endpoint, username="u", password="p")
         channel = producer.channel()
         body = Download(media=Media(id="sv-1", source_uri=f"{file_server.base}/movie.mkv")).marshal()
-        assert wait_for(lambda: amqp.broker.queue_depth("v1.download-0") == 0 and "v1.download" in amqp.broker._exchanges)
+        # serve() startup includes backend construction (shared DHT
+        # node, listener binds); on a loaded 1-vCPU host that can
+        # exceed the default 10 s — seen flaking under parallel load
+        assert wait_for(
+            lambda: amqp.broker.queue_depth("v1.download-0") == 0
+            and "v1.download" in amqp.broker._exchanges,
+            timeout=30,
+        )
         channel.publish("v1.download", "v1.download-0", body)
 
         key = f"sv-1/original/{base64.b64encode(b'movie.mkv').decode()}"

@@ -195,6 +195,24 @@ class TestUploader:
         )
         assert "newbucket" in stub.buckets
 
+    def test_bucket_cache_rearms_after_midrun_deletion(self, stub, tmp_path):
+        """The once-per-process bucket-ensure cache (span-trace hunt:
+        a bucket_exists round trip per job) must RE-ARM when an upload
+        fails — a bucket deleted mid-run (lifecycle policy) has to be
+        auto-recreated on the next batch, as before the cache."""
+        uploader = Uploader("rearm", client_for(stub))
+        files = self.make_files(tmp_path, ["a.mkv"])
+        uploader.upload_files(CancelToken(), "m1", files)
+        assert "rearm" in stub.buckets
+
+        del stub.buckets["rearm"]  # operator/lifecycle deletion
+        with pytest.raises(UploadError):
+            uploader.upload_files(CancelToken(), "m2", files)
+        # cache re-armed: the next batch recreates the bucket and lands
+        result = uploader.upload_files(CancelToken(), "m3", files)
+        assert len(result.uploaded) == 1 and not result.failed
+        assert "rearm" in stub.buckets
+
     def test_partial_failure_skips_and_reports(self, stub, tmp_path):
         files = self.make_files(tmp_path, ["ok.mkv"]) + [str(tmp_path / "missing.mkv")]
         result = Uploader("b", client_for(stub)).upload_files(

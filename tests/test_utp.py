@@ -941,6 +941,30 @@ class TestDualStackFallback:
         finally:
             sock.close()
 
+    def test_tcp_v6_any_address_degrades_to_v6_listener(self, monkeypatch):
+        """has_dualstack_ipv6() false with v6 AVAILABLE: the fallback
+        picks '::' as the AF_INET6 socket's bind host. The pre-fix code
+        bound '0.0.0.0' on the v6 socket — gaierror, listener dead
+        instead of degraded (advisor finding, dualstack.py:80)."""
+        from downloader_tpu.fetch import dualstack
+
+        probe = socket.socket(socket.AF_INET6, socket.SOCK_STREAM)
+        try:
+            probe.bind(("::", 0))
+        except OSError:
+            pytest.skip("host cannot bind AF_INET6")
+        finally:
+            probe.close()
+        monkeypatch.setattr(
+            dualstack.socket, "has_dualstack_ipv6", lambda: False
+        )
+        sock = dualstack.bind_dual_stack_tcp("::", 0)
+        try:
+            assert sock.family == socket.AF_INET6
+            assert sock.getsockname()[1] > 0
+        finally:
+            sock.close()
+
     def test_mux_works_v4_only(self, monkeypatch):
         """The whole uTP stream path still works when only v4 binds."""
         from downloader_tpu.fetch import dualstack
