@@ -33,7 +33,9 @@ tightening per-pair ratio spread from ~0.1 to ~0.03),
 BENCH_CONCURRENCY (default 6), BENCH_SLICES (alternating sub-runs per
 pair, default 4), BENCH_REPEATS (pairs, default 5), BENCH_DIR (default
 /dev/shm if present), BENCH_ABLATION=0 to skip the sub-ratio ablation,
-BENCH_ABLATION_REPEATS (interleaved triples, default 3).
+BENCH_ABLATION_REPEATS (interleaved triples, default 3), BENCH_PIPELINE=0
+to skip the streaming-pipeline ablation, BENCH_PIPELINE_REPEATS
+(interleaved pipelined/store-and-forward pairs, default 3).
 
 On the measurement noise: this box's absolute throughput swings ~3x on
 multi-second timescales (the same configuration has measured 85 and 580
@@ -165,6 +167,10 @@ class _Pipeline:
         site: str,
         zero_copy: bool = True,
         payload: str = "payload.mkv",
+        pipeline: bool | None = None,
+        multipart_threshold: int | None = None,
+        part_size: int | None = None,
+        part_workers: int | None = None,
     ):
         self.token = CancelToken()
         self.payload = payload
@@ -196,15 +202,29 @@ class _Pipeline:
                     )
                 ],
             )
-            uploader = Uploader(
+            client_kwargs = {}
+            if multipart_threshold is not None:
+                client_kwargs["multipart_threshold"] = multipart_threshold
+            if part_size is not None:
+                client_kwargs["part_size"] = part_size
+            self.uploader = Uploader(
                 self.config.bucket,
                 S3Client(
                     stub_endpoint,
                     Credentials("bench", "bench"),
                     zero_copy=zero_copy,
+                    **client_kwargs,
                 ),
             )
-            daemon = Daemon(self.token, self.client, dispatcher, uploader, self.config)
+            if pipeline is not None:
+                # pin the streaming pipeline explicitly (the ablation's
+                # two arms); None leaves the production from-env default
+                self.uploader.configure_pipeline(
+                    pipeline, part_workers=part_workers
+                )
+            daemon = Daemon(
+                self.token, self.client, dispatcher, self.uploader, self.config
+            )
             self.runner = threading.Thread(target=daemon.run, daemon=True)
             self.runner.start()
 
@@ -264,6 +284,9 @@ class _Pipeline:
         runner = getattr(self, "runner", None)
         if runner is not None:
             runner.join(timeout=30)
+        uploader = getattr(self, "uploader", None)
+        if uploader is not None:
+            uploader.close()  # the part pool must not outlive the run
         for proc in (self.httpd, self.stub_proc):
             if proc is not None:
                 proc.kill()
@@ -278,11 +301,14 @@ def run_config(
     prefetch: int,
     site: str,
     zero_copy: bool = True,
+    **pipeline_kwargs,
 ) -> tuple[float, float]:
     """Drain ``jobs`` download jobs through the full daemon pipeline;
     returns (MB moved, seconds) end-to-end (first enqueue → last
     Convert consumed) so callers can aggregate across runs."""
-    pipeline = _Pipeline(concurrency, prefetch, site, zero_copy=zero_copy)
+    pipeline = _Pipeline(
+        concurrency, prefetch, site, zero_copy=zero_copy, **pipeline_kwargs
+    )
     try:
         start = time.monotonic()
         for i in range(jobs):
@@ -369,6 +395,68 @@ def run_ablation(
         ),
         "concurrency": concurrency,
         "triples": triples,
+    }
+
+
+def run_pipeline_ablation(
+    jobs: int,
+    mb_per_job: int,
+    concurrency: int,
+    site: str,
+    repeats: int,
+) -> dict:
+    """The streaming-pipeline ablation: pipelined (multipart parts ship
+    while the fetch runs) vs store-and-forward (fetch completes, then
+    upload), INTERLEAVED pairs with per-pair ratios and the median
+    reported — the same noise defense as the headline.
+
+    Both arms run the identical multipart shape (threshold/part size
+    pinned small enough that the bench payload takes the multipart
+    path), so the ratio isolates the overlap itself rather than
+    conflating it with single-PUT-vs-multipart differences."""
+    part_mb = 8 * 1024 * 1024
+    arms = dict(
+        concurrency=concurrency,
+        prefetch=concurrency,
+        multipart_threshold=part_mb,
+        part_size=part_mb,
+    )
+    pairs: list[dict] = []
+    for i in range(repeats):
+        moved, took = run_config(
+            jobs, mb_per_job, site=site, pipeline=False, **arms
+        )
+        store_forward = moved / took
+        moved, took = run_config(
+            jobs,
+            mb_per_job,
+            site=site,
+            pipeline=True,
+            part_workers=concurrency,
+            **arms,
+        )
+        pipelined = moved / took
+        pairs.append(
+            {
+                "MBps": {
+                    "store_and_forward": round(store_forward, 1),
+                    "pipelined": round(pipelined, 1),
+                },
+                "ratio": round(pipelined / store_forward, 2),
+            }
+        )
+        _log(
+            f"bench: pipeline pair {i + 1}: store-and-forward "
+            f"{store_forward:.1f} MB/s, pipelined {pipelined:.1f} MB/s, "
+            f"ratio {pairs[-1]['ratio']:.2f}"
+        )
+    ordered = sorted(pair["ratio"] for pair in pairs)
+    return {
+        "metric": "pipeline_overlap",
+        "pipelined_vs_store_forward": ordered[len(ordered) // 2],
+        "part_size_mb": part_mb // (1024 * 1024),
+        "concurrency": concurrency,
+        "pairs": pairs,
     }
 
 
@@ -532,6 +620,25 @@ def main() -> None:
                 f"{ablation['concurrency_ratio_zero_copy']:.2f}x"
             )
 
+        pipeline_ablation = None
+        if os.environ.get("BENCH_PIPELINE", "1") != "0":
+            pipeline_repeats = max(
+                1, int(os.environ.get("BENCH_PIPELINE_REPEATS", 3))
+            )
+            pipeline_jobs = min(jobs, max(concurrency, jobs // max(1, slices)))
+            _log(
+                f"bench: pipeline ablation, {pipeline_repeats} interleaved "
+                f"pairs of {pipeline_jobs} jobs x {mb_per_job} MB per config"
+            )
+            pipeline_ablation = run_pipeline_ablation(
+                pipeline_jobs, mb_per_job, concurrency, site, pipeline_repeats
+            )
+            _log(
+                "bench: pipeline ablation median: pipelined vs "
+                "store-and-forward "
+                f"{pipeline_ablation['pipelined_vs_store_forward']:.2f}x"
+            )
+
         latency_samples = max(3, int(os.environ.get("BENCH_LATENCY_SAMPLES", 15)))
         _log(f"bench: per-job overhead latency, {latency_samples} tiny jobs")
         tiny = os.path.join(site, "tiny.bin")
@@ -572,6 +679,8 @@ def main() -> None:
         ]
         if ablation is not None:
             extra_metrics.append(ablation)
+        if pipeline_ablation is not None:
+            extra_metrics.append(pipeline_ablation)
         if os.environ.get("BENCH_DIGEST", "1") != "0":
             _log("bench: digest kernel micro-benchmark (pallas vs hashlib)")
             try:

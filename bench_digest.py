@@ -67,6 +67,7 @@ def measure(
         # hangs a bare jax.devices() forever; the bench must degrade to
         # a reported failure, not stall the whole driver run
         device = _devices_with_timeout()[0]
+        result["device"] = str(device)
         engine = DigestEngine()
         hashlib_bps, transfer_bps, sync_s = engine._calibrate()
         result["transfer_MBps"] = round(transfer_bps / 1e6, 1)
@@ -208,6 +209,14 @@ def measure(
                 )
     except Exception as exc:  # pragma: no cover - device-dependent
         _log(f"bench_digest: device path unavailable ({exc})")
+        # structured probe outcome: when accelerator init times out (a
+        # wedged runtime parks jax.devices(), seen in BENCH_r05) the
+        # bench JSON must record WHY the device numbers are missing,
+        # not just warn on a stderr stream nobody archives. setdefault:
+        # a failure AFTER device resolution keeps the resolved name,
+        # with the reason explaining the missing kernel numbers
+        result.setdefault("device", "unavailable")
+        result["device_reason"] = f"{type(exc).__name__}: {exc}"
         if "hashlib_GBps" not in result:
             return None
     return result

@@ -31,6 +31,7 @@ import time
 from dataclasses import dataclass, field
 
 from ..fetch import DispatchClient, TransferError, UnsupportedJobError
+from ..fetch import progress as transfer_progress
 from ..queue import QueueClient
 from ..queue.delivery import Delivery
 from ..scan import scan_dir
@@ -134,16 +135,29 @@ class Daemon:
                 trace.set_status("requeued")
                 return
 
+        # streaming fetch→upload pipeline: the session consumes the
+        # fetch backends' progress reports (write offsets, verified
+        # piece spans) and ships S3 multipart parts while the fetch is
+        # still running — job transfer time becomes max(fetch, upload)
+        # instead of fetch + upload. None when PIPELINE=off; every
+        # failure path converges on session.close(), which aborts any
+        # speculative multipart upload not explicitly completed.
+        session = self._uploader.streaming_session(media.id, self._token)
         try:
             with tracing.span(
                 "fetch", url=tracing.redact_url(media.source_uri)
-            ):
+            ), transfer_progress.install(session):
                 job_dir = self._dispatcher.download(media.id, media.source_uri)
             with tracing.span("scan"):
                 files = scan_dir(job_dir)
             job_log.with_field("count", len(files)).info("found media files")
             with tracing.span("upload", files=len(files)):
-                self._uploader.upload_files(self._token, media.id, files)
+                # completes streams the scan accepted, aborts streams
+                # it rejected; completed files skip store-and-forward
+                streamed = session.finalize(files) if session else {}
+                self._uploader.upload_files(
+                    self._token, media.id, files, streamed=streamed
+                )
         except UnsupportedJobError as exc:
             job_log.error("unsupported job; dropping", exc=exc)
             delivery.nack()
@@ -173,6 +187,9 @@ class Daemon:
             delivery.nack(requeue=True)
             trace.set_status("requeued")
             return
+        finally:
+            if session is not None:
+                session.close()
 
         log.info("creating v1.convert message")
         convert = Convert(
@@ -347,6 +364,7 @@ def serve(
     finally:
         if health is not None:
             health.stop()
+        uploader.close()  # drains the streaming pipeline's part pool
         for backend in backends:
             backend_close = getattr(backend, "close", None)
             if backend_close is not None:

@@ -35,6 +35,7 @@ except ImportError:  # non-Unix: the splice path is gated off with it
 from ..utils import get_logger, metrics, tracing
 from ..utils.netio import SocketWaiter
 from ..utils.cancel import Cancelled, CancelToken
+from . import progress as transfer_progress
 from .dispatch import BackendRegistration, ProgressFn
 
 log = get_logger("fetch.http")
@@ -259,6 +260,14 @@ class HTTPBackend:
         part_path: str | None = None
         final_path: str | None = None
         last_tick = time.monotonic()
+        # streaming-upload hand-off (fetch/progress.py): advertise the
+        # contiguous write offset so the store can ship multipart parts
+        # while this transfer is still running. No-op outside a job
+        # with an installed sink.
+        stream_sink = transfer_progress.current()
+        announced = False
+        reported_high = 0
+        sink_file: list = [None]  # the open part file, for flush-before-report
 
         while True:
             token.raise_if_cancelled()
@@ -325,11 +334,34 @@ class HTTPBackend:
 
                     total = _total_size(response, offset)
 
+                    if announced and offset < reported_high:
+                        # restarted below bytes already advertised (the
+                        # server ignored our Range, or the partial file
+                        # vanished): this response may re-send DIFFERENT
+                        # bytes than the ones speculatively uploaded —
+                        # the stream consumer must discard them
+                        stream_sink.invalidate(final_path)
+                        reported_high = 0
+                    if not announced and total:
+                        stream_sink.begin_file(
+                            final_path, total, read_path=part_path
+                        )
+                        announced = True
+
                     def tick(got: int) -> None:
-                        nonlocal offset, last_tick
+                        nonlocal offset, last_tick, reported_high
                         if token.cancelled():
                             raise Cancelled()
                         offset += got
+                        if announced and offset > reported_high:
+                            # only fd-flushed bytes may be advertised: a
+                            # concurrent part reader sees the file through
+                            # its own descriptor, not our write buffer
+                            flushable = sink_file[0]
+                            if flushable is not None:
+                                flushable.flush()
+                            reported_high = offset
+                            stream_sink.advance(final_path, offset)
                         now = time.monotonic()
                         if now - last_tick >= self._progress_interval:
                             last_tick = now
@@ -342,6 +374,7 @@ class HTTPBackend:
                         with body_span, open(
                             part_path, "r+b" if offset else "wb"
                         ) as sink:
+                            sink_file[0] = sink
                             sink.seek(offset)
                             sock = _plain_socket_of(response)
                             if (
@@ -397,6 +430,7 @@ class HTTPBackend:
                                 bytes=offset - span_start_offset
                             )
                     except (urllib.error.URLError, OSError, TimeoutError) as exc:
+                        sink_file[0] = None
                         token.raise_if_cancelled()  # closed by the cancel hook
                         attempts += 1
                         if attempts > self._max_resume_attempts:
@@ -423,7 +457,10 @@ class HTTPBackend:
                 continue
             break
 
+        sink_file[0] = None
         os.replace(part_path, final_path)
+        if announced:
+            stream_sink.finish_file(final_path)
         metrics.GLOBAL.add("http_bytes_fetched", offset)
         metrics.GLOBAL.add("http_files_fetched")
         progress(url, 100.0)

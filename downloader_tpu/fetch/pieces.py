@@ -15,6 +15,7 @@ import threading
 
 from ..parallel import DigestEngine, default_engine
 from ..utils import get_logger, metrics
+from . import progress as transfer_progress
 from .http import TransferError
 from .peerwire import PeerProtocolError
 
@@ -95,6 +96,16 @@ class PieceStore:
         # piece-complete callbacks (index) — the inbound listener hangs
         # its HAVE broadcast here so remote leechers learn of new pieces
         self._observers: list = []
+        # streaming-upload hand-off: captured at construction (the
+        # SwarmDownloader builds the store on the job thread, where the
+        # job's sink is installed); verified piece spans are reported
+        # from whatever worker thread wins them — sinks are thread-safe.
+        # Pieces are SHA-1 verified before write, so unlike the HTTP
+        # write offset these spans can ship out of order safely.
+        self._transfer_sink = transfer_progress.current()
+        for (path, length), is_pad in zip(self.files, self.pad_file):
+            if not is_pad and length > 0:
+                self._transfer_sink.begin_file(path, length)
 
     def add_observer(self, callback) -> None:
         self._observers.append(callback)
@@ -138,6 +149,24 @@ class PieceStore:
                 out.append((None if is_pad else parts, lo - file_start, hi - lo))
             file_start = file_end
         return out
+
+    def _report_verified(self, index: int) -> None:
+        """Advertise one verified piece's on-disk byte ranges to the
+        job's transfer sink (streaming upload): per overlapped file,
+        the file-relative span the piece covers. Pad ranges are never
+        on disk and never advertised."""
+        if self._transfer_sink is transfer_progress.NOOP:
+            return  # keep the per-piece hot path free of the file walk
+        offset = index * self.piece_length
+        size = self.piece_size(index)
+        file_start = 0
+        for (path, length), is_pad in zip(self.files, self.pad_file):
+            file_end = file_start + length
+            lo = max(offset, file_start)
+            hi = min(offset + size, file_end)
+            if lo < hi and not is_pad:
+                self._transfer_sink.add_span(path, lo - file_start, hi - file_start)
+            file_start = file_end
 
     def read_piece(self, index: int, handles: dict | None = None) -> bytes | None:
         """Read one piece back from the on-disk file layout.
@@ -232,6 +261,7 @@ class PieceStore:
             for index, good in zip(indices, verdicts):
                 if good:
                     self.have[index] = True
+                    self._report_verified(index)
                     count += 1
             indices, pieces, pending = [], [], 0
             return count
@@ -287,6 +317,10 @@ class PieceStore:
             self.have[index] = True
         metrics.GLOBAL.add("torrent_pieces_verified")
         metrics.GLOBAL.add("torrent_bytes_downloaded", len(data))
+        # outside the write lock, like the observers below: the span
+        # report may hand a fully-covered part to the upload pool, and
+        # that submission must not serialize piece writes
+        self._report_verified(index)
         # notify outside the write lock: observers hit the network (HAVE
         # broadcasts) and must not serialize piece writes behind a slow
         # remote's socket

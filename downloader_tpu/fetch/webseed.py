@@ -11,7 +11,7 @@ from __future__ import annotations
 import socket
 import urllib.parse
 
-from ..utils import get_logger, tracing
+from ..utils import get_logger, metrics, tracing
 from .http import TransferError
 
 log = get_logger("fetch.peer")
@@ -93,13 +93,22 @@ class _WebSeedClient:
                 pass
 
     def fetch_range(self, url: str, offset: int, length: int) -> bytes:
-        with tracing.span(
-            "webseed-range",
-            url=tracing.redact_url(url),
-            offset=offset,
-            length=length,
-        ):
-            return self._fetch_range(url, offset, length)
+        # ingress-side twin of the pipeline's upload gauges: how many
+        # webseed bytes are mid-flight right now, and how many landed —
+        # lets /metrics show both halves of a streamed job's overlap
+        metrics.GLOBAL.gauge_add("webseed_bytes_inflight", length)
+        try:
+            with tracing.span(
+                "webseed-range",
+                url=tracing.redact_url(url),
+                offset=offset,
+                length=length,
+            ):
+                chunk = self._fetch_range(url, offset, length)
+        finally:
+            metrics.GLOBAL.gauge_add("webseed_bytes_inflight", -length)
+        metrics.GLOBAL.add("webseed_bytes_fetched", len(chunk))
+        return chunk
 
     def _fetch_range(self, url: str, offset: int, length: int) -> bytes:
         import http.client

@@ -22,6 +22,7 @@ import io
 import os
 import re
 import stat
+import tempfile
 import time
 import urllib.parse
 from typing import BinaryIO, Mapping
@@ -62,6 +63,15 @@ def _fileno_of(body) -> int | None:
         return None
 
 
+def _seekable(stream) -> bool:
+    """IOBase.seekable when available; SpooledTemporaryFile (pre-3.11)
+    supports seek/tell without implementing the IOBase probe."""
+    probe = getattr(stream, "seekable", None)
+    if probe is not None:
+        return probe()
+    return hasattr(stream, "seek")
+
+
 class S3Error(Exception):
     def __init__(self, status: int, message: str):
         super().__init__(f"s3: {status} {message}")
@@ -90,6 +100,18 @@ class S3Client:
         self._zero_copy = zero_copy
         self._multipart_threshold = multipart_threshold
         self._part_size = part_size  # None = derive per object
+
+    @property
+    def multipart_threshold(self) -> int:
+        """Objects at or above this size take the multipart API; the
+        streaming pipeline uses it as its eligibility floor."""
+        return self._multipart_threshold
+
+    def part_size_for(self, size: int) -> int:
+        """The part size this client would use for an object of
+        ``size`` bytes (minio-go optimalPartInfo semantics) — public so
+        the streaming pipeline plans part boundaries identically."""
+        return self._derived_part_size(size)
 
     @classmethod
     def from_endpoint_url(
@@ -295,14 +317,39 @@ class S3Client:
         streams twice — avoid for large media files.
 
         Objects larger than the multipart threshold take the multipart
-        API instead (requires a seekable stream), exactly as minio-go
-        does for the reference (uploader.go:86-89 via PutObjectWithContext
-        → putObjectMultipartStream above 64 MiB); ``sign_payload`` is
-        honored there per part."""
-        if size > self._multipart_threshold and stream.seekable():
-            self._put_multipart(
-                bucket, key, stream, size, content_type, token, sign_payload
-            )
+        API instead, exactly as minio-go does for the reference
+        (uploader.go:86-89 via PutObjectWithContext →
+        putObjectMultipartStream above 64 MiB); ``sign_payload`` is
+        honored there per part. Non-seekable bodies above the threshold
+        are spooled to a temp file first — a 5+ GiB pipe must not fall
+        back to a single PUT that real S3 rejects, and spooling keeps
+        the retry-per-part and abort-on-failure semantics."""
+        if size > self._multipart_threshold:
+            if _seekable(stream):
+                self._put_multipart(
+                    bucket, key, stream, size, content_type, token, sign_payload
+                )
+                return
+            with tempfile.SpooledTemporaryFile(
+                max_size=min(self._multipart_threshold, 16 * 1024 * 1024)
+            ) as spool:
+                remaining = size
+                while remaining > 0:
+                    if token is not None:
+                        token.raise_if_cancelled()
+                    chunk = stream.read(min(_STREAM_CHUNK, remaining))
+                    if not chunk:
+                        raise S3Error(
+                            0,
+                            f"short body: got {size - remaining} of {size} "
+                            "bytes from non-seekable stream",
+                        )
+                    spool.write(chunk)
+                    remaining -= len(chunk)
+                spool.seek(0)
+                self._put_multipart(
+                    bucket, key, spool, size, content_type, token, sign_payload
+                )
             return
         payload_hash = "UNSIGNED-PAYLOAD"
         if self._credentials.anonymous:
@@ -358,6 +405,142 @@ class S3Client:
         stream.seek(start)
         return digest.hexdigest()
 
+    def initiate_multipart(
+        self,
+        bucket: str,
+        key: str,
+        content_type: str = "application/octet-stream",
+        token: CancelToken | None = None,
+    ) -> str:
+        """Start a multipart upload and return its UploadId. Parts may
+        then ship in ANY order (S3 parts are independent — the
+        streaming pipeline exploits this for out-of-order piece spans);
+        the caller owns completing or aborting the upload."""
+        status, body, _ = self._request(
+            "POST",
+            self._object_path(bucket, key),
+            query={"uploads": ""},
+            content_type=content_type,
+            token=token,
+        )
+        if status != 200:
+            raise S3Error(status, body.decode(errors="replace")[:200])
+        match = _UPLOAD_ID_RE.search(body)
+        if not match:
+            raise S3Error(status, "initiate multipart: no UploadId in response")
+        return match.group(1).decode()
+
+    def upload_part(
+        self,
+        bucket: str,
+        key: str,
+        upload_id: str,
+        number: int,
+        stream: BinaryIO,
+        length: int,
+        token: CancelToken | None = None,
+        sign_payload: bool = False,
+    ) -> str:
+        """PUT one part (1-indexed) from the stream's current position;
+        returns the ETag for the Complete manifest. Transient failures
+        (5xx, connection drop) get ONE in-place retry when the stream
+        can be rewound — a multi-GB upload should not restart because a
+        single part hit a blip."""
+        start = stream.tell() if _seekable(stream) else None
+        payload_hash = (
+            sigv4.EMPTY_SHA256
+            if self._credentials.anonymous
+            else "UNSIGNED-PAYLOAD"
+        )
+        if sign_payload and not self._credentials.anonymous and start is not None:
+            payload_hash = self._part_hash(stream, start, length)
+        last_error: Exception | None = None
+        for attempt in range(2):
+            if token is not None:
+                token.raise_if_cancelled()
+            if attempt and start is not None:
+                stream.seek(start)
+            try:
+                with tracing.span("s3-part", part=number, bytes=length):
+                    status, body, headers = self._request(
+                        "PUT",
+                        self._object_path(bucket, key),
+                        query={
+                            "partNumber": str(number),
+                            "uploadId": upload_id,
+                        },
+                        body=stream,
+                        content_length=length,
+                        payload_hash=payload_hash,
+                        token=token,
+                    )
+            except (OSError, http.client.HTTPException) as exc:
+                last_error = exc
+                if start is None:
+                    raise S3Error(0, f"part {number}: {exc}") from exc
+                continue
+            if status in (200, 201, 204):
+                etag = headers.get("etag", "")
+                if not etag:
+                    raise S3Error(status, f"part {number}: no ETag in response")
+                return etag
+            message = f"part {number}: " + body.decode(errors="replace")[:200]
+            if status < 500 or start is None:
+                raise S3Error(status, message)
+            last_error = S3Error(status, message)
+        if isinstance(last_error, S3Error):
+            raise last_error
+        raise S3Error(0, f"part {number}: {last_error}")
+
+    def complete_multipart(
+        self,
+        bucket: str,
+        key: str,
+        upload_id: str,
+        parts: list[tuple[int, str]],
+        token: CancelToken | None = None,
+    ) -> None:
+        """Assemble the uploaded parts. ``parts`` is (number, etag) in
+        any order; the manifest is sorted — S3 requires ascending part
+        numbers even though the uploads themselves were unordered."""
+        manifest = "".join(
+            f"<Part><PartNumber>{number}</PartNumber>"
+            f"<ETag>{etag}</ETag></Part>"
+            for number, etag in sorted(parts)
+        )
+        complete = (
+            f"<CompleteMultipartUpload>{manifest}</CompleteMultipartUpload>"
+        ).encode()
+        status, body, _ = self._request(
+            "POST",
+            self._object_path(bucket, key),
+            query={"uploadId": upload_id},
+            body=io.BytesIO(complete),
+            content_length=len(complete),
+            payload_hash=hashlib.sha256(complete).hexdigest(),
+            content_type="application/xml",
+            token=token,
+        )
+        # S3 can answer Complete with 200 + an <Error> document, so
+        # the status alone does not mean success
+        if status != 200 or b"<Error>" in body:
+            raise S3Error(status, body.decode(errors="replace")[:200])
+
+    def abort_multipart(self, bucket: str, key: str, upload_id: str) -> None:
+        """Abort an in-progress multipart upload so the store doesn't
+        accrue orphaned part storage. Deliberately token-free — aborts
+        must run even ON cancellation — with a short timeout so a
+        black-holed endpoint can't park a cancelled caller for the full
+        client timeout. 404 (already gone) counts as success."""
+        status, body, _ = self._request(
+            "DELETE",
+            self._object_path(bucket, key),
+            query={"uploadId": upload_id},
+            timeout=min(self._timeout, 5.0),
+        )
+        if status not in (200, 204, 404):
+            raise S3Error(status, body.decode(errors="replace")[:200])
+
     def _put_multipart(
         self,
         bucket: str,
@@ -368,22 +551,14 @@ class S3Client:
         token: CancelToken | None,
         sign_payload: bool = False,
     ) -> None:
-        path = self._object_path(bucket, key)
-        status, body, _ = self._request(
-            "POST", path, query={"uploads": ""}, content_type=content_type,
-            token=token,
+        """Sequential store-and-forward multipart: the whole object is
+        already on disk (or spooled), so parts ship in order off one
+        stream. The streaming pipeline drives the same initiate/part/
+        complete/abort API out of order instead."""
+        upload_id = self.initiate_multipart(
+            bucket, key, content_type=content_type, token=token
         )
-        if status != 200:
-            raise S3Error(status, body.decode(errors="replace")[:200])
-        match = _UPLOAD_ID_RE.search(body)
-        if not match:
-            raise S3Error(status, "initiate multipart: no UploadId in response")
-        upload_id = match.group(1).decode()
-
         part_size = self._derived_part_size(size)
-        payload_hash = (
-            sigv4.EMPTY_SHA256 if self._credentials.anonymous else "UNSIGNED-PAYLOAD"
-        )
         base = stream.tell()
         try:
             etags: list[tuple[int, str]] = []
@@ -394,68 +569,27 @@ class S3Client:
                 length = min(part_size, size - offset)
                 number = len(etags) + 1
                 stream.seek(base + offset)
-                if sign_payload and not self._credentials.anonymous:
-                    # honor the caller's opt-in per part: an extra read
-                    # pass over the window, same trade as the single-PUT
-                    # sign_payload path
-                    payload_hash = self._part_hash(stream, base + offset, length)
-                with tracing.span("s3-part", part=number, bytes=length):
-                    status, body, headers = self._request(
-                        "PUT",
-                        path,
-                        query={"partNumber": str(number), "uploadId": upload_id},
-                        body=stream,
-                        content_length=length,
-                        payload_hash=payload_hash,
-                        token=token,
+                etags.append(
+                    (
+                        number,
+                        self.upload_part(
+                            bucket,
+                            key,
+                            upload_id,
+                            number,
+                            stream,
+                            length,
+                            token=token,
+                            sign_payload=sign_payload,
+                        ),
                     )
-                if status not in (200, 201, 204):
-                    raise S3Error(
-                        status,
-                        f"part {number}: " + body.decode(errors="replace")[:200],
-                    )
-                etag = headers.get("etag", "")
-                if not etag:
-                    raise S3Error(status, f"part {number}: no ETag in response")
-                etags.append((number, etag))
-                offset += length
-
-            manifest = "".join(
-                f"<Part><PartNumber>{number}</PartNumber>"
-                f"<ETag>{etag}</ETag></Part>"
-                for number, etag in etags
-            )
-            complete = (
-                f"<CompleteMultipartUpload>{manifest}"
-                "</CompleteMultipartUpload>"
-            ).encode()
-            status, body, _ = self._request(
-                "POST",
-                path,
-                query={"uploadId": upload_id},
-                body=io.BytesIO(complete),
-                content_length=len(complete),
-                payload_hash=hashlib.sha256(complete).hexdigest(),
-                content_type="application/xml",
-                token=token,
-            )
-            # S3 can answer Complete with 200 + an <Error> document, so
-            # the status alone does not mean success
-            if status != 200 or b"<Error>" in body:
-                raise S3Error(status, body.decode(errors="replace")[:200])
-        except BaseException:
-            # best-effort abort so the store doesn't accrue orphaned
-            # part storage. No token — the abort must run even ON
-            # cancellation — but a short timeout so a black-holed
-            # endpoint can't park a cancelled caller for the full
-            # client timeout (prompt teardown beats a guaranteed abort)
-            try:
-                self._request(
-                    "DELETE",
-                    path,
-                    query={"uploadId": upload_id},
-                    timeout=min(self._timeout, 5.0),
                 )
+                offset += length
+            self.complete_multipart(bucket, key, upload_id, etags, token=token)
+        except BaseException:
+            # best-effort: prompt teardown beats a guaranteed abort
+            try:
+                self.abort_multipart(bucket, key, upload_id)
             except Exception:
                 pass
             raise

@@ -368,6 +368,21 @@ class S3Stub:
             target=self._server.serve_forever, daemon=True
         )
 
+    def list_multipart_uploads(
+        self, bucket: str | None = None
+    ) -> list[tuple[str, str, str]]:
+        """Pending (bucket, key, upload_id) triples — the stub's analogue
+        of S3 ListMultipartUploads. Abort-path tests assert this is
+        EMPTY after cancellation/failure/scan-rejection: a non-empty
+        list is exactly the orphaned part storage a real account would
+        be billed for."""
+        with self.lock:
+            return [
+                upload
+                for upload in self.uploads
+                if bucket is None or upload[0] == bucket
+            ]
+
     @property
     def endpoint(self) -> str:
         host, port = self._server.server_address[:2]

@@ -33,6 +33,13 @@ LATENCY_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0,
 OVERHEAD_BUCKETS = (0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                     0.025, 0.05, 0.1, 0.5, 1.0, 5.0)
 
+# buckets for dimensionless 0..1 ratios (e.g. the streaming pipeline's
+# pipeline_overlap_ratio: what fraction of a streamed file's bytes were
+# uploaded while its fetch was still running). Uniform deciles — the
+# interesting signal is the distribution's mass shifting toward 1.0 as
+# overlap improves, not tail latency
+RATIO_BUCKETS = (0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0)
+
 
 class Counters:
     def __init__(self) -> None:

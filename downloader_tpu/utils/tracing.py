@@ -86,13 +86,21 @@ def redact_url(url: str) -> str:
 
 # stages whose per-job durations are folded into /metrics histograms;
 # anything else (decode, ack, dequeue) is framework overhead and lands
-# in overhead_seconds as the root-minus-attributed remainder
-_STAGE_METRICS = ("fetch", "scan", "upload", "publish")
-# top-level spans that are deliberate waiting, not framework cost: the
-# retry pacing delay (RETRY_DELAY, default 10 s) must not land in the
-# ms-scale overhead_seconds series one retried-then-successful job
-# would otherwise blow out
-_NOT_OVERHEAD = _STAGE_METRICS + ("retry-delay", "retry-republish")
+# in overhead_seconds as the root-minus-attributed remainder.
+# ``stream_upload`` is the pipeline's overlapped-egress summary span
+# (store/pipeline.py): it gets a histogram but deliberately does NOT
+# join the overhead attribution below — its interval overlaps the
+# fetch span, so subtracting both would double-count the overlapped
+# wall time and drive the remainder negative
+_STAGE_METRICS = ("fetch", "scan", "upload", "publish", "stream_upload")
+# top-level spans subtracted from the root to compute overhead_seconds:
+# sequential pipeline stages plus deliberate waiting (the retry pacing
+# delay, RETRY_DELAY default 10 s, must not land in the ms-scale
+# overhead series one retried-then-successful job would blow out).
+# These must be non-overlapping intervals — see stream_upload above.
+_NOT_OVERHEAD = (
+    "fetch", "scan", "upload", "publish", "retry-delay", "retry-republish",
+)
 
 DEFAULT_RING = 64
 MAX_SPANS_PER_TRACE = 512

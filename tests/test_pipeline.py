@@ -1,0 +1,446 @@
+"""Streaming fetch→upload pipeline tests (store/pipeline.py).
+
+Three layers:
+
+- pure coverage math: randomized piece-span → part-span fuzzing so the
+  out-of-order mapping can never silently drop (or double-ship) a byte
+  range;
+- session semantics against the S3 stub: streamed completion with
+  byte-exact content, and the abort triangle — cancellation mid-part,
+  fetch failure mid-stream, scan rejection after speculative parts —
+  each asserted to leave ZERO dangling multipart uploads
+  (stub.list_multipart_uploads);
+- end-to-end through the real HTTP backend: the fetch's progress hooks
+  drive the session exactly as a daemon job would.
+"""
+
+import http.server
+import os
+import random
+import threading
+
+import pytest
+
+from downloader_tpu.fetch import DispatchClient, HTTPBackend
+from downloader_tpu.fetch import progress as transfer_progress
+from downloader_tpu.scan import scan_dir
+from downloader_tpu.store import Credentials, S3Client, Uploader, object_key
+from downloader_tpu.store.pipeline import (
+    PartPlan,
+    SpanSet,
+    _FileStream,
+    default_name_predicate,
+)
+from downloader_tpu.store.stub import S3Stub
+from downloader_tpu.utils.cancel import CancelToken
+
+CREDS = Credentials(access_key="testkey", secret_key="testsecret")
+
+PART = 64 * 1024
+THRESHOLD = 128 * 1024
+
+
+@pytest.fixture
+def stub():
+    with S3Stub(credentials=CREDS) as server:
+        yield server
+
+
+def make_uploader(stub, part_workers=2) -> Uploader:
+    client = S3Client(
+        stub.endpoint, CREDS, multipart_threshold=THRESHOLD, part_size=PART
+    )
+    uploader = Uploader("bucket", client)
+    uploader.configure_pipeline(True, part_workers=part_workers)
+    return uploader
+
+
+# ---------------------------------------------------------------------------
+# coverage math
+
+
+class TestSpanSet:
+    def test_merge_adjacent_and_overlapping(self):
+        spans = SpanSet()
+        spans.add(0, 10)
+        spans.add(10, 20)  # adjacent folds
+        spans.add(15, 30)  # overlapping folds
+        assert spans.spans() == [(0, 30)]
+        assert spans.covers(0, 30) and not spans.covers(0, 31)
+
+    def test_bridging_gap(self):
+        spans = SpanSet()
+        spans.add(0, 10)
+        spans.add(20, 30)
+        assert spans.spans() == [(0, 10), (20, 30)]
+        spans.add(10, 20)
+        assert spans.spans() == [(0, 30)]
+
+    def test_empty_and_contained(self):
+        spans = SpanSet()
+        spans.add(5, 5)
+        assert spans.spans() == []
+        spans.add(0, 100)
+        spans.add(10, 20)
+        assert spans.spans() == [(0, 100)]
+        assert spans.total() == 100
+
+
+def feed_stream(total: int, part_size: int):
+    """A detached _FileStream: feed() exercises the span→part logic
+    without any session or network behind it."""
+    stream = _FileStream.__new__(_FileStream)
+    stream.plan = PartPlan(total, part_size)
+    stream.spans = SpanSet()
+    stream.submitted = set()
+    stream.failed = None
+    stream.sealed = False
+    return stream
+
+
+class TestPieceToPartCoverage:
+    """The fuzz the tentpole demands: random piece sizes against random
+    part boundaries, spans arriving in random order — every part must
+    emit exactly once, only when fully covered, and full piece coverage
+    must emit every part (no byte range silently dropped)."""
+
+    @pytest.mark.parametrize("seed", range(40))
+    def test_random_pieces_tile_parts_exactly(self, seed):
+        rng = random.Random(seed)
+        part_size = rng.choice([1, 7, 64, 1000, 4096]) * rng.randint(1, 9)
+        total = rng.randint(1, 40 * part_size)
+        piece_len = rng.randint(1, max(1, total // rng.randint(1, 8)) + 1)
+        stream = feed_stream(total, part_size)
+
+        pieces = [
+            (lo, min(lo + piece_len, total))
+            for lo in range(0, total, piece_len)
+        ]
+        rng.shuffle(pieces)
+
+        emitted: list[int] = []
+        for lo, hi in pieces:
+            ready = stream.feed(lo, hi)
+            for number in ready:
+                # a part may only ship once its full range is covered
+                # by spans fed SO FAR
+                plo, phi = stream.plan.part_range(number)
+                assert stream.spans.covers(plo, phi)
+            emitted.extend(ready)
+
+        # exactly-once, and nothing missing once coverage is total
+        assert sorted(emitted) == list(
+            range(1, stream.plan.num_parts + 1)
+        ), f"seed {seed}: parts dropped or duplicated"
+        # the parts tile [0, total) precisely
+        covered = sorted(stream.plan.part_range(n) for n in emitted)
+        cursor = 0
+        for lo, hi in covered:
+            assert lo == cursor
+            cursor = hi
+        assert cursor == total
+
+    @pytest.mark.parametrize("seed", range(15))
+    def test_partial_coverage_never_overclaims(self, seed):
+        rng = random.Random(1000 + seed)
+        part_size = rng.randint(1, 5000)
+        total = rng.randint(1, 30 * part_size)
+        stream = feed_stream(total, part_size)
+        emitted: set[int] = set()
+        for _ in range(rng.randint(1, 25)):
+            lo = rng.randint(0, total - 1)
+            hi = rng.randint(lo + 1, total)
+            for number in stream.feed(lo, hi):
+                assert number not in emitted
+                plo, phi = stream.plan.part_range(number)
+                assert stream.spans.covers(plo, phi)
+                emitted.add(number)
+
+    def test_plan_boundaries(self):
+        plan = PartPlan(100, 30)
+        assert plan.num_parts == 4
+        assert plan.part_range(1) == (0, 30)
+        assert plan.part_range(4) == (90, 100)
+        assert list(plan.parts_touching(29, 31)) == [1, 2]
+        with pytest.raises(ValueError):
+            plan.part_range(5)
+
+
+# ---------------------------------------------------------------------------
+# session semantics against the stub
+
+
+def write_payload(tmp_path, name="movie.mkv", size=5 * PART + 123):
+    data = os.urandom(size)
+    path = tmp_path / name
+    path.write_bytes(data)
+    return str(path), data
+
+
+class TestStreamingSession:
+    def test_streamed_completion_content_exact(self, stub, tmp_path):
+        path, data = write_payload(tmp_path)
+        uploader = make_uploader(stub)
+        session = uploader.streaming_session("m1")
+        session.begin_file(path, len(data))
+        # sequential writer shape: contiguous offset advances
+        for offset in range(PART, len(data), PART):
+            session.advance(path, offset)
+        session.finish_file(path)
+        streamed = session.finalize([path])
+        session.close()
+
+        key = object_key("m1", path)
+        assert streamed == {path: key}
+        assert bytes(stub.buckets["bucket"][key]) == data
+        assert stub.completed_multiparts == 1
+        assert stub.list_multipart_uploads() == []
+
+        # the uploader skips re-uploading the streamed file
+        result = uploader.upload_files(CancelToken(), "m1", [path], streamed)
+        assert result.uploaded == [(path, key)] and not result.failed
+        assert stub.completed_multiparts == 1  # no second pass
+
+    def test_out_of_order_piece_spans(self, stub, tmp_path):
+        path, data = write_payload(tmp_path, size=7 * PART + 55)
+        uploader = make_uploader(stub)
+        session = uploader.streaming_session("m2")
+        session.begin_file(path, len(data))
+        pieces = [
+            (lo, min(lo + 48_000, len(data)))
+            for lo in range(0, len(data), 48_000)
+        ]
+        random.Random(7).shuffle(pieces)
+        for lo, hi in pieces:
+            session.add_span(path, lo, hi)
+        streamed = session.finalize([path])
+        session.close()
+        key = object_key("m2", path)
+        assert streamed == {path: key}
+        assert bytes(stub.buckets["bucket"][key]) == data
+        assert stub.list_multipart_uploads() == []
+
+    def test_scan_rejection_aborts_speculative_parts(self, stub, tmp_path):
+        path, data = write_payload(tmp_path)
+        uploader = make_uploader(stub)
+        session = uploader.streaming_session("m3")
+        session.begin_file(path, len(data))
+        session.advance(path, len(data))
+        assert stub.list_multipart_uploads() != []  # speculative upload live
+        streamed = session.finalize([])  # the scan rejected the file
+        session.close()
+        assert streamed == {}
+        assert stub.list_multipart_uploads() == [], "dangling multipart upload"
+        assert object_key("m3", path) not in stub.buckets.get("bucket", {})
+
+    def test_fetch_failure_mid_stream_aborts(self, stub, tmp_path):
+        path, data = write_payload(tmp_path)
+        uploader = make_uploader(stub)
+        session = uploader.streaming_session("m4")
+        session.begin_file(path, len(data))
+        session.advance(path, 3 * PART)  # fetch dies here; no finalize
+        session.close()
+        assert stub.list_multipart_uploads() == [], "dangling multipart upload"
+        assert stub.completed_multiparts == 0
+
+    def test_cancellation_mid_part_aborts(self, stub, tmp_path):
+        path, data = write_payload(tmp_path)
+        token = CancelToken()
+        uploader = make_uploader(stub, part_workers=1)
+        session = uploader.streaming_session("m5", token)
+        session.begin_file(path, len(data))
+        session.advance(path, 2 * PART)
+        token.cancel()  # in-flight and queued parts observe the token
+        session.advance(path, len(data))
+        session.finish_file(path)
+        session.close()
+        assert stub.list_multipart_uploads() == [], "dangling multipart upload"
+        assert stub.completed_multiparts == 0
+
+    def test_invalidate_aborts_and_blocks_restream(self, stub, tmp_path):
+        path, data = write_payload(tmp_path)
+        uploader = make_uploader(stub)
+        session = uploader.streaming_session("m6")
+        session.begin_file(path, len(data))
+        session.advance(path, 2 * PART)
+        session.invalidate(path)  # HTTP restart-from-zero
+        assert stub.list_multipart_uploads() == []
+        # a re-begin does not start a second speculative upload
+        session.begin_file(path, len(data))
+        session.advance(path, len(data))
+        assert stub.list_multipart_uploads() == []
+        assert session.finalize([path]) == {}
+        session.close()
+
+    def test_small_and_non_media_files_ineligible(self, stub, tmp_path):
+        uploader = make_uploader(stub)
+        session = uploader.streaming_session("m7")
+        small, _ = write_payload(tmp_path, "small.mkv", size=THRESHOLD - 1)
+        session.begin_file(small, THRESHOLD - 1)
+        txt, _ = write_payload(tmp_path, "notes.txt", size=4 * THRESHOLD)
+        session.begin_file(txt, 4 * THRESHOLD)
+        session.advance(small, THRESHOLD - 1)
+        session.advance(txt, 4 * THRESHOLD)
+        assert stub.list_multipart_uploads() == []  # nothing speculative
+        assert session.finalize([small, txt]) == {}
+        session.close()
+        # store-and-forward still handles both
+        result = uploader.upload_files(CancelToken(), "m7", [small, txt], {})
+        assert len(result.uploaded) == 2
+
+    def test_name_predicate_matches_scan(self):
+        assert default_name_predicate("/a/b/movie.mkv")
+        assert default_name_predicate("clip.webm")
+        assert not default_name_predicate("archive.rar")
+        assert not default_name_predicate("README")
+
+    def test_disabled_pipeline_yields_no_session(self, stub):
+        uploader = make_uploader(stub)
+        uploader.configure_pipeline(False)
+        assert uploader.streaming_session("m8") is None
+
+
+# ---------------------------------------------------------------------------
+# torrent-side hooks: PieceStore → transfer sink
+
+
+class RecordingSink:
+    def __init__(self):
+        self.begun: dict[str, int] = {}
+        self.spans: list[tuple[str, int, int]] = []
+
+    def begin_file(self, path, total, read_path=None):
+        self.begun[path] = total
+
+    def advance(self, path, offset):
+        self.spans.append((path, 0, offset))
+
+    def add_span(self, path, start, end):
+        self.spans.append((path, start, end))
+
+    def finish_file(self, path):
+        pass
+
+    def invalidate(self, path):
+        pass
+
+
+class TestPieceStoreReporting:
+    def test_verified_pieces_report_per_file_spans(self, tmp_path):
+        """A multi-file torrent with a BEP 47 pad: verified pieces must
+        advertise file-relative spans for REAL files only, split at
+        file boundaries, so the pipeline's part math sees exactly the
+        bytes that exist on disk."""
+        from downloader_tpu.fetch.pieces import PieceStore
+
+        # f1: 20 bytes, pad: 12 (aligns next file), f2: 16 → 3 pieces of 16
+        info = {
+            b"piece length": 16,
+            b"pieces": b"\x00" * 60,
+            b"name": b"show",
+            b"files": [
+                {b"path": [b"e1.mkv"], b"length": 20},
+                {b"path": [b".pad", b"12"], b"length": 12},
+                {b"path": [b"e2.mkv"], b"length": 16},
+            ],
+        }
+        sink = RecordingSink()
+        with transfer_progress.install(sink):
+            store = PieceStore(info, str(tmp_path))
+        f1 = os.path.join(str(tmp_path), "show", "e1.mkv")
+        f2 = os.path.join(str(tmp_path), "show", "e2.mkv")
+        assert sink.begun == {f1: 20, f2: 16}  # pad never announced
+
+        store.write_verified(0, b"a" * 16)  # wholly inside f1
+        store.write_verified(2, b"c" * 16)  # wholly inside f2, out of order
+        store.write_verified(1, b"b" * 16)  # f1 tail + pad (pad dropped)
+        assert (f1, 0, 16) in sink.spans
+        assert (f2, 0, 16) in sink.spans
+        assert (f1, 16, 20) in sink.spans
+        assert all(".pad" not in path for path, _, _ in sink.spans)
+
+    def test_resume_scan_reports_resumed_spans(self, tmp_path):
+        """Pieces re-verified off disk by the resume scan count as
+        coverage too — a restarted job can stream the tail while only
+        fetching what is missing."""
+        import hashlib
+
+        from downloader_tpu.fetch.pieces import PieceStore
+
+        payload = os.urandom(48)
+        hashes = b"".join(
+            hashlib.sha1(payload[i : i + 16]).digest() for i in (0, 16, 32)
+        )
+        info = {
+            b"piece length": 16,
+            b"pieces": hashes,
+            b"name": b"movie.mkv",
+            b"length": 48,
+        }
+        (tmp_path / "movie.mkv").write_bytes(payload)
+        sink = RecordingSink()
+        with transfer_progress.install(sink):
+            store = PieceStore(info, str(tmp_path))
+        resumed = store.resume_existing()
+        assert resumed == 3
+        path = os.path.join(str(tmp_path), "movie.mkv")
+        assert {(path, 0, 16), (path, 16, 32), (path, 32, 48)} <= set(
+            sink.spans
+        )
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the real HTTP backend
+
+
+class _PayloadHandler(http.server.BaseHTTPRequestHandler):
+    payload = b""
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(self.payload)))
+        self.end_headers()
+        self.wfile.write(self.payload)
+
+
+class TestEndToEndStreaming:
+    def test_http_fetch_streams_then_uploader_skips(self, stub, tmp_path):
+        payload = os.urandom(6 * PART + 321)
+
+        class Handler(_PayloadHandler):
+            pass
+
+        Handler.payload = payload
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            token = CancelToken()
+            base = tmp_path / "jobs"
+            base.mkdir()
+            dispatcher = DispatchClient(
+                token, str(base), [HTTPBackend(progress_interval=0.01)]
+            )
+            uploader = make_uploader(stub)
+            session = uploader.streaming_session("job-1", token)
+            url = f"http://127.0.0.1:{httpd.server_address[1]}/movie.mkv"
+            with transfer_progress.install(session):
+                job_dir = dispatcher.download("job-1", url)
+            files = scan_dir(job_dir)
+            assert len(files) == 1
+            streamed = session.finalize(files)
+            session.close()
+
+            key = object_key("job-1", files[0])
+            assert streamed == {files[0]: key}
+            assert bytes(stub.buckets["bucket"][key]) == payload
+            assert stub.list_multipart_uploads() == []
+            # the daemon's upload stage: nothing left to re-send
+            result = uploader.upload_files(token, "job-1", files, streamed)
+            assert result.uploaded == [(files[0], key)]
+            assert stub.completed_multiparts == 1
+        finally:
+            httpd.shutdown()
+            httpd.server_close()

@@ -233,6 +233,60 @@ class TestUploader:
     def test_object_key_format(self):
         assert object_key("id1", "/x/y/movie.mkv") == "id1/original/bW92aWUubWt2"
 
+    def test_multi_file_batch_uploads_in_parallel_pool(self, stub, tmp_path):
+        """Multi-file torrent jobs upload through the bounded pool; every
+        file must land with its exact content regardless of worker
+        interleaving, and the result ordering stays deterministic."""
+        files = self.make_files(
+            tmp_path, [f"e{i:02d}.mkv" for i in range(7)]
+        )
+        uploader = Uploader("b", client_for(stub), upload_workers=3)
+        result = uploader.upload_files(CancelToken(), "season", files)
+        assert [path for path, _ in result.uploaded] == files
+        assert not result.failed
+        for path in files:
+            key = object_key("season", path)
+            assert bytes(stub.buckets["b"][key]) == open(path, "rb").read()
+
+    def test_parallel_batch_partial_failure_policy(self, stub, tmp_path):
+        """The pool keeps the serial contract: per-file failures are
+        reported and skipped, all-failed raises UploadError."""
+        files = self.make_files(tmp_path, ["a.mkv", "b.mkv"]) + [
+            str(tmp_path / "gone1.mkv"),
+            str(tmp_path / "gone2.mkv"),
+        ]
+        uploader = Uploader("b", client_for(stub), upload_workers=4)
+        result = uploader.upload_files(CancelToken(), "m", files)
+        assert len(result.uploaded) == 2 and len(result.failed) == 2
+        with pytest.raises(UploadError):
+            uploader.upload_files(
+                CancelToken(),
+                "m",
+                [str(tmp_path / "gone3.mkv"), str(tmp_path / "gone4.mkv")],
+            )
+
+    def test_cancelled_batch_raises_not_reports(self, stub, tmp_path):
+        from downloader_tpu.utils.cancel import Cancelled
+
+        files = self.make_files(tmp_path, ["x.mkv", "y.mkv", "z.mkv"])
+        token = CancelToken()
+        token.cancel()
+        with pytest.raises(Cancelled):
+            Uploader("b", client_for(stub)).upload_files(token, "m", files)
+
+    def test_streamed_files_skip_second_pass(self, stub, tmp_path):
+        """Files the streaming pipeline already landed are reported as
+        uploaded without re-reading them — the path need not even exist
+        on disk anymore."""
+        (real,) = self.make_files(tmp_path, ["kept.mkv"])
+        ghost = str(tmp_path / "already-streamed.mkv")  # never written
+        streamed = {ghost: object_key("m", ghost)}
+        result = Uploader("b", client_for(stub)).upload_files(
+            CancelToken(), "m", [real, ghost], streamed=streamed
+        )
+        assert (ghost, streamed[ghost]) in result.uploaded
+        assert len(result.uploaded) == 2 and not result.failed
+
 
 class TestMultipart:
     """The multipart path mirrors what minio-go v6 gives the reference for
@@ -368,6 +422,98 @@ class TestMultipart:
         )
         assert bytes(stub.buckets["b"]["k"]) == data
         assert stub.completed_multiparts == 1
+
+    def test_non_seekable_above_threshold_spools_to_multipart(self, stub):
+        """An oversized NON-seekable body must not fall back to one
+        giant PUT (real S3 caps single PUTs at 5 GiB): it spools to a
+        temp file and takes the multipart path, content intact."""
+
+        class NoSeek(io.RawIOBase):
+            def __init__(self, data):
+                self._inner = io.BytesIO(data)
+
+            def readable(self):
+                return True
+
+            def seekable(self):
+                return False
+
+            def read(self, size=-1):
+                return self._inner.read(size)
+
+        client = S3Client(
+            stub.endpoint,
+            CREDS,
+            multipart_threshold=128 * 1024,
+            part_size=100 * 1024,
+        )
+        client.make_bucket("b")
+        data = os.urandom(350 * 1024)
+        client.put_object("b", "spooled.mkv", NoSeek(data), len(data))
+        assert bytes(stub.buckets["b"]["spooled.mkv"]) == data
+        assert stub.completed_multiparts == 1
+        assert not stub.uploads
+
+    def test_non_seekable_short_body_aborts_cleanly(self, stub):
+        """A non-seekable stream that runs dry before its declared size
+        must error before any upload starts — not ship a padded or
+        truncated object."""
+
+        class ShortNoSeek(io.RawIOBase):
+            def __init__(self, data):
+                self._inner = io.BytesIO(data)
+
+            def readable(self):
+                return True
+
+            def seekable(self):
+                return False
+
+            def read(self, size=-1):
+                return self._inner.read(size)
+
+        client = S3Client(stub.endpoint, CREDS, multipart_threshold=64 * 1024)
+        client.make_bucket("b")
+        with pytest.raises(S3Error):
+            client.put_object(
+                "b", "short", ShortNoSeek(b"x" * 1024), 256 * 1024
+            )
+        assert "short" not in stub.buckets["b"]
+        assert not stub.uploads
+
+    def test_out_of_order_part_api_roundtrip(self, stub):
+        """The streaming pipeline's usage shape: parts uploaded OUT OF
+        ORDER against an explicit upload id, then completed with an
+        unordered manifest."""
+        client = S3Client(stub.endpoint, CREDS)
+        client.make_bucket("b")
+        windows = [os.urandom(70 * 1024) for _ in range(3)]
+        upload_id = client.initiate_multipart("b", "ooo.mkv")
+        etags = []
+        for number in (3, 1, 2):  # deliberately unordered
+            data = windows[number - 1]
+            etags.append(
+                (
+                    number,
+                    client.upload_part(
+                        "b", "ooo.mkv", upload_id, number,
+                        io.BytesIO(data), len(data),
+                    ),
+                )
+            )
+        client.complete_multipart("b", "ooo.mkv", upload_id, etags)
+        assert bytes(stub.buckets["b"]["ooo.mkv"]) == b"".join(windows)
+        assert stub.list_multipart_uploads() == []
+
+    def test_abort_multipart_idempotent(self, stub):
+        client = S3Client(stub.endpoint, CREDS)
+        client.make_bucket("b")
+        upload_id = client.initiate_multipart("b", "gone.mkv")
+        assert stub.list_multipart_uploads() == [("b", "gone.mkv", upload_id)]
+        client.abort_multipart("b", "gone.mkv", upload_id)
+        assert stub.list_multipart_uploads() == []
+        # double-abort (and unknown-id abort) is success, not an error
+        client.abort_multipart("b", "gone.mkv", upload_id)
 
     def test_drain_mode_multipart(self):
         """The bench's non-retaining stub must handle multipart too:
