@@ -124,6 +124,75 @@ print(httpd.server_address[1], flush=True)
 httpd.serve_forever()
 """
 
+# Range + HEAD capable variant for the segmented-fetch ablation, with a
+# per-CONNECTION bandwidth cap: the segmented fetcher's whole value
+# proposition is recovering bandwidth a single connection can't reach
+# (server rate limits, congestion windows), and an unthrottled loopback
+# server has no such cap to recover from. The throttle paces each
+# response stream independently, so N segments stream at N x the cap.
+_RANGE_SERVER = """
+import http.server, os, sys, time
+root, throttle_mbps = sys.argv[1], float(sys.argv[2])
+class RangeQuiet(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    def log_message(self, *args): pass
+    def _meta(self):
+        path = os.path.join(root, os.path.basename(self.path))
+        try:
+            return path, os.path.getsize(path)
+        except OSError:
+            return None, 0
+    def do_HEAD(self):
+        path, size = self._meta()
+        if path is None:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(size))
+        self.send_header("Accept-Ranges", "bytes")
+        self.end_headers()
+    def do_GET(self):
+        path, size = self._meta()
+        if path is None:
+            self.send_error(404)
+            return
+        lo, hi = 0, size - 1
+        rng = self.headers.get("Range")
+        if rng and rng.startswith("bytes="):
+            a, b = rng[6:].split("-", 1)
+            lo = int(a)
+            hi = int(b) if b else size - 1
+            self.send_response(206)
+            self.send_header("Content-Range", f"bytes {lo}-{hi}/{size}")
+        else:
+            self.send_response(200)
+        length = hi - lo + 1
+        self.send_header("Content-Length", str(length))
+        self.end_headers()
+        window = 256 * 1024
+        per_window = window / (throttle_mbps * 1e6) if throttle_mbps > 0 else 0.0
+        try:
+            with open(path, "rb") as f:
+                f.seek(lo)
+                sent = 0
+                while sent < length:
+                    chunk = f.read(min(window, length - sent))
+                    if not chunk:
+                        break
+                    start = time.monotonic()
+                    self.wfile.write(chunk)
+                    sent += len(chunk)
+                    if per_window > 0:
+                        wait = per_window - (time.monotonic() - start)
+                        if wait > 0:
+                            time.sleep(wait)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # endgame loser cancellation closes mid-body; expected
+httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), RangeQuiet)
+print(httpd.server_address[1], flush=True)
+httpd.serve_forever()
+"""
+
 _STUB_SERVER = """
 import sys
 sys.path.insert(0, sys.argv[1])
@@ -141,9 +210,9 @@ threading.Event().wait()
 """
 
 
-def _spawn_server(code: str, arg: str) -> tuple[subprocess.Popen, int]:
+def _spawn_server(code: str, *args: str) -> tuple[subprocess.Popen, int]:
     proc = subprocess.Popen(
-        [sys.executable, "-c", code, arg],
+        [sys.executable, "-c", code, *args],
         stdout=subprocess.PIPE,
         stderr=subprocess.DEVNULL,
         text=True,
@@ -171,13 +240,19 @@ class _Pipeline:
         multipart_threshold: int | None = None,
         part_size: int | None = None,
         part_workers: int | None = None,
+        server: tuple[str, tuple[str, ...]] | None = None,
+        http_segments: int | None = None,
+        segment_min_bytes: int | None = None,
     ):
         self.token = CancelToken()
         self.payload = payload
         self.workdir = tempfile.mkdtemp(prefix="bench-dl-", dir=_bench_root())
         self.httpd = self.stub_proc = None
         try:
-            self.httpd, http_port = _spawn_server(_PAYLOAD_SERVER, site)
+            server_code, server_args = server or (_PAYLOAD_SERVER, ())
+            self.httpd, http_port = _spawn_server(
+                server_code, site, *server_args
+            )
             self.base_url = f"http://127.0.0.1:{http_port}"
             self.stub_proc, stub_port = _spawn_server(
                 _STUB_SERVER, os.path.dirname(os.path.abspath(__file__))
@@ -198,7 +273,11 @@ class _Pipeline:
                 self.workdir,
                 [
                     HTTPBackend(
-                        progress_interval=5.0, timeout=120.0, zero_copy=zero_copy
+                        progress_interval=5.0,
+                        timeout=120.0,
+                        zero_copy=zero_copy,
+                        segments=http_segments,
+                        segment_min_bytes=segment_min_bytes,
                     )
                 ],
             )
@@ -344,6 +423,9 @@ def run_ablation(
     three, per-triple ratios cancel shared noise, and the median is
     reported."""
     configs = (
+        # all three arms pin http_segments=1: these ratios isolate the
+        # data path and the concurrency lift; the segmented stripe has
+        # its own ablation (run_segmented_ablation)
         ("userspace_c1", dict(concurrency=1, prefetch=1, zero_copy=False)),
         ("zerocopy_c1", dict(concurrency=1, prefetch=1, zero_copy=True)),
         ("zerocopy_cN", dict(
@@ -361,6 +443,7 @@ def run_ablation(
                 kwargs["prefetch"],
                 site,
                 zero_copy=kwargs["zero_copy"],
+                http_segments=1,
             )
             rates[name] = moved / took
         triples.append(
@@ -457,6 +540,132 @@ def run_pipeline_ablation(
         "part_size_mb": part_mb // (1024 * 1024),
         "concurrency": concurrency,
         "pairs": pairs,
+    }
+
+
+def run_segmented_ablation(
+    jobs: int,
+    mb_per_job: int,
+    concurrency: int,
+    site: str,
+    repeats: int,
+) -> dict:
+    """The segmented-fetch ablation: segmented (HTTP_SEGMENTS default)
+    vs single-stream (segments pinned to 1), both against the in-tree
+    Range-capable test server with a per-CONNECTION bandwidth cap — the
+    condition the stripe exists to beat. Two object sizes per arm:
+
+    - ``large``: the striped case; N ranges stream concurrently so the
+      per-connection cap stops bounding the job.
+    - ``small``: under the 2x-minimum-segment threshold, so the probe
+      declines and the segmented arm must cost no more than
+      single-stream (fallback is the whole point of the adaptive
+      default).
+
+    Reports per-arm wall seconds + MB/s, the streaming pipeline's
+    overlap ratio, and the pool/segment counters, all as deltas of
+    metrics.GLOBAL around each arm (the daemon runs in-process).
+    Interleaved repeats, median ratios — the standard noise defense."""
+    from downloader_tpu.utils import metrics as global_metrics
+
+    throttle = float(os.environ.get("BENCH_SEGMENT_THROTTLE_MBPS", 25))
+    server = (_RANGE_SERVER, (str(throttle),))
+    # the small arm measures the FALLBACK cost (one pooled HEAD per
+    # job, ~1 RTT): 4 MiB keeps it under the 2 x HTTP_SEGMENT_MIN_MB
+    # threshold while giving the wall clock enough signal that a
+    # millisecond of probe doesn't drown in timer noise; 2 x the jobs
+    # for the same reason
+    small_mb = 4
+    small_payload = os.path.join(site, "seg_small.mkv")
+    if not os.path.exists(small_payload):
+        with open(small_payload, "wb") as sink:
+            sink.write(os.urandom(small_mb * 1024 * 1024))
+    part_mb = 8 * 1024 * 1024
+    shared = dict(
+        concurrency=concurrency,
+        prefetch=concurrency,
+        multipart_threshold=part_mb,
+        part_size=part_mb,
+        pipeline=True,
+        part_workers=concurrency,
+        server=server,
+    )
+
+    def run_arm(arm_jobs, arm_mb, payload, segments):
+        counters0 = global_metrics.GLOBAL.snapshot()
+        hists0 = global_metrics.GLOBAL.histograms()
+        moved, took = run_config(
+            arm_jobs, arm_mb, site=site, payload=payload,
+            http_segments=segments, **shared,
+        )
+        counters1 = global_metrics.GLOBAL.snapshot()
+        hists1 = global_metrics.GLOBAL.histograms()
+
+        def counter_delta(name):
+            return counters1.get(name, 0) - counters0.get(name, 0)
+
+        overlap = None
+        if "pipeline_overlap_ratio" in hists1:
+            _, _, sum1, count1 = hists1["pipeline_overlap_ratio"]
+            _, _, sum0, count0 = hists0.get(
+                "pipeline_overlap_ratio", ((), [], 0.0, 0)
+            )
+            if count1 > count0:
+                overlap = (sum1 - sum0) / (count1 - count0)
+        return {
+            "wall_s": round(took, 2),
+            "MBps": round(moved / took, 1),
+            "overlap_ratio": None if overlap is None else round(overlap, 3),
+            "pool_reuse_hits": counter_delta("http_pool_reuse_hits"),
+            "segmented_fetches": counter_delta("http_segmented_fetches"),
+            "segment_redispatches": counter_delta("http_segment_redispatches"),
+        }
+
+    rounds: list[dict] = []
+    for i in range(repeats):
+        arms = {
+            "single_large": run_arm(jobs, mb_per_job, "payload.mkv", 1),
+            "segmented_large": run_arm(jobs, mb_per_job, "payload.mkv", None),
+            "single_small": run_arm(2 * jobs, small_mb, "seg_small.mkv", 1),
+            "segmented_small": run_arm(
+                2 * jobs, small_mb, "seg_small.mkv", None
+            ),
+        }
+        rounds.append(
+            {
+                "arms": arms,
+                "large_ratio": round(
+                    arms["segmented_large"]["MBps"]
+                    / arms["single_large"]["MBps"], 2
+                ),
+                "small_ratio": round(
+                    arms["segmented_small"]["MBps"]
+                    / arms["single_small"]["MBps"], 2
+                ),
+            }
+        )
+        _log(
+            f"bench: segmented round {i + 1}: large "
+            f"{arms['single_large']['MBps']:.1f} -> "
+            f"{arms['segmented_large']['MBps']:.1f} MB/s "
+            f"({rounds[-1]['large_ratio']:.2f}x, overlap "
+            f"{arms['segmented_large']['overlap_ratio']}, reuse "
+            f"{arms['segmented_large']['pool_reuse_hits']}), small "
+            f"{rounds[-1]['small_ratio']:.2f}x (fallback)"
+        )
+
+    def median_ratio(key: str) -> float:
+        ordered = sorted(r[key] for r in rounds)
+        return ordered[len(ordered) // 2]
+
+    return {
+        "metric": "segmented_vs_single",
+        "segmented_vs_single_large": median_ratio("large_ratio"),
+        "segmented_vs_single_small": median_ratio("small_ratio"),
+        "throttle_MBps_per_conn": throttle,
+        "large_mb": mb_per_job,
+        "small_mb": small_mb,
+        "rounds": rounds,
     }
 
 
@@ -569,8 +778,12 @@ def main() -> None:
             mb = {"b": 0.0, "f": 0.0}
             secs = {"b": 0.0, "f": 0.0}
             for slice_n in slice_jobs:
+                # http_segments=1: the reference has no range probe and
+                # one connection per transfer; the baseline arm keeps
+                # that shape exactly
                 moved, took = run_config(
-                    slice_n, mb_per_job, 1, 1, site, zero_copy=False
+                    slice_n, mb_per_job, 1, 1, site, zero_copy=False,
+                    http_segments=1,
                 )
                 mb["b"] += moved
                 secs["b"] += took
@@ -639,6 +852,39 @@ def main() -> None:
                 f"{pipeline_ablation['pipelined_vs_store_forward']:.2f}x"
             )
 
+        segmented_ablation = None
+        if os.environ.get("BENCH_SEGMENTED", "1") != "0":
+            segmented_repeats = max(
+                1, int(os.environ.get("BENCH_SEGMENTED_REPEATS", 3))
+            )
+            # LOW job concurrency on purpose: this ablation isolates
+            # the per-CONNECTION bandwidth cap the stripe exists to
+            # beat. At the headline's concurrency this 1-vCPU box is
+            # CPU-bound, not connection-bound, and the ratio measures
+            # scheduler contention instead of the stripe (the
+            # concurrency lift has its own ablation above).
+            segmented_jobs = max(
+                1, int(os.environ.get("BENCH_SEGMENTED_JOBS", 2))
+            )
+            segmented_conc = max(
+                1, int(os.environ.get("BENCH_SEGMENTED_CONCURRENCY", 2))
+            )
+            _log(
+                f"bench: segmented ablation, {segmented_repeats} interleaved "
+                f"rounds of {segmented_jobs} jobs x {mb_per_job} MB (large) "
+                f"and 4 MB (small, fallback) per arm, concurrency "
+                f"{segmented_conc}"
+            )
+            segmented_ablation = run_segmented_ablation(
+                segmented_jobs, mb_per_job, segmented_conc, site,
+                segmented_repeats,
+            )
+            _log(
+                "bench: segmented ablation medians: large "
+                f"{segmented_ablation['segmented_vs_single_large']:.2f}x, "
+                f"small {segmented_ablation['segmented_vs_single_small']:.2f}x"
+            )
+
         latency_samples = max(3, int(os.environ.get("BENCH_LATENCY_SAMPLES", 15)))
         _log(f"bench: per-job overhead latency, {latency_samples} tiny jobs")
         tiny = os.path.join(site, "tiny.bin")
@@ -681,6 +927,8 @@ def main() -> None:
             extra_metrics.append(ablation)
         if pipeline_ablation is not None:
             extra_metrics.append(pipeline_ablation)
+        if segmented_ablation is not None:
+            extra_metrics.append(segmented_ablation)
         if os.environ.get("BENCH_DIGEST", "1") != "0":
             _log("bench: digest kernel micro-benchmark (pallas vs hashlib)")
             try:
@@ -698,17 +946,20 @@ def main() -> None:
 
         # one JSON line, as the driver contract requires; the secondary
         # metrics ride along as extra keys
-        print(
-            json.dumps(
-                {
-                    "metric": "e2e_fetch_upload_MBps",
-                    "value": round(value, 1),
-                    "unit": "MB/s",
-                    "vs_baseline": round(vs_baseline, 2),
-                    "extra_metrics": extra_metrics,
-                }
-            )
-        )
+        report = {
+            "metric": "e2e_fetch_upload_MBps",
+            "value": round(value, 1),
+            "unit": "MB/s",
+            "vs_baseline": round(vs_baseline, 2),
+            "extra_metrics": extra_metrics,
+        }
+        try:
+            from bench_digest import digest_line
+
+            _log(f"bench: digest {json.dumps(digest_line(report))}")
+        except Exception as exc:  # the digest is a convenience, never a gate
+            _log(f"bench: digest summary unavailable ({exc})")
+        print(json.dumps(report))
     finally:
         shutil.rmtree(site, ignore_errors=True)
 

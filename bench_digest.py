@@ -28,6 +28,39 @@ def _log(message: str) -> None:
     print(message, file=sys.stderr, flush=True)
 
 
+def digest_line(report: dict) -> dict:
+    """Fold a full bench.py report into one flat summary line: the
+    headline plus every ablation's contract number — including the
+    ``segmented_vs_single`` arms — so a human (or the driver's log
+    scraper) reads the whole run's story without walking the nested
+    ``extra_metrics`` list."""
+    out: dict = {
+        "e2e_MBps": report.get("value"),
+        "vs_baseline": report.get("vs_baseline"),
+    }
+    for extra in report.get("extra_metrics", []):
+        metric = extra.get("metric")
+        if metric == "job_overhead_latency_ms":
+            out["overhead_ms"] = extra.get("value")
+        elif metric == "ablation":
+            out["data_path_x"] = extra.get("data_path_ratio_c1")
+            out["concurrency_x"] = extra.get("concurrency_ratio_zero_copy")
+        elif metric == "pipeline_overlap":
+            out["pipeline_x"] = extra.get("pipelined_vs_store_forward")
+        elif metric == "segmented_vs_single":
+            out["segmented_large_x"] = extra.get("segmented_vs_single_large")
+            out["segmented_small_x"] = extra.get("segmented_vs_single_small")
+            rounds = extra.get("rounds") or []
+            if rounds:
+                arm = rounds[-1]["arms"].get("segmented_large", {})
+                out["segmented_overlap_ratio"] = arm.get("overlap_ratio")
+                out["segmented_pool_reuse_hits"] = arm.get("pool_reuse_hits")
+        elif metric == "digest_kernel":
+            out["hashlib_GBps"] = extra.get("hashlib_GBps")
+            out["pallas_GBps"] = extra.get("pallas_GBps")
+    return out
+
+
 def measure(
     piece_kb: int = 256, batch: int = 1024, reps: int = 3
 ) -> dict | None:

@@ -186,11 +186,19 @@ def _announce_all_from_env() -> bool:
     return False
 
 
-def _default_backends(shared_dht: bool = False):
+def _default_backends(
+    shared_dht: bool = False,
+    http_segments: int | None = None,
+    http_pool_per_host: int | None = None,
+    http_pool_idle: float | None = None,
+):
     """``shared_dht=True`` (the daemon) keeps ONE process-lifetime DHT
     node across jobs, with optional routing-table persistence via
     DHT_STATE_PATH; the one-shot CLI keeps per-job construction like
-    the reference's per-job client (torrent.go:43-44)."""
+    the reference's per-job client (torrent.go:43-44). The HTTP knobs
+    default to the env (HTTP_SEGMENTS / HTTP_POOL_*); the daemon passes
+    its Config's resolved values instead so serve() has one source of
+    truth."""
     from .fetch.torrent import TorrentBackend
     from .utils import flag_from_env, zero_copy_from_env
 
@@ -209,7 +217,12 @@ def _default_backends(shared_dht: bool = False):
                 os.environ.get("DHT_STATE_PATH") or None
             ) if shared_dht else None,
         ),
-        HTTPBackend(zero_copy=zero_copy_from_env()),
+        HTTPBackend(
+            zero_copy=zero_copy_from_env(),
+            segments=http_segments,
+            pool_per_host=http_pool_per_host,
+            pool_idle=http_pool_idle,
+        ),
     ]
 
 

@@ -346,7 +346,21 @@ def serve(
 
     from ..cli import _default_backends
 
-    backends = _default_backends(shared_dht=True)
+    # the HTTP fetch knobs come from Config (one parse, logged here)
+    # rather than each backend re-reading the environment: segmented
+    # fetch shape is operator-visible capacity planning (segments ×
+    # jobs concurrent connections against origin servers)
+    backends = _default_backends(
+        shared_dht=True,
+        http_segments=config.http_segments,
+        http_pool_per_host=config.http_pool_per_host,
+        http_pool_idle=config.http_pool_idle,
+    )
+    log.with_fields(
+        segments=config.http_segments,
+        pool_per_host=config.http_pool_per_host,
+        pool_idle=config.http_pool_idle,
+    ).info("http fetch: segmented ranges + keep-alive pool configured")
     dispatcher = DispatchClient(token, config.base_dir, backends)
     uploader = Uploader.from_env(config.bucket)
 

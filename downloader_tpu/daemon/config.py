@@ -49,6 +49,12 @@ class Config:
     health_host: str = "127.0.0.1"  # bind loopback unless told otherwise
     trace: bool = True  # per-job span tracing (TRACE=off disables)
     trace_ring: int = 64  # completed span trees kept for /debug/jobs
+    # segmented HTTP fetch (fetch/segments.py): max concurrent ranges
+    # per object (1 = single-stream only) and the per-host keep-alive
+    # pool bounds (fetch/connpool.py)
+    http_segments: int = 8
+    http_pool_per_host: int = 6
+    http_pool_idle: float = 30.0
 
     @classmethod
     def from_env(cls, environ: Mapping[str, str] | None = None) -> "Config":
@@ -87,4 +93,13 @@ class Config:
         config.trace_ring = ring_from_value(
             env.get("TRACE_RING"), config.trace_ring
         )
+        from ..fetch.connpool import (
+            pool_idle_from_env,
+            pool_per_host_from_env,
+        )
+        from ..fetch.segments import segments_from_env
+
+        config.http_segments = segments_from_env(env)
+        config.http_pool_per_host = pool_per_host_from_env(env)
+        config.http_pool_idle = pool_idle_from_env(env)
         return config

@@ -225,6 +225,10 @@ class HTTPBackend:
         max_resume_attempts: int = 3,
         opener: urllib.request.OpenerDirector | None = None,
         zero_copy: bool = True,
+        segments: int | None = None,
+        segment_min_bytes: int | None = None,
+        pool_per_host: int | None = None,
+        pool_idle: float | None = None,
     ):
         self._progress_interval = progress_interval
         self._timeout = timeout
@@ -234,10 +238,41 @@ class HTTPBackend:
         # splice misbehaves; also how the bench emulates the reference's
         # userspace data path (Go grab = io.Copy) for its baseline
         self._zero_copy = zero_copy
+        # segmented multi-connection fetch (fetch/segments.py), with its
+        # per-host keep-alive pool shared across segments AND jobs for
+        # this backend's lifetime. A custom opener opts out: segments
+        # speak http.client directly and would bypass whatever the
+        # opener was installed to do (auth handlers, test fakes).
+        self._segmenter = None
+        if opener is None:
+            from .connpool import ConnectionPool
+            from .segments import SegmentedFetcher
+
+            fetcher = SegmentedFetcher(
+                pool=ConnectionPool(
+                    per_host=pool_per_host,
+                    idle_ttl=pool_idle,
+                    timeout=timeout,
+                ),
+                segments=segments,
+                min_segment_bytes=segment_min_bytes,
+                timeout=timeout,
+                max_attempts=max_resume_attempts,
+                progress_interval=progress_interval,
+            )
+            if fetcher.enabled:
+                self._segmenter = fetcher
+            else:
+                fetcher.close()
 
     def register(self) -> BackendRegistration:
         # reference registers protocols only, no extensions (http.go:25-34)
         return BackendRegistration(name="http", protocols=("http", "https"))
+
+    def close(self) -> None:
+        """Release pooled keep-alive connections (daemon shutdown)."""
+        if self._segmenter is not None:
+            self._segmenter.close()
 
     # -- download --------------------------------------------------------
 
@@ -255,8 +290,17 @@ class HTTPBackend:
     def download(
         self, token: CancelToken, base_dir: str, progress: ProgressFn, url: str
     ) -> None:
+        if self._segmenter is not None:
+            # the segmented path handles everything when the probe says
+            # the server supports ranges and the object is big enough;
+            # False means "run the single-stream path" — either the
+            # probe declined (no side effects) or Range support
+            # vanished mid-job (speculative state already invalidated)
+            if self._segmenter.fetch(token, base_dir, progress, url):
+                return
         attempts = 0
         offset = 0
+        known_total = 0
         part_path: str | None = None
         final_path: str | None = None
         last_tick = time.monotonic()
@@ -332,7 +376,16 @@ class HTTPBackend:
                         offset = 0
                         continue
 
-                    total = _total_size(response, offset)
+                    try:
+                        total = _total_size(response, offset, known_total)
+                    except TransferError:
+                        # the server's size story changed mid-transfer:
+                        # bytes already speculatively uploaded may not
+                        # match what a re-fetch would return
+                        if announced:
+                            stream_sink.invalidate(final_path)
+                        raise
+                    known_total = total or known_total
 
                     if announced and offset < reported_high:
                         # restarted below bytes already advertised (the
@@ -459,6 +512,12 @@ class HTTPBackend:
 
         sink_file[0] = None
         os.replace(part_path, final_path)
+        try:
+            # a stale span journal from an earlier segmented attempt
+            # must not outlive the part file it described
+            os.unlink(part_path + ".spans")
+        except OSError:
+            pass
         if announced:
             stream_sink.finish_file(final_path)
         metrics.GLOBAL.add("http_bytes_fetched", offset)
@@ -466,13 +525,52 @@ class HTTPBackend:
         progress(url, 100.0)
 
 
-def _total_size(response, offset: int) -> int:
-    """Full object size from Content-Range (resumed) or Content-Length."""
+def _total_size(response, offset: int, known_total: int = 0) -> int:
+    """Full object size from Content-Range (resumed) or Content-Length.
+
+    ``known_total`` is the size earlier responses of the SAME transfer
+    reported. A resumed attempt whose headers disagree with it — or
+    whose Content-Range is present but unparseable — raises
+    TransferError instead of silently trusting whichever response came
+    first: a changed total means the object was replaced server-side,
+    and stitching ranges of two different objects into one file (or one
+    speculative multipart upload) produces silent corruption."""
     content_range = response.headers.get("Content-Range", "")
-    match = re.match(r"bytes \d+-\d+/(\d+)", content_range)
-    if match:
-        return int(match.group(1))
+    if content_range:
+        match = re.fullmatch(
+            r"bytes (\d+)-(\d+)/(\d+|\*)", content_range.strip()
+        )
+        if not match:
+            raise TransferError(
+                f"malformed Content-Range: {content_range!r}"
+            )
+        start, end = int(match.group(1)), int(match.group(2))
+        if start != offset or end < start:
+            raise TransferError(
+                f"Content-Range {content_range!r} inconsistent with "
+                f"resume offset {offset}"
+            )
+        if match.group(3) != "*":
+            total = int(match.group(3))
+            if end >= total:
+                raise TransferError(
+                    f"Content-Range {content_range!r} ends past its total"
+                )
+            if known_total and total != known_total:
+                raise TransferError(
+                    f"Content-Range total changed {known_total} -> {total}; "
+                    "object replaced mid-transfer"
+                )
+            return total
+        # 'bytes x-y/*' (complete length unknown, RFC 9110 §14.4) is
+        # legal: fall through to the Content-Length computation
     length = response.headers.get("Content-Length")
     if length and length.isdigit():
-        return int(length) + offset
+        total = int(length) + offset
+        if known_total and total != known_total:
+            raise TransferError(
+                f"content length changed: total {known_total} -> {total}; "
+                "object replaced mid-transfer"
+            )
+        return total
     return 0

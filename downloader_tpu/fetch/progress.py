@@ -25,7 +25,10 @@ Report semantics:
   written (sequential writers: HTTP/webseed write offset). Monotonic;
   stale offsets are ignored.
 - ``add_span(path, start, end)`` — bytes ``[start, end)`` are durably
-  written and VERIFIED (out-of-order writers: torrent pieces).
+  written (out-of-order writers: torrent pieces report them SHA-1
+  verified; the segmented HTTP fetcher reports each segment's flushed
+  window, so spans arrive as a NON-monotone, non-prefix set —
+  consumers must merge, not assume a growing prefix).
 - ``finish_file(path)`` — the file is complete at its final path.
 - ``invalidate(path)`` — previously reported bytes are no longer
   trustworthy (an HTTP transfer restarting from zero may receive
@@ -36,6 +39,72 @@ from __future__ import annotations
 
 import threading
 from typing import Protocol
+
+
+class SpanSet:
+    """Disjoint, sorted set of half-open byte ranges ``[start, end)``.
+
+    The shared span arithmetic for everything that tracks partial
+    coverage of a byte stream: the streaming pipeline's part math
+    (store/pipeline.py), the segmented fetcher's resume journal and
+    endgame bookkeeping (fetch/segments.py). Not thread-safe — callers
+    hold their own lock. The merge keeps the list canonical (no
+    overlaps, no adjacency) so coverage checks are a bisect-free linear
+    probe over what is, in practice, a handful of spans (sequential
+    writers keep exactly one)."""
+
+    __slots__ = ("_spans",)
+
+    def __init__(self) -> None:
+        self._spans: list[tuple[int, int]] = []
+
+    def add(self, start: int, end: int) -> None:
+        if end <= start:
+            return
+        merged: list[tuple[int, int]] = []
+        placed = False
+        for lo, hi in self._spans:
+            if hi < start or lo > end:  # strictly outside (not adjacent)
+                if not placed and lo > end:
+                    merged.append((start, end))
+                    placed = True
+                merged.append((lo, hi))
+            else:  # overlaps or touches: fold into the new span
+                start = min(start, lo)
+                end = max(end, hi)
+        if not placed:
+            merged.append((start, end))
+            merged.sort()
+        self._spans = merged
+
+    def covers(self, start: int, end: int) -> bool:
+        if end <= start:
+            return True
+        for lo, hi in self._spans:
+            if lo <= start and end <= hi:
+                return True
+        return False
+
+    def total(self) -> int:
+        return sum(hi - lo for lo, hi in self._spans)
+
+    def spans(self) -> list[tuple[int, int]]:
+        return list(self._spans)
+
+    def missing(self, total: int) -> list[tuple[int, int]]:
+        """The gaps in ``[0, total)`` not yet covered — what a resumed
+        segmented fetch still has to request."""
+        gaps: list[tuple[int, int]] = []
+        cursor = 0
+        for lo, hi in self._spans:
+            if lo >= total:
+                break
+            if lo > cursor:
+                gaps.append((cursor, min(lo, total)))
+            cursor = max(cursor, hi)
+        if cursor < total:
+            gaps.append((cursor, total))
+        return gaps
 
 
 class TransferSink(Protocol):

@@ -42,6 +42,7 @@ import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
 
+from ..fetch.progress import SpanSet  # noqa: F401  (re-export: span math lives with the writers)
 from ..scan import MEDIA_EXTENSIONS
 from ..utils import get_logger, metrics, tracing
 from ..utils.cancel import Cancelled, CancelToken
@@ -77,53 +78,6 @@ def default_name_predicate(path: str) -> bool:
     """The scan predicate applied speculatively to the known target
     filename: would the media scan even consider this file?"""
     return os.path.splitext(os.path.basename(path))[1] in MEDIA_EXTENSIONS
-
-
-class SpanSet:
-    """Disjoint, sorted set of half-open byte ranges ``[start, end)``.
-
-    Not thread-safe — callers hold their own lock. The merge keeps the
-    list canonical (no overlaps, no adjacency) so coverage checks are
-    a bisect-free linear probe over what is, in practice, a handful of
-    spans (sequential writers keep exactly one)."""
-
-    __slots__ = ("_spans",)
-
-    def __init__(self) -> None:
-        self._spans: list[tuple[int, int]] = []
-
-    def add(self, start: int, end: int) -> None:
-        if end <= start:
-            return
-        merged: list[tuple[int, int]] = []
-        placed = False
-        for lo, hi in self._spans:
-            if hi < start or lo > end:  # strictly outside (not adjacent)
-                if not placed and lo > end:
-                    merged.append((start, end))
-                    placed = True
-                merged.append((lo, hi))
-            else:  # overlaps or touches: fold into the new span
-                start = min(start, lo)
-                end = max(end, hi)
-        if not placed:
-            merged.append((start, end))
-            merged.sort()
-        self._spans = merged
-
-    def covers(self, start: int, end: int) -> bool:
-        if end <= start:
-            return True
-        for lo, hi in self._spans:
-            if lo <= start and end <= hi:
-                return True
-        return False
-
-    def total(self) -> int:
-        return sum(hi - lo for lo, hi in self._spans)
-
-    def spans(self) -> list[tuple[int, int]]:
-        return list(self._spans)
 
 
 class PartPlan:
@@ -193,8 +147,23 @@ class _FileStream:
 
     def feed(self, start: int, end: int) -> list[int]:
         """Merge a completed range; return part numbers that just became
-        fully covered and should ship."""
+        fully covered and should ship.
+
+        Ingestion is explicitly NON-PREFIX: spans may arrive in any
+        order and with gaps (torrent pieces; the segmented HTTP
+        fetcher's concurrent ranges) — a part ships as soon as ITS
+        range is covered, regardless of earlier bytes. Nothing here may
+        assume a monotone write offset."""
         if self.failed or self.sealed:
+            return []
+        if end > self.total:
+            # a writer reporting past the announced size means the
+            # source disagrees with the size this upload was planned
+            # around (e.g. a server changing Content-Length mid-job);
+            # the over-claimed tail maps to parts that don't exist in
+            # the plan, so fail the stream (→ store-and-forward
+            # fallback) rather than ship a part plan built on a lie
+            self.failed = f"span [{start}, {end}) beyond total {self.total}"
             return []
         self.spans.add(start, end)
         ready: list[int] = []

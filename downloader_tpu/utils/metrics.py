@@ -33,6 +33,12 @@ LATENCY_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0,
 OVERHEAD_BUCKETS = (0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                     0.025, 0.05, 0.1, 0.5, 1.0, 5.0)
 
+# buckets for small cardinalities (e.g. http_segments_per_fetch: how
+# many ranges a segmented transfer striped across). The distribution's
+# mass says whether the adaptive segment-count default actually
+# engages, which a plain counter would hide
+COUNT_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0)
+
 # buckets for dimensionless 0..1 ratios (e.g. the streaming pipeline's
 # pipeline_overlap_ratio: what fraction of a streamed file's bytes were
 # uploaded while its fetch was still running). Uniform deciles — the

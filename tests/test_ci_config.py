@@ -110,3 +110,69 @@ def test_corpus_manifest_tiles_the_binary_exactly():
     assert cursor == blob_size, (
         f"manifest covers {cursor} bytes, .bin has {blob_size}"
     )
+
+
+def test_http_env_knobs_documented_in_readme():
+    """Every HTTP_* env knob the fetch layer reads (segment count, pool
+    bounds, DNS TTL — anything added later too) must appear in the
+    README's configuration table: an undocumented knob is operator
+    capacity planning (segments × jobs concurrent connections against
+    origin servers) that nobody can plan around. The scan keys on the
+    ``get("HTTP_...")`` read pattern so a renamed or new knob is caught
+    at the source, not remembered by hand."""
+    package = REPO / "downloader_tpu"
+    knobs: set[str] = set()
+    for source in package.rglob("*.py"):
+        knobs.update(
+            re.findall(r'\bget\(\s*"(HTTP_[A-Z0-9_]+)"', source.read_text())
+        )
+    # the scan must actually see the knobs this feature introduced — an
+    # over-tight regex matching nothing would green-light anything
+    for expected in ("HTTP_SEGMENTS", "HTTP_SEGMENT_MIN_MB",
+                     "HTTP_POOL_PER_HOST", "HTTP_POOL_IDLE", "HTTP_DNS_TTL"):
+        assert expected in knobs, f"env-knob scan lost {expected}"
+    readme = (REPO / "README.md").read_text()
+    undocumented = sorted(k for k in knobs if f"`{k}`" not in readme)
+    assert not undocumented, (
+        f"HTTP env knobs missing from README's table: {undocumented}"
+    )
+
+
+def test_bench_digest_picks_up_segmented_ablation():
+    """bench.py's digest line must carry the segmented_vs_single arms —
+    a bench report whose summary silently drops the ablation would let
+    the segmented path regress invisibly."""
+    import sys
+
+    sys.path.insert(0, str(REPO))  # bench_digest lives at the repo root
+    try:
+        import bench_digest
+    finally:
+        sys.path.remove(str(REPO))
+
+    report = {
+        "value": 100.0,
+        "vs_baseline": 2.0,
+        "extra_metrics": [
+            {
+                "metric": "segmented_vs_single",
+                "segmented_vs_single_large": 3.1,
+                "segmented_vs_single_small": 1.0,
+                "rounds": [
+                    {
+                        "arms": {
+                            "segmented_large": {
+                                "overlap_ratio": 0.7,
+                                "pool_reuse_hits": 9,
+                            }
+                        }
+                    }
+                ],
+            }
+        ],
+    }
+    digest = bench_digest.digest_line(report)
+    assert digest["segmented_large_x"] == 3.1
+    assert digest["segmented_small_x"] == 1.0
+    assert digest["segmented_overlap_ratio"] == 0.7
+    assert digest["segmented_pool_reuse_hits"] == 9

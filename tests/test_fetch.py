@@ -550,3 +550,129 @@ def test_zero_copy_disabled_takes_userspace_path(server, tmp_path, monkeypatch):
     )
     assert (tmp_path / "file.mkv").read_bytes() == PAYLOAD
     assert not calls, "splice engaged despite zero_copy=False"
+
+
+# ---------------------------------------------------------------------------
+# Content-Range / Content-Length consistency across resumed attempts
+
+
+class _FakeResponse:
+    def __init__(self, headers):
+        self._headers = headers
+
+    @property
+    def headers(self):
+        return self._headers
+
+
+def test_total_size_strict_content_range():
+    from downloader_tpu.fetch.http import _total_size
+
+    ok = _FakeResponse({"Content-Range": "bytes 100-999/1000"})
+    assert _total_size(ok, 100) == 1000
+    assert _total_size(ok, 100, known_total=1000) == 1000
+
+    # a resumed attempt reporting a DIFFERENT total means the object
+    # was replaced server-side; trusting the first total would stitch
+    # two objects into one file
+    with pytest.raises(TransferError):
+        _total_size(ok, 100, known_total=900)
+    # malformed Content-Range must not silently read as "size unknown"
+    with pytest.raises(TransferError):
+        _total_size(_FakeResponse({"Content-Range": "bytes garbage"}), 100)
+    # range start disagreeing with the resume offset
+    with pytest.raises(TransferError):
+        _total_size(_FakeResponse({"Content-Range": "bytes 0-999/1000"}), 100)
+    # end beyond the claimed total
+    with pytest.raises(TransferError):
+        _total_size(
+            _FakeResponse({"Content-Range": "bytes 100-1000/1000"}), 100
+        )
+    # Content-Length path: changed implied total on a restart
+    with pytest.raises(TransferError):
+        _total_size(
+            _FakeResponse({"Content-Length": "500"}), 0, known_total=1000
+        )
+    assert _total_size(_FakeResponse({}), 0) == 0  # still tolerated
+    # 'bytes x-y/*' (complete length unknown) is RFC-legal: fall
+    # through to Content-Length instead of failing the transfer
+    assert _total_size(
+        _FakeResponse(
+            {"Content-Range": "bytes 100-999/*", "Content-Length": "900"}
+        ),
+        100,
+    ) == 1000
+    with pytest.raises(TransferError):  # start still validated
+        _total_size(_FakeResponse({"Content-Range": "bytes 0-999/*"}), 100)
+
+
+def test_resumed_transfer_with_changed_total_fails_and_invalidates(tmp_path):
+    """A server that truncates mid-stream then reports a different
+    object size on the ranged resume: the transfer must die with
+    TransferError and invalidate the speculative upload rather than
+    splice two objects together."""
+    import http.server as http_server
+    import threading as threading_mod
+
+    from downloader_tpu.fetch import progress as transfer_progress
+
+    first = PAYLOAD
+    second_total = len(PAYLOAD) + 777  # the object changed
+
+    class ChangingHandler(http_server.BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def do_GET(self):
+            rng = self.headers.get("Range")
+            if not rng:
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(first)))
+                self.end_headers()
+                self.wfile.write(first[: len(first) // 2])
+                self.wfile.flush()
+                self.connection.close()  # mid-stream disconnect
+                return
+            offset = int(rng[6:].rstrip("-"))
+            body = first[offset:]
+            self.send_response(206)
+            self.send_header(
+                "Content-Range",
+                f"bytes {offset}-{second_total - 1}/{second_total}",
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = http_server.ThreadingHTTPServer(("127.0.0.1", 0), ChangingHandler)
+    threading_mod.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    invalidated = []
+
+    class Sink:
+        def begin_file(self, path, total, read_path=None):
+            pass
+
+        def advance(self, path, offset):
+            pass
+
+        def add_span(self, path, start, end):
+            pass
+
+        def finish_file(self, path):
+            pass
+
+        def invalidate(self, path):
+            invalidated.append(path)
+
+    try:
+        backend = HTTPBackend(progress_interval=0.01, timeout=5)
+        with transfer_progress.install(Sink()):
+            with pytest.raises(TransferError):
+                backend.download(
+                    CancelToken(), str(tmp_path), lambda u, p: None,
+                    f"http://127.0.0.1:{httpd.server_address[1]}/movie.mkv",
+                )
+        assert invalidated, "speculative upload was not invalidated"
+    finally:
+        httpd.shutdown()

@@ -60,3 +60,106 @@ def test_register_closed_socket_raises_oserror():
     with pytest.raises(OSError) as excinfo:
         SocketWaiter(a, write=False, what="read")
     assert not isinstance(excinfo.value, TimeoutError)
+
+
+# ---------------------------------------------------------------------------
+# DNS resolution cache (per-host TTL + negative cache)
+
+
+class _CountingResolver:
+    """Monkeypatch target standing in for socket.getaddrinfo."""
+
+    def __init__(self, result=None, error=None):
+        self.calls = 0
+        self.result = result or [
+            (socket.AF_INET, socket.SOCK_STREAM, 6, "", ("127.0.0.1", 80))
+        ]
+        self.error = error
+
+    def __call__(self, host, port, family=0, type=0, *args):
+        self.calls += 1
+        if self.error is not None:
+            raise self.error
+        return list(self.result)
+
+
+def test_dns_cache_hits_within_ttl(monkeypatch):
+    from downloader_tpu.utils.netio import DNSCache
+
+    resolver = _CountingResolver()
+    monkeypatch.setattr(socket, "getaddrinfo", resolver)
+    now = [0.0]
+    cache = DNSCache(ttl=60.0, clock=lambda: now[0])
+    first = cache.resolve("example.test", 80)
+    second = cache.resolve("example.test", 80)
+    assert first == second and resolver.calls == 1
+    assert cache.hits == 1 and cache.misses == 1
+    # a different port is a different cache key
+    cache.resolve("example.test", 443)
+    assert resolver.calls == 2
+
+
+def test_dns_cache_expires_after_ttl(monkeypatch):
+    from downloader_tpu.utils.netio import DNSCache
+
+    resolver = _CountingResolver()
+    monkeypatch.setattr(socket, "getaddrinfo", resolver)
+    now = [0.0]
+    cache = DNSCache(ttl=60.0, clock=lambda: now[0])
+    cache.resolve("example.test", 80)
+    now[0] = 61.0
+    cache.resolve("example.test", 80)
+    assert resolver.calls == 2
+
+
+def test_dns_negative_cache(monkeypatch):
+    from downloader_tpu.utils.netio import DNSCache
+
+    resolver = _CountingResolver(error=socket.gaierror("no such host"))
+    monkeypatch.setattr(socket, "getaddrinfo", resolver)
+    now = [0.0]
+    cache = DNSCache(ttl=60.0, negative_ttl=5.0, clock=lambda: now[0])
+    with pytest.raises(socket.gaierror):
+        cache.resolve("dead.test", 80)
+    with pytest.raises(socket.gaierror):
+        cache.resolve("dead.test", 80)
+    assert resolver.calls == 1, "negative result not cached"
+    # the failure ages out much faster than a positive entry
+    now[0] = 6.0
+    resolver.error = None
+    assert cache.resolve("dead.test", 80)
+    assert resolver.calls == 2
+
+
+def test_dns_ttl_zero_disables_cache(monkeypatch):
+    from downloader_tpu.utils.netio import DNSCache
+
+    resolver = _CountingResolver()
+    monkeypatch.setattr(socket, "getaddrinfo", resolver)
+    cache = DNSCache(ttl=0.0)
+    cache.resolve("example.test", 80)
+    cache.resolve("example.test", 80)
+    assert resolver.calls == 2
+
+
+def test_create_connection_uses_cached_addresses():
+    from downloader_tpu.utils.netio import DNSCache, create_connection
+
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+    port = listener.getsockname()[1]
+    try:
+        cache = DNSCache(ttl=60.0)
+        conn = create_connection(
+            ("127.0.0.1", port), timeout=2, resolver=cache
+        )
+        conn.close()
+        assert cache.misses == 1
+        conn = create_connection(
+            ("127.0.0.1", port), timeout=2, resolver=cache
+        )
+        conn.close()
+        assert cache.hits == 1, "second connect resolved again"
+    finally:
+        listener.close()

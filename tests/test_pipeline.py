@@ -90,6 +90,7 @@ def feed_stream(total: int, part_size: int):
     """A detached _FileStream: feed() exercises the span→part logic
     without any session or network behind it."""
     stream = _FileStream.__new__(_FileStream)
+    stream.total = total
     stream.plan = PartPlan(total, part_size)
     stream.spans = SpanSet()
     stream.submitted = set()
@@ -444,3 +445,49 @@ class TestEndToEndStreaming:
         finally:
             httpd.shutdown()
             httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# segmented-HTTP-shaped ingestion: non-prefix spans, over-claim guard
+
+
+class TestSegmentedSpanIngestion:
+    def test_non_prefix_segment_spans_ship_parts(self, stub, tmp_path):
+        """The segmented fetcher reports each segment's flushed window,
+        so coverage grows from MULTIPLE fronts at once — parts in the
+        middle of the file must ship before the prefix completes."""
+        path, data = write_payload(tmp_path, size=8 * PART)
+        uploader = make_uploader(stub)
+        session = uploader.streaming_session("seg1")
+        session.begin_file(path, len(data))
+        # two segments interleaving: [4P, 8P) completes before [0, 4P)
+        session.add_span(path, 4 * PART, 6 * PART)
+        session.add_span(path, 0, PART)
+        session.add_span(path, 6 * PART, 8 * PART)
+        with session._lock:
+            stream = session._files[path]
+            shipped_early = set(stream.submitted)
+        assert {5, 6, 7, 8} <= shipped_early, (
+            "mid-file parts did not ship before the prefix completed"
+        )
+        session.add_span(path, PART, 4 * PART)
+        streamed = session.finalize([path])
+        session.close()
+        key = object_key("seg1", path)
+        assert streamed == {path: key}
+        assert bytes(stub.buckets["bucket"][key]) == data
+        assert stub.list_multipart_uploads() == []
+
+    def test_span_beyond_total_fails_stream_not_process(self, stub, tmp_path):
+        """A span past the announced size means the source changed size
+        mid-job: the stream must fail (→ store-and-forward fallback)
+        instead of shipping parts planned against a stale size."""
+        path, data = write_payload(tmp_path)
+        uploader = make_uploader(stub)
+        session = uploader.streaming_session("seg2")
+        session.begin_file(path, len(data))
+        session.add_span(path, 0, len(data) + 999)  # over-claim
+        streamed = session.finalize([path])
+        session.close()
+        assert streamed == {}
+        assert stub.list_multipart_uploads() == []

@@ -1,0 +1,881 @@
+"""Segmented multi-connection HTTP fetch tests (fetch/segments.py +
+fetch/connpool.py), driven against a real local Range-capable server:
+
+- connection pool semantics (reuse, idle eviction, per-host cap),
+- segment planning math and the span journal's resume contract,
+- end-to-end segmented downloads byte-identical to single-stream,
+- the fallback triangle: no Accept-Ranges, small objects, and the
+  nasty one — the server dropping Range support MID-JOB, which must
+  fall back to single-stream AND abort the stale speculative multipart
+  upload (zero dangling uploads),
+- kill-and-resume: a restarted job re-fetches only the ranges its span
+  journal says are missing,
+- the endgame re-dispatch state machine.
+"""
+
+import hashlib
+import http.server
+import os
+import threading
+import time
+
+import pytest
+
+from downloader_tpu.fetch import HTTPBackend, TransferError
+from downloader_tpu.fetch import progress as transfer_progress
+from downloader_tpu.fetch.connpool import ConnectionPool
+from downloader_tpu.fetch.segments import (
+    RangeDropped,
+    SegmentedFetcher,
+    SpanJournal,
+    _FetchState,
+    _Segment,
+    plan_ranges,
+    segment_count,
+    segments_from_env,
+)
+from downloader_tpu.utils import metrics
+from downloader_tpu.utils.cancel import CancelToken
+
+PAYLOAD = os.urandom(3 * 1024 * 1024)
+SEG_MIN = 256 * 1024  # tests stripe small payloads; shrink the minimum
+
+
+class _QuietThreadingServer(http.server.ThreadingHTTPServer):
+    def handle_error(self, request, client_address):
+        pass  # endgame/cancel paths reset connections; that's expected
+
+
+class RangeHandler(http.server.BaseHTTPRequestHandler):
+    """Range + HEAD capable payload server. ``/noranges`` omits
+    Accept-Ranges; ``/drop`` honors only the first ``drop_honored``
+    ranged GETs then answers 200 (Range support lost mid-job);
+    ``requests`` records every GET's Range header per path."""
+
+    protocol_version = "HTTP/1.1"
+    requests: dict = {}
+    head_requests: list = []
+    drop_honored = 0
+
+    def log_message(self, *args):
+        pass
+
+    def do_HEAD(self):
+        RangeHandler.head_requests.append(self.path)
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(PAYLOAD)))
+        if self.path != "/noranges":
+            self.send_header("Accept-Ranges", "bytes")
+        self.end_headers()
+
+    def do_GET(self):
+        RangeHandler.requests.setdefault(self.path, []).append(
+            self.headers.get("Range")
+        )
+        rng = self.headers.get("Range")
+        honor = rng is not None
+        if self.path == "/drop":
+            if RangeHandler.drop_honored > 0:
+                RangeHandler.drop_honored -= 1
+            else:
+                honor = False
+        body = PAYLOAD
+        if honor:
+            lo, hi = rng[6:].split("-")
+            lo, hi = int(lo), int(hi) if hi else len(PAYLOAD) - 1
+            self.send_response(206)
+            self.send_header(
+                "Content-Range", f"bytes {lo}-{hi}/{len(PAYLOAD)}"
+            )
+            body = body[lo : hi + 1]
+        else:
+            self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture(scope="module")
+def server():
+    httpd = _QuietThreadingServer(("127.0.0.1", 0), RangeHandler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _reset_handler_state():
+    RangeHandler.requests = {}
+    RangeHandler.head_requests = []
+    RangeHandler.drop_honored = 0
+
+
+def make_backend(segments=4, **kwargs):
+    return HTTPBackend(
+        progress_interval=0.01,
+        timeout=5,
+        segments=segments,
+        segment_min_bytes=SEG_MIN,
+        **kwargs,
+    )
+
+
+# ---------------------------------------------------------------------------
+# connection pool
+
+
+class TestConnectionPool:
+    def test_reuse_and_miss_accounting(self):
+        pool = ConnectionPool(per_host=4, idle_ttl=60.0)
+        a = pool.acquire("http", "127.0.0.1", 1)
+        assert a.fresh
+        pool.release(a, reusable=True)
+        b = pool.acquire("http", "127.0.0.1", 1)
+        assert b is a and not b.fresh
+        # different port → different shelf
+        c = pool.acquire("http", "127.0.0.1", 2)
+        assert c is not b and c.fresh
+        pool.close()
+
+    def test_idle_ttl_evicts_stale_connections(self):
+        now = [0.0]
+        pool = ConnectionPool(per_host=4, idle_ttl=10.0, clock=lambda: now[0])
+        a = pool.acquire("http", "h", 80)
+        pool.release(a, reusable=True)
+        now[0] = 11.0  # past the TTL: the parked socket is presumed dead
+        b = pool.acquire("http", "h", 80)
+        assert b is not a and b.fresh
+        pool.close()
+
+    def test_per_host_cap_bounds_idle_retention(self):
+        pool = ConnectionPool(per_host=2, idle_ttl=60.0)
+        conns = [pool.acquire("http", "h", 80) for _ in range(4)]
+        for conn in conns:
+            pool.release(conn, reusable=True)
+        assert pool.idle_count() == 2
+        pool.close()
+        assert pool.idle_count() == 0
+
+    def test_not_reusable_never_parked(self):
+        pool = ConnectionPool(per_host=4, idle_ttl=60.0)
+        a = pool.acquire("http", "h", 80)
+        pool.release(a, reusable=False)
+        assert pool.idle_count() == 0
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# planning math + env knob
+
+
+class TestPlanning:
+    def test_segment_count_adaptive(self):
+        mb = 1024 * 1024
+        assert segment_count(1 * mb, 8, 8 * mb) == 1  # too small
+        assert segment_count(15 * mb, 8, 8 * mb) == 1  # under 2x min
+        assert segment_count(16 * mb, 8, 8 * mb) == 2
+        assert segment_count(40 * mb, 8, 8 * mb) == 5
+        assert segment_count(640 * mb, 8, 8 * mb) == 8  # capped
+        assert segment_count(640 * mb, 1, 8 * mb) == 1  # disabled
+
+    def test_plan_ranges_tiles_gaps_exactly(self):
+        gaps = [(0, 1000), (2000, 2100)]
+        ranges = plan_ranges(gaps, target=4, min_bytes=100)
+        covered = []
+        for lo, hi in ranges:
+            assert hi > lo
+            covered.append((lo, hi))
+        # ranges tile the gaps exactly, in order, no overlap
+        cursor_gaps = []
+        for glo, ghi in gaps:
+            parts = [r for r in covered if glo <= r[0] < ghi]
+            cursor = glo
+            for lo, hi in parts:
+                assert lo == cursor
+                cursor = hi
+            assert cursor == ghi
+            cursor_gaps.extend(parts)
+        assert sorted(cursor_gaps) == sorted(covered)
+
+    def test_plan_ranges_respects_minimum(self):
+        ranges = plan_ranges([(0, 10_000)], target=8, min_bytes=4_000)
+        assert len(ranges) == 3  # 4000+4000+2000, not 8 slivers
+        assert all(hi - lo >= 2_000 for lo, hi in ranges)
+
+    def test_segments_from_env(self):
+        assert segments_from_env({}) == 8
+        assert segments_from_env({"HTTP_SEGMENTS": "auto"}) == 8
+        assert segments_from_env({"HTTP_SEGMENTS": "off"}) == 1
+        assert segments_from_env({"HTTP_SEGMENTS": "0"}) == 1
+        assert segments_from_env({"HTTP_SEGMENTS": "5"}) == 5
+        assert segments_from_env({"HTTP_SEGMENTS": "bogus"}) == 8
+
+
+# ---------------------------------------------------------------------------
+# span journal
+
+
+class TestSpanJournal:
+    def test_roundtrip_and_missing(self, tmp_path):
+        path = str(tmp_path / "x.part.spans")
+        journal = SpanJournal.open(path, 1000)
+        journal.add(0, 100)
+        journal.add(300, 500)
+        journal.close()
+        reloaded = SpanJournal.open(path, 1000)
+        assert reloaded.covered_spans() == [(0, 100), (300, 500)]
+        assert reloaded.missing() == [(100, 300), (500, 1000)]
+        reloaded.remove()
+        assert not os.path.exists(path)
+
+    def test_total_mismatch_discards_journal(self, tmp_path):
+        path = str(tmp_path / "x.part.spans")
+        journal = SpanJournal.open(path, 1000)
+        journal.add(0, 900)
+        journal.close()
+        # the URL now serves a different-sized object: stale coverage
+        # must not survive into the new transfer
+        reloaded = SpanJournal.open(path, 2000)
+        assert reloaded.covered_spans() == []
+        reloaded.close()
+
+    def test_torn_tail_line_ignored(self, tmp_path):
+        path = str(tmp_path / "x.part.spans")
+        journal = SpanJournal.open(path, 1000)
+        journal.add(0, 100)
+        journal.close()
+        with open(path, "a") as sink:
+            sink.write("200 ")  # crash mid-append
+        reloaded = SpanJournal.open(path, 1000)
+        assert reloaded.covered_spans() == [(0, 100)]
+        reloaded.close()
+
+    def test_validator_change_discards_journal(self, tmp_path):
+        """Same size, different object (ETag changed between job
+        attempts): resuming from the old journal would stitch bytes of
+        two objects together."""
+        path = str(tmp_path / "x.part.spans")
+        journal = SpanJournal.open(path, 1000, validator='"etag-v1"')
+        journal.add(0, 900)
+        journal.close()
+        reloaded = SpanJournal.open(path, 1000, validator='"etag-v2"')
+        assert reloaded.covered_spans() == []
+        reloaded.close()
+        journal = SpanJournal.open(path, 1000, validator='"etag-v2"')
+        journal.add(0, 100)
+        journal.close()
+        kept = SpanJournal.open(path, 1000, validator='"etag-v2"')
+        assert kept.covered_spans() == [(0, 100)]
+        kept.close()
+
+    def test_journal_from_previous_boot_discarded(self, tmp_path, monkeypatch):
+        """Journal lines can survive a power loss whose data pages did
+        not (pwrite is page-cache-only; the journal append is tiny):
+        a journal written under another boot id describes potentially
+        zero-filled holes and must be discarded."""
+        import downloader_tpu.fetch.segments as seg_mod
+
+        path = str(tmp_path / "x.part.spans")
+        journal = SpanJournal.open(path, 1000)
+        journal.add(0, 500)
+        journal.close()
+        monkeypatch.setattr(seg_mod, "_BOOT_ID", "previous-boot")
+        reloaded = SpanJournal.open(path, 1000)
+        assert reloaded.covered_spans() == []
+        reloaded.close()
+
+    def test_out_of_bounds_spans_dropped(self, tmp_path):
+        path = str(tmp_path / "x.part.spans")
+        journal = SpanJournal.open(path, 1000)
+        journal.close()
+        with open(path, "a") as sink:
+            sink.write("900 1100\nnot numbers\n-5 10\n")
+        reloaded = SpanJournal.open(path, 1000)
+        assert reloaded.covered_spans() == []
+        reloaded.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end segmented downloads
+
+
+class TestSegmentedDownload:
+    def test_striped_download_byte_identical(self, server, tmp_path):
+        backend = make_backend()
+        before = metrics.GLOBAL.snapshot()
+        backend.download(
+            CancelToken(), str(tmp_path), lambda u, p: None,
+            f"{server}/movie.mkv",
+        )
+        data = (tmp_path / "movie.mkv").read_bytes()
+        assert hashlib.sha256(data).digest() == hashlib.sha256(PAYLOAD).digest()
+        # every GET was ranged (the stripe engaged), covering disjoint
+        # ranges — and no .part/.spans leftovers
+        ranges = RangeHandler.requests["/movie.mkv"]
+        assert len(ranges) >= 2 and all(r for r in ranges)
+        assert sorted(os.listdir(tmp_path)) == ["movie.mkv"]
+        after = metrics.GLOBAL.snapshot()
+        assert after.get("http_segmented_fetches", 0) > before.get(
+            "http_segmented_fetches", 0
+        )
+        backend.close()
+
+    def test_pool_reused_across_jobs(self, server, tmp_path):
+        backend = make_backend()
+        before = metrics.GLOBAL.snapshot().get("http_pool_reuse_hits", 0)
+        for job in ("a", "b"):
+            job_dir = tmp_path / job
+            job_dir.mkdir()
+            backend.download(
+                CancelToken(), str(job_dir), lambda u, p: None,
+                f"{server}/movie.mkv",
+            )
+        after = metrics.GLOBAL.snapshot().get("http_pool_reuse_hits", 0)
+        # the second job's probe + segments ride the first job's
+        # parked keep-alive connections
+        assert after - before >= 1
+        backend.close()
+
+    def test_small_object_falls_back_single_stream(self, server, tmp_path):
+        backend = HTTPBackend(
+            progress_interval=0.01, timeout=5,
+            segments=4, segment_min_bytes=8 * 1024 * 1024,
+        )
+        backend.download(
+            CancelToken(), str(tmp_path), lambda u, p: None,
+            f"{server}/small.mkv",
+        )
+        assert (tmp_path / "small.mkv").read_bytes() == PAYLOAD
+        # single-stream from offset 0 sends no Range header at all
+        assert RangeHandler.requests["/small.mkv"] == [None]
+        backend.close()
+
+    def test_no_accept_ranges_falls_back(self, server, tmp_path):
+        backend = make_backend()
+        backend.download(
+            CancelToken(), str(tmp_path), lambda u, p: None,
+            f"{server}/noranges",
+        )
+        assert (tmp_path / "noranges").read_bytes() == PAYLOAD
+        assert RangeHandler.requests["/noranges"] == [None]
+        backend.close()
+
+    def test_declined_url_probed_once(self, server, tmp_path):
+        """A URL that declined segmentation (too small here) must not
+        re-pay the HEAD probe on the next job for the same source."""
+        backend = HTTPBackend(
+            progress_interval=0.01, timeout=5,
+            segments=4, segment_min_bytes=8 * 1024 * 1024,
+        )
+        for job in ("a", "b"):
+            job_dir = tmp_path / job
+            job_dir.mkdir()
+            backend.download(
+                CancelToken(), str(job_dir), lambda u, p: None,
+                f"{server}/small.mkv",
+            )
+            assert (job_dir / "small.mkv").read_bytes() == PAYLOAD
+        assert RangeHandler.head_requests == ["/small.mkv"]
+        backend.close()
+
+    def test_segments_disabled_uses_single_stream(self, server, tmp_path):
+        backend = make_backend(segments=1)
+        backend.download(
+            CancelToken(), str(tmp_path), lambda u, p: None,
+            f"{server}/movie.mkv",
+        )
+        assert (tmp_path / "movie.mkv").read_bytes() == PAYLOAD
+        assert RangeHandler.requests["/movie.mkv"] == [None]
+        backend.close()
+
+
+# ---------------------------------------------------------------------------
+# kill-and-resume via the span journal
+
+
+class TestResume:
+    def test_restarted_job_fetches_only_missing_ranges(self, server, tmp_path):
+        """The acceptance scenario: a job dies with partial coverage
+        (part file + span journal on disk); the restarted job must
+        request ONLY the missing ranges and produce a file hashing
+        identical to a pristine single-stream download."""
+        single_dir = tmp_path / "single"
+        single_dir.mkdir()
+        backend = make_backend(segments=1)
+        backend.download(
+            CancelToken(), str(single_dir), lambda u, p: None,
+            f"{server}/movie.mkv",
+        )
+        reference = hashlib.sha256(
+            (single_dir / "movie.mkv").read_bytes()
+        ).digest()
+        backend.close()
+
+        # simulate the crash: first MiB and a mid-file window are on
+        # disk and journaled, the rest never arrived
+        job_dir = tmp_path / "resumed"
+        job_dir.mkdir()
+        part = job_dir / "movie.mkv.part"
+        with open(part, "wb") as sink:
+            sink.write(PAYLOAD[: 1024 * 1024])
+            sink.seek(2 * 1024 * 1024)
+            sink.write(PAYLOAD[2 * 1024 * 1024 : 2 * 1024 * 1024 + SEG_MIN])
+            sink.truncate(len(PAYLOAD))
+        journal = SpanJournal.open(str(part) + ".spans", len(PAYLOAD))
+        journal.add(0, 1024 * 1024)
+        journal.add(2 * 1024 * 1024, 2 * 1024 * 1024 + SEG_MIN)
+        journal.close()
+
+        RangeHandler.requests = {}
+        backend = make_backend()
+        backend.download(
+            CancelToken(), str(job_dir), lambda u, p: None,
+            f"{server}/movie.mkv",
+        )
+        backend.close()
+        got = hashlib.sha256((job_dir / "movie.mkv").read_bytes()).digest()
+        assert got == reference
+
+        covered = [(0, 1024 * 1024),
+                   (2 * 1024 * 1024, 2 * 1024 * 1024 + SEG_MIN)]
+        for header in RangeHandler.requests["/movie.mkv"]:
+            assert header and header.startswith("bytes=")
+            lo, hi = header[6:].split("-")
+            lo, hi = int(lo), int(hi) + 1
+            for clo, chi in covered:
+                assert hi <= clo or lo >= chi, (
+                    f"re-fetched already-journaled bytes: {header}"
+                )
+        assert not os.path.exists(part)
+        assert not os.path.exists(str(part) + ".spans")
+
+    def test_orphaned_journal_without_part_file_is_discarded(
+        self, server, tmp_path
+    ):
+        """A journal claiming coverage whose .part file is GONE (crash
+        between rename and journal removal, or a single-stream fallback
+        that consumed the part) must be discarded — trusting it would
+        mark a fresh zero-filled file as already downloaded."""
+        part = tmp_path / "movie.mkv.part"
+        journal = SpanJournal.open(str(part) + ".spans", len(PAYLOAD))
+        journal.add(0, len(PAYLOAD))  # claims EVERYTHING, no part file
+        journal.close()
+        backend = make_backend()
+        backend.download(
+            CancelToken(), str(tmp_path), lambda u, p: None,
+            f"{server}/movie.mkv",
+        )
+        backend.close()
+        assert (tmp_path / "movie.mkv").read_bytes() == PAYLOAD
+        # the whole object was actually fetched (ranged GETs seen)
+        assert len(RangeHandler.requests["/movie.mkv"]) >= 2
+
+    def test_journal_over_wrong_sized_part_is_discarded(
+        self, server, tmp_path
+    ):
+        """A .part at the wrong size (e.g. a single-stream attempt
+        truncated it under a stale journal) invalidates the journal."""
+        part = tmp_path / "movie.mkv.part"
+        part.write_bytes(b"\0" * 1024)  # not the probed total
+        journal = SpanJournal.open(str(part) + ".spans", len(PAYLOAD))
+        journal.add(0, 2 * 1024 * 1024)
+        journal.close()
+        backend = make_backend()
+        backend.download(
+            CancelToken(), str(tmp_path), lambda u, p: None,
+            f"{server}/movie.mkv",
+        )
+        backend.close()
+        assert (tmp_path / "movie.mkv").read_bytes() == PAYLOAD
+
+    def test_cancel_aborts_stalled_segment_promptly(self, tmp_path):
+        """Cancellation must close in-flight segment sockets NOW — the
+        same contract as every other transfer path — not wait out the
+        socket timeout against a stalled origin."""
+        import time as time_mod
+
+        from downloader_tpu.utils.cancel import Cancelled
+
+        stall_total = 4 * 1024 * 1024
+
+        class StallHandler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def do_HEAD(self):
+                self.send_response(200)
+                self.send_header("Content-Length", str(stall_total))
+                self.send_header("Accept-Ranges", "bytes")
+                self.end_headers()
+
+            def do_GET(self):
+                rng = self.headers.get("Range")
+                lo, hi = rng[6:].split("-")
+                lo, hi = int(lo), int(hi)
+                self.send_response(206)
+                self.send_header(
+                    "Content-Range", f"bytes {lo}-{hi}/{stall_total}"
+                )
+                self.send_header("Content-Length", str(hi - lo + 1))
+                self.end_headers()
+                self.wfile.write(b"x" * 1024)  # a taste, then stall
+                self.wfile.flush()
+                try:
+                    time_mod.sleep(30)
+                except Exception:
+                    pass
+
+        httpd = _QuietThreadingServer(("127.0.0.1", 0), StallHandler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        token = CancelToken()
+        threading.Timer(0.4, token.cancel).start()
+        backend = HTTPBackend(
+            progress_interval=0.01, timeout=30,
+            segments=4, segment_min_bytes=512 * 1024,
+        )
+        start = time.monotonic()
+        with pytest.raises(Cancelled):
+            backend.download(
+                token, str(tmp_path), lambda u, p: None,
+                f"http://127.0.0.1:{httpd.server_address[1]}/movie.mkv",
+            )
+        elapsed = time.monotonic() - start
+        backend.close()
+        httpd.shutdown()
+        assert elapsed < 5, f"cancel took {elapsed:.1f}s (socket timeout leak)"
+
+    def test_cancel_mid_fetch_keeps_journal_for_retry(self, server, tmp_path):
+        from downloader_tpu.utils.cancel import Cancelled
+
+        token = CancelToken()
+        calls = [0]
+
+        def cancel_on_progress(url, pct):
+            calls[0] += 1
+            token.cancel()
+
+        backend = make_backend()
+        # the progress throttle interval is 0.01 s, so the token
+        # cancels early in the stripe; the journal must survive
+        with pytest.raises(Cancelled):
+            backend.download(
+                token, str(tmp_path), cancel_on_progress,
+                f"{server}/movie.mkv",
+            )
+        backend.close()
+        leftovers = sorted(os.listdir(tmp_path))
+        assert "movie.mkv.part" in leftovers
+        assert "movie.mkv.part.spans" in leftovers
+
+
+# ---------------------------------------------------------------------------
+# mid-job loss of Range support → fallback + stale upload aborted
+
+
+class TestRangeDroppedMidJob:
+    def test_fallback_aborts_stale_multipart_upload(self, server, tmp_path):
+        from downloader_tpu.fetch import DispatchClient
+        from downloader_tpu.scan import scan_dir
+        from downloader_tpu.store import Credentials, S3Client, Uploader
+        from downloader_tpu.store.stub import S3Stub
+
+        creds = Credentials(access_key="k", secret_key="s")
+        part = 64 * 1024
+        RangeHandler.drop_honored = 2  # two segments land, then 200s
+        with S3Stub(credentials=creds) as stub:
+            client = S3Client(
+                stub.endpoint, creds,
+                multipart_threshold=128 * 1024, part_size=part,
+            )
+            uploader = Uploader("bucket", client)
+            uploader.configure_pipeline(True, part_workers=2)
+            token = CancelToken()
+            base = tmp_path / "jobs"
+            base.mkdir()
+            dispatcher = DispatchClient(token, str(base), [make_backend()])
+            session = uploader.streaming_session("job-drop", token)
+            with transfer_progress.install(session):
+                job_dir = dispatcher.download("job-drop", f"{server}/drop")
+            files = scan_dir(job_dir)
+            streamed = session.finalize(files)
+            session.close()
+            # the file itself completed via single-stream fallback ...
+            assert open(job_dir + "/drop", "rb").read() == PAYLOAD
+            # ... but the segmented-era speculative upload was
+            # invalidated: nothing streamed, nothing dangling
+            assert streamed == {}
+            assert stub.list_multipart_uploads() == []
+            uploader.close()
+
+    def test_range_dropped_probe_level(self, server, tmp_path):
+        """Direct fetcher-level check: fetch() returns False (fallback)
+        and removes its partial state when Range support vanishes."""
+        RangeHandler.drop_honored = 1
+        fetcher = SegmentedFetcher(
+            segments=4, min_segment_bytes=SEG_MIN, timeout=5,
+            progress_interval=0.01,
+        )
+        done = fetcher.fetch(
+            CancelToken(), str(tmp_path), lambda u, p: None,
+            f"{server}/drop",
+        )
+        assert done is False
+        assert not os.path.exists(tmp_path / "drop.part")
+        assert not os.path.exists(tmp_path / "drop.part.spans")
+        fetcher.close()
+
+
+# ---------------------------------------------------------------------------
+# endgame re-dispatch state machine
+
+
+def make_state(ranges):
+    fetcher = SegmentedFetcher(segments=4, min_segment_bytes=1, timeout=1)
+
+    class _Probe:
+        total = max(hi for _, hi in ranges)
+        scheme, host, port, request_path = "http", "h", 80, "/"
+        content_disposition = None
+
+    class _NullJournal:
+        class spans:
+            @staticmethod
+            def total():
+                return 0
+
+        @staticmethod
+        def add(lo, hi):
+            pass
+
+    state = _FetchState(
+        fetcher, CancelToken(), _Probe(), "http://h/", "/tmp/x", -1,
+        _NullJournal(), transfer_progress.NOOP, ranges,
+        lambda u, p: None, 1.0, None,
+    )
+    return fetcher, state
+
+
+class TestEndgame:
+    def test_idle_worker_duplicates_straggler(self):
+        fetcher, state = make_state([(0, 10_000_000), (10_000_000, 20_000_000)])
+        a = state.next_segment()
+        b = state.next_segment()
+        a.pos = a.reported = 9_900_000  # nearly done
+        b.pos = 12_000_000  # 8 MB left: the straggler...
+        b.reported = 11_000_000  # ...with an unreported tail window
+        twin = state.next_segment()
+        assert twin is not None and twin.rescue
+        # the twin must start at the REPORTED mark: [11 MB, 12 MB) is
+        # written but not journaled, and a loser cancelled mid-window
+        # would otherwise leave it covered by neither copy
+        assert twin.start == b.reported and twin.end == b.end
+        assert b.rival is twin and twin.rival is b
+        # each straggler is duplicated at most once; `a` is under the
+        # endgame minimum, so there is nothing else to steal
+        assert state.next_segment() is None
+        fetcher.close()
+
+    def test_winner_cancels_loser(self):
+        fetcher, state = make_state([(0, 10_000_000)])
+        seg = state.next_segment()
+        seg.pos = 1_000_000
+        twin = state.next_segment()
+        assert twin is not None
+        twin.pos = twin.end
+        state.complete(twin)
+        assert seg.stop.is_set(), "loser kept downloading after the rival won"
+        assert not twin.stop.is_set()
+        fetcher.close()
+
+    def test_no_redispatch_below_minimum_remaining(self):
+        fetcher, state = make_state([(0, 10_000_000)])
+        seg = state.next_segment()
+        seg.pos = seg.end - 1024  # 1 KiB left: not worth a re-dispatch
+        assert state.next_segment() is None
+        fetcher.close()
+
+    def test_cancelled_loser_journals_written_bytes(self, tmp_path):
+        """Regression: a loser cancelled mid-window must report the
+        bytes it already wrote before standing down — found live as
+        'segmented fetch left 1 uncovered ranges' when the twin started
+        at the straggler's unjournaled in-memory position."""
+        total = 2 * 1024 * 1024
+        data = os.urandom(total)
+        part = tmp_path / "x.part"
+        part.write_bytes(b"\0" * total)
+        journal = SpanJournal.open(str(part) + ".spans", total)
+        fd = os.open(part, os.O_RDWR)
+        fetcher = SegmentedFetcher(
+            segments=2, min_segment_bytes=1, timeout=1,
+        )
+
+        class _Probe:
+            scheme, host, port, request_path = "http", "h", 80, "/"
+            content_disposition = None
+
+        _Probe.total = total
+        state = _FetchState(
+            fetcher, CancelToken(), _Probe(), "http://h/", str(part), fd,
+            journal, transfer_progress.NOOP, [(0, total)],
+            lambda u, p: None, 1.0, None,
+        )
+        seg = state.next_segment()
+
+        class FakeResponse:
+            status = 206
+            will_close = False
+
+            def __init__(self):
+                self.sent = 0
+                self.length = total
+
+            def getheader(self, name, default=None):
+                if name == "Content-Range":
+                    return f"bytes 0-{total - 1}/{total}"
+                return default
+
+            def read(self, n):
+                chunk = data[self.sent : self.sent + n]
+                self.sent += len(chunk)
+                self.length -= len(chunk)
+                if self.sent >= 300 * 1024:
+                    seg.stop.set()  # the rival "wins" mid-window
+                return chunk
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                pass
+
+        drained = fetcher._consume_response(state, seg, FakeResponse())
+        assert drained is False
+        # everything written before the stop is journaled — under the
+        # old code [0, pos) stayed unreported and resumed fetches (or
+        # a twin starting above it) left the window uncovered
+        covered = journal.covered_spans()
+        assert covered and covered[0][0] == 0
+        assert covered[0][1] == seg.pos > 0
+        os.close(fd)
+        journal.close()
+        fetcher.close()
+
+    def test_abandoned_rescue_leaves_straggler_running(self):
+        """A rescue twin dying (origin rejects the extra connection)
+        must stand down without cancelling the straggler it backed up
+        — and without failing the fetch."""
+        fetcher, state = make_state([(0, 10_000_000)])
+        seg = state.next_segment()
+        seg.pos = seg.reported = 1_000_000
+        twin = state.next_segment()
+        assert twin is not None
+        state.abandon(twin)
+        assert not seg.stop.is_set(), "abandoning the rescue killed the owner"
+        assert state.failure is None
+        fetcher.close()
+
+    def test_probe_retries_past_stale_pooled_connection(self, server):
+        """A parked keep-alive the server closed must read as 'stale
+        pool entry, try a fresh connection' — not as 'not segmentable'
+        (which would cache a 60 s single-stream decline)."""
+        import socket as socket_mod
+        import urllib.parse
+
+        parsed = urllib.parse.urlsplit(server)
+        pool = ConnectionPool(per_host=4, idle_ttl=300.0)
+        dead = http.client.HTTPConnection(parsed.hostname, parsed.port)
+        dead.sock = socket_mod.socket()  # never connected: send() raises
+        dead.sock.close()
+        from downloader_tpu.fetch.connpool import PooledConnection
+
+        pool.release(
+            PooledConnection(
+                dead, ("http", parsed.hostname, parsed.port), fresh=True
+            ),
+            reusable=True,
+        )
+        fetcher = SegmentedFetcher(
+            pool=pool, segments=4, min_segment_bytes=SEG_MIN, timeout=5,
+        )
+        probe = fetcher.probe(f"{server}/movie.mkv")
+        assert probe is not None and probe.total == len(PAYLOAD)
+        fetcher.close()
+
+    def test_short_pwrite_never_journals_unwritten_bytes(
+        self, tmp_path, monkeypatch
+    ):
+        """os.pwrite may write short near a full disk: the journal (and
+        the streaming sink) must only ever cover bytes actually on
+        disk."""
+        total = 1024 * 1024
+        data = os.urandom(total)
+        part = tmp_path / "x.part"
+        part.write_bytes(b"\0" * total)
+        journal = SpanJournal.open(str(part) + ".spans", total)
+        fd = os.open(part, os.O_RDWR)
+        fetcher = SegmentedFetcher(segments=2, min_segment_bytes=1, timeout=1)
+
+        class _Probe:
+            scheme, host, port, request_path = "http", "h", 80, "/"
+            content_disposition = None
+            validator = ""
+            strong_validator = ""
+
+        _Probe.total = total
+        state = _FetchState(
+            fetcher, CancelToken(), _Probe(), "http://h/", str(part), fd,
+            journal, transfer_progress.NOOP, [(0, total)],
+            lambda u, p: None, 1.0, None,
+        )
+        seg = state.next_segment()
+
+        real_pwrite = os.pwrite
+        monkeypatch.setattr(
+            os, "pwrite",
+            lambda f, buf, offset: real_pwrite(f, bytes(buf)[:1000], offset),
+        )
+
+        class FakeResponse:
+            status = 206
+            will_close = False
+
+            def __init__(self):
+                self.sent = 0
+                self.length = total
+
+            def getheader(self, name, default=None):
+                if name == "Content-Range":
+                    return f"bytes 0-{total - 1}/{total}"
+                return default
+
+            def read(self, n):
+                chunk = data[self.sent : self.sent + n]
+                self.sent += len(chunk)
+                self.length -= len(chunk)
+                return chunk
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                pass
+
+        drained = fetcher._consume_response(state, seg, FakeResponse())
+        assert drained is True and seg.pos == total
+        os.close(fd)
+        journal.close()
+        fetcher.close()
+        assert part.read_bytes() == data, "journaled bytes never hit the disk"
+
+    def test_failure_stops_all_segments(self):
+        fetcher, state = make_state([(0, 10_000_000), (10_000_000, 20_000_000)])
+        a = state.next_segment()
+        b = state.next_segment()
+        state.fail(RangeDropped())
+        assert a.stop.is_set() and b.stop.is_set()
+        assert state.next_segment() is None
+        assert isinstance(state.failure, RangeDropped)
+        fetcher.close()
