@@ -8,7 +8,7 @@ APP         := downloader
 BINDIR      := bin
 DOCKER_IMAGE ?= downloader-tpu
 
-.PHONY: all dep build native wheel docker-build fmt fmt-fix test bench clean
+.PHONY: all dep build native wheel docker-build fmt fmt-fix analyze test bench clean
 
 all: dep native build
 
@@ -71,6 +71,14 @@ fmt:
 
 fmt-fix:
 	$(PYTHON) hack/fmt.py --fix downloader_tpu tests bench.py __graft_entry__.py
+
+# Concurrency & resource-safety static analysis (go vet analogue):
+# guarded-by, no-blocking-under-lock, resource-finalization,
+# lock-order, exception-hygiene over the whole package. Also enforced
+# inside the test suite (tests/test_static_analysis.py); this target
+# is the standalone CI/pre-commit entry point.
+analyze:
+	$(PYTHON) -m downloader_tpu.analysis
 
 test:
 	$(PYTHON) -m pytest tests/ -q

@@ -1,0 +1,45 @@
+"""In-tree concurrency & resource-safety static analyzer.
+
+Every recent regression class in this codebase — dangling multipart
+uploads, leaked sockets on cancel, stale journal reuse, a worker
+thread killed by an escaped exception — was a cross-thread or
+cross-path invariant no single test enumerated. This package turns
+those invariants into AST-level checkers (stdlib ``ast`` only) that
+run over the whole ``downloader_tpu`` package on every tier-1
+invocation (tests/test_static_analysis.py) and standalone via
+``python -m downloader_tpu.analysis``.
+
+Shipped rules (see README "Static analysis" for the operator-facing
+catalog):
+
+- ``guarded-by`` — attributes annotated ``# guarded-by: _lock`` may
+  only be touched while that lock is held (lexically inside
+  ``with self._lock:`` or in a function annotated ``# holds: _lock``).
+- ``no-blocking-under-lock`` — no sleeps, joins, socket I/O, or
+  future/event waits while any lock is held.
+- ``resource-finalization`` — sockets/files/tempfiles created in a
+  function must reach close/unlink on ALL paths (``with``,
+  ``try/finally``, or a re-raising handler), unless ownership escapes.
+- ``lock-order`` — the static lock-acquisition graph (nested ``with``
+  blocks plus ``# holds:`` annotations) must be cycle-free.
+- ``exception-hygiene`` — no bare ``except:``, no silent broad
+  ``except Exception: pass``, and ``threading.Thread`` targets must
+  not let exceptions escape (they kill the worker silently).
+
+Suppression syntax, inline on the offending line::
+
+    something_flagged()  # analysis: ignore[rule-id] why it is safe
+
+A suppression without a written reason is itself a violation
+(``suppression``): the reason IS the review artifact.
+"""
+
+from .core import (  # noqa: F401
+    Analyzer,
+    Module,
+    Violation,
+    all_checkers,
+    analyze_paths,
+    iter_package_files,
+)
+from . import checkers as _checkers  # noqa: F401  (registers the rule set)

@@ -1,0 +1,64 @@
+"""CLI: ``python -m downloader_tpu.analysis [paths...] [--json]``.
+
+With no paths, analyzes the installed ``downloader_tpu`` package —
+the same scope tier-1 enforces — so CI and pre-commit can run the
+gate standalone. Exit status: 0 clean, 1 violations, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .core import Analyzer, iter_package_files
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m downloader_tpu.analysis",
+        description="concurrency & resource-safety static analysis",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories to analyze (default: the package)",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="machine-readable output (one object, 'violations' list)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.paths:
+        from .core import analyze_paths
+
+        violations = analyze_paths(args.paths)
+    else:
+        # whole-package mode: the full scope is in view, so stale
+        # suppressions of cross-module rules are decidable too
+        violations = Analyzer(full_scope=True).run(iter_package_files())  # type: ignore[arg-type]
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "violations": [v.to_dict() for v in violations],
+                    "count": len(violations),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for violation in violations:
+            print(violation)
+        if violations:
+            print(f"\n{len(violations)} violation(s)")
+        else:
+            print("ok: no violations")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,306 @@
+"""Shared AST scan: one pass per function producing the lock-aware
+event stream the checkers consume.
+
+The scan tracks, lexically, which locks are held at every point of a
+function body: a ``with <expr>:`` whose context expression resolves to
+a lock-like dotted path (final component matching ``*lock``) pushes
+that path for the duration of the block; a ``# holds: <lock>`` def
+annotation seeds the whole body (for functions documented as "caller
+holds the lock"). Lock paths are dotted attribute chains rooted at
+``self`` (``_lock``, ``_session._lock``), resolved through simple
+local aliases (``session = self._session`` makes ``session._lock``
+resolve to ``_session._lock``).
+
+Everything is lexical and intra-function by design: no inter-
+procedural dataflow, no type inference. The rules err toward false
+negatives (a lock reached through an unresolvable expression is
+invisible) rather than false positives; the suppression syntax exists
+for the residue.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from .core import Module
+
+LOCK_NAME_RE = re.compile(r"(^|_)(r?lock|mutex)$", re.IGNORECASE)
+
+# method names that block the calling thread: sleeps, joins, socket
+# I/O, HTTP round trips, future/event waits. Name-based on purpose —
+# the receiver's type is unknowable statically, and a false hit is one
+# suppression with a written reason
+BLOCKING_NAMES = frozenset(
+    {
+        "sleep",
+        "join",
+        "recv",
+        "recv_into",
+        "recvfrom",
+        "recvfrom_into",
+        "send",
+        "sendall",
+        "sendto",
+        "connect",
+        "accept",
+        "getresponse",
+        "select",
+        "wait",
+        "result",
+    }
+)
+
+
+@dataclass
+class AttrAccess:
+    """A ``self.<path>`` touch (read or write) inside a method."""
+
+    attr: str
+    line: int
+    held: tuple[str, ...]  # raw lock paths held at the access
+    func_name: str
+    class_name: str | None
+    is_store: bool
+
+
+@dataclass
+class LockAcquire:
+    """One ``with <lock>:`` entry."""
+
+    path: str  # raw dotted path, e.g. "_lock", "_session._lock"
+    line: int
+    held: tuple[str, ...]  # raw paths already held when acquiring
+    func_name: str
+    class_name: str | None
+
+
+@dataclass
+class BlockingCall:
+    name: str
+    line: int
+    held: tuple[str, ...]
+
+
+@dataclass
+class GuardDecl:
+    """``self.X = ...  # guarded-by: <lock>`` registration."""
+
+    attr: str
+    lock: str
+    line: int
+    class_name: str | None
+
+
+@dataclass
+class FunctionScan:
+    node: ast.FunctionDef
+    class_name: str | None
+    accesses: list[AttrAccess] = field(default_factory=list)
+    acquires: list[LockAcquire] = field(default_factory=list)
+    blocking: list[BlockingCall] = field(default_factory=list)
+
+
+@dataclass
+class ModuleScan:
+    module: Module
+    functions: list[FunctionScan] = field(default_factory=list)
+    guards: list[GuardDecl] = field(default_factory=list)
+
+
+def dotted_from_self(node: ast.AST, aliases: dict[str, str]) -> str | None:
+    """The dotted attribute path of ``node`` relative to ``self``
+    (``self._a.b`` -> ``"_a.b"``), resolving one level of local
+    aliasing; None when the expression is not self-rooted."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if not isinstance(cur, ast.Name):
+        return None
+    parts.reverse()
+    if cur.id == "self":
+        return ".".join(parts) if parts else None
+    base = aliases.get(cur.id)
+    if base is None:
+        return None
+    return ".".join([base] + parts) if parts else base
+
+
+def is_lock_path(path: str) -> bool:
+    return bool(LOCK_NAME_RE.search(path.rsplit(".", 1)[-1]))
+
+
+def scan_module(module: Module) -> ModuleScan:
+    scan = ModuleScan(module)
+    for node in module.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_function(scan, node, None)
+        elif isinstance(node, ast.ClassDef):
+            _scan_class(scan, node)
+    return scan
+
+
+def _scan_class(scan: ModuleScan, cls: ast.ClassDef) -> None:
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_function(scan, node, cls.name)
+        elif isinstance(node, ast.ClassDef):
+            _scan_class(scan, node)
+
+
+def _scan_function(
+    scan: ModuleScan,
+    func: ast.FunctionDef,
+    class_name: str | None,
+) -> None:
+    out = FunctionScan(func, class_name)
+    scan.functions.append(out)
+    module = scan.module
+    aliases: dict[str, str] = {}
+    base_held = tuple(module.holds_for(func))
+
+    def note_guard_decl(stmt: ast.stmt, target: ast.expr) -> None:
+        if not (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+        ):
+            return
+        end = getattr(stmt, "end_lineno", stmt.lineno) or stmt.lineno
+        for line in range(stmt.lineno, end + 1):
+            lock = module.guarded_lines.get(line)
+            if lock is not None:
+                scan.guards.append(
+                    GuardDecl(target.attr, lock, stmt.lineno, class_name)
+                )
+                return
+
+    def scan_expr(node: ast.AST | None, held: tuple[str, ...]) -> None:
+        if node is None:
+            return
+        stack: list[ast.AST] = [node]
+        while stack:
+            sub = stack.pop()
+            # code inside nested defs/lambdas runs later, on whichever
+            # thread calls it — never under the lexically-current
+            # locks; only its default expressions evaluate here (a
+            # pruned manual walk: ast.walk cannot skip subtrees)
+            if isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                stack.extend(getattr(sub.args, "defaults", []))
+                stack.extend(
+                    d
+                    for d in getattr(sub.args, "kw_defaults", []) or []
+                    if d is not None
+                )
+                continue
+            stack.extend(ast.iter_child_nodes(sub))
+            if isinstance(sub, ast.Attribute):
+                path = dotted_from_self(sub, aliases)
+                if path is not None:
+                    out.accesses.append(
+                        AttrAccess(
+                            path,
+                            sub.lineno,
+                            held,
+                            func.name,
+                            class_name,
+                            isinstance(sub.ctx, (ast.Store, ast.Del)),
+                        )
+                    )
+            elif isinstance(sub, ast.Call) and held:
+                name = None
+                if isinstance(sub.func, ast.Attribute):
+                    name = sub.func.attr
+                elif isinstance(sub.func, ast.Name):
+                    name = sub.func.id
+                if name in BLOCKING_NAMES:
+                    out.blocking.append(BlockingCall(name, sub.lineno, held))
+
+    def walk(stmts: list[ast.stmt], held: tuple[str, ...]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _scan_function(scan, stmt, class_name)
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                _scan_class(scan, stmt)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = held
+                for item in stmt.items:
+                    scan_expr(item.context_expr, held)
+                    path = dotted_from_self(item.context_expr, aliases)
+                    if path is not None and is_lock_path(path):
+                        out.acquires.append(
+                            LockAcquire(
+                                path,
+                                stmt.lineno,
+                                inner,
+                                func.name,
+                                class_name,
+                            )
+                        )
+                        inner = inner + (path,)
+                walk(stmt.body, inner)
+                continue
+            if isinstance(stmt, ast.If):
+                scan_expr(stmt.test, held)
+                walk(stmt.body, held)
+                walk(stmt.orelse, held)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                scan_expr(stmt.iter, held)
+                scan_expr(stmt.target, held)
+                walk(stmt.body, held)
+                walk(stmt.orelse, held)
+                continue
+            if isinstance(stmt, ast.While):
+                scan_expr(stmt.test, held)
+                walk(stmt.body, held)
+                walk(stmt.orelse, held)
+                continue
+            if isinstance(stmt, ast.Try):
+                walk(stmt.body, held)
+                for handler in stmt.handlers:
+                    scan_expr(handler.type, held)
+                    walk(handler.body, held)
+                walk(stmt.orelse, held)
+                walk(stmt.finalbody, held)
+                continue
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (
+                    stmt.targets
+                    if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+                for target in targets:
+                    note_guard_decl(stmt, target)
+                value = getattr(stmt, "value", None)
+                # track simple `name = self.<...>` aliases so a later
+                # `with name._lock:` resolves; any other rebind of the
+                # name invalidates a stale alias
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                ):
+                    path = (
+                        dotted_from_self(value, aliases)
+                        if value is not None
+                        else None
+                    )
+                    if path is not None:
+                        aliases[stmt.targets[0].id] = path
+                    else:
+                        aliases.pop(stmt.targets[0].id, None)
+                scan_expr(value, held)
+                for target in targets:
+                    scan_expr(target, held)
+                continue
+            scan_expr(stmt, held)
+
+    walk(func.body, base_held)

@@ -1,0 +1,602 @@
+"""The shipped rule set. Each checker is grounded in a regression
+class this codebase has actually paid for (see module docs referenced
+per rule): the analyzer exists to make those one-time lessons
+mechanical.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import astwalk
+from .core import Checker, Module, Violation, find_cycles, register
+
+_BROAD_EXCEPTIONS = {"Exception", "BaseException"}
+
+# resource-creating callables recognized by terminal name; functions
+# annotated `# resource-factory` on their def line join this set
+_RESOURCE_FACTORIES = {
+    "open",
+    "socket",
+    "create_connection",
+    "socketpair",
+    "mkstemp",
+    "mkdtemp",
+    "NamedTemporaryFile",
+    "TemporaryFile",
+    "SpooledTemporaryFile",
+    "makefile",
+    "fdopen",
+}
+
+# calls that settle a resource: close/unlink family, pool hand-backs
+_CLEANUP_NAMES = {
+    "close",
+    "unlink",
+    "remove",
+    "rmtree",
+    "release",
+    "shutdown",
+    "terminate",
+    "detach",
+}
+
+
+def _scan(module: Module) -> astwalk.ModuleScan:
+    # one shared scan per module per Analyzer run; checkers run in
+    # sequence on the same thread, so a plain memo on the module works
+    cached = getattr(module, "_astwalk_scan", None)
+    if cached is None:
+        cached = astwalk.scan_module(module)
+        module._astwalk_scan = cached  # type: ignore[attr-defined]
+    return cached
+
+
+@register
+class GuardedByChecker(Checker):
+    """Attributes annotated ``# guarded-by: <lock>`` may only be
+    touched while that lock is held (lexically, or via a ``# holds:``
+    def annotation). ``__init__`` is exempt: no other thread can hold a
+    reference during construction. This is the static form of the
+    invariants connpool/pipeline/segments already document in prose —
+    the dangling-upload and stale-journal regressions were all
+    unguarded cross-thread state in disguise."""
+
+    rule = "guarded-by"
+
+    def check(self, module: Module) -> list[Violation]:
+        scan = _scan(module)
+        guards: dict[tuple[str | None, str], str] = {}
+        for decl in scan.guards:
+            guards[(decl.class_name, decl.attr)] = decl.lock
+        if not guards:
+            return []
+        out: list[Violation] = []
+        seen: set[tuple[int, str]] = set()
+        for func in scan.functions:
+            if func.node.name == "__init__":
+                continue
+            for access in func.accesses:
+                lock = guards.get((access.class_name, access.attr))
+                if lock is None or lock in access.held:
+                    continue
+                key = (access.line, access.attr)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(
+                    Violation(
+                        self.rule,
+                        module.path,
+                        access.line,
+                        f"'self.{access.attr}' is guarded by '{lock}' but "
+                        f"accessed in {access.func_name}() without it "
+                        f"(held: {list(access.held) or 'none'})",
+                    )
+                )
+        return out
+
+
+@register
+class BlockingUnderLockChecker(Checker):
+    """No sleeps, joins, socket I/O, or future/event waits while any
+    lock is held: a blocked holder turns every other thread that needs
+    the lock into a convoy, and a blocked holder that also waits on
+    one of those threads is a deadlock (the pipeline drains part
+    futures OUTSIDE the session lock for exactly this reason)."""
+
+    rule = "no-blocking-under-lock"
+
+    def check(self, module: Module) -> list[Violation]:
+        out: list[Violation] = []
+        for func in _scan(module).functions:
+            for call in func.blocking:
+                out.append(
+                    Violation(
+                        self.rule,
+                        module.path,
+                        call.line,
+                        f"blocking call '{call.name}()' while holding "
+                        f"{list(call.held)}",
+                    )
+                )
+        return out
+
+
+@register
+class LockOrderChecker(Checker):
+    """The static lock-acquisition graph must be cycle-free. Nodes are
+    class-qualified lock paths; an edge A->B is recorded whenever
+    ``with B:`` executes while A is held (nested ``with`` blocks, or a
+    ``# holds: A`` function acquiring B). Two threads taking the same
+    two locks in opposite orders is the one concurrency bug that no
+    amount of testing reliably reproduces — it is purely a property of
+    the code shape, which is exactly what a static pass can prove."""
+
+    rule = "lock-order"
+    cross_module = True  # a cycle can close through another module
+
+    def __init__(self) -> None:
+        # edge -> first (path, line) that exhibits it
+        self._edges: dict[tuple[str, str], tuple[str, int]] = {}
+
+    @staticmethod
+    def _ident(class_name: str | None, module: Module, path: str) -> str:
+        owner = class_name or module.path.rsplit("/", 1)[-1]
+        return f"{owner}.{path}"
+
+    def check(self, module: Module) -> list[Violation]:
+        for func in _scan(module).functions:
+            for acq in func.acquires:
+                new = self._ident(acq.class_name, module, acq.path)
+                for held in acq.held:
+                    src = self._ident(acq.class_name, module, held)
+                    if src == new:
+                        continue
+                    self._edges.setdefault(
+                        (src, new), (module.path, acq.line)
+                    )
+        return []
+
+    def finalize(self) -> list[Violation]:
+        graph: dict[str, list[str]] = {}
+        for src, dst in self._edges:
+            graph.setdefault(src, []).append(dst)
+        out: list[Violation] = []
+        for edge_src, edge_dst, cycle in find_cycles(graph):
+            edge = self._edges.get((edge_src, edge_dst)) or next(
+                iter(self._edges.values())
+            )
+            out.append(
+                Violation(
+                    self.rule,
+                    edge[0],
+                    edge[1],
+                    "lock-order cycle: " + " -> ".join(cycle),
+                )
+            )
+        return out
+
+    def edges(self) -> dict[tuple[str, str], tuple[str, int]]:
+        """The collected acquisition edges (introspection/tests)."""
+        return dict(self._edges)
+
+
+@register
+class ResourceFinalizationChecker(Checker):
+    """A socket/file/tempfile created in a function must reach
+    close/unlink on every path: managed by ``with``, closed in a
+    ``finally``, or closed in an exception handler paired with a
+    normal-path close — unless ownership escapes (returned, stored on
+    an object, handed to another call). Leaked sockets on cancel were
+    a real regression class; this rule makes 'who closes it' a
+    property the suite checks instead of a review question."""
+
+    rule = "resource-finalization"
+    cross_module = True  # `# resource-factory` defs extend the rule remotely
+
+    def __init__(self) -> None:
+        self._factories = set(_RESOURCE_FACTORIES)
+
+    def prepare(self, modules: list[Module]) -> None:
+        # functions annotated `# resource-factory` contribute their
+        # name: calls to them are resource creations wherever they
+        # appear (terminal-name matching, same as the builtin set)
+        for module in modules:
+            if not module.factory_lines:
+                continue  # nothing annotated: skip the full-tree walk
+            for node in ast.walk(module.tree):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and (
+                    node.lineno in module.factory_lines
+                    or any(
+                        line in module.factory_lines
+                        for line in range(
+                            node.lineno,
+                            (node.body[0].lineno if node.body else node.lineno)
+                            + 1,
+                        )
+                    )
+                ):
+                    self._factories.add(node.name)
+
+    @staticmethod
+    def _terminal_name(func: ast.expr) -> str | None:
+        if isinstance(func, ast.Attribute):
+            return func.attr
+        if isinstance(func, ast.Name):
+            return func.id
+        return None
+
+    def check(self, module: Module) -> list[Violation]:
+        out: list[Violation] = []
+        for scan_fn in _scan(module).functions:
+            out.extend(self._check_function(module, scan_fn.node))
+        return out
+
+    def _check_function(
+        self, module: Module, func: ast.FunctionDef
+    ) -> list[Violation]:
+        # creations: `name = factory(...)` / `fd, path = mkstemp()`
+        creations: list[tuple[str, int, str]] = []
+        for node in self._walk_own(func):
+            if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call
+            ):
+                continue
+            factory = self._terminal_name(node.value.func)
+            if factory not in self._factories:
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    creations.append((target.id, node.lineno, factory))
+                elif isinstance(target, (ast.Tuple, ast.List)):
+                    for elt in target.elts:
+                        if isinstance(elt, ast.Name):
+                            creations.append((elt.id, node.lineno, factory))
+        if not creations:
+            return []
+
+        out: list[Violation] = []
+        for name, line, factory in creations:
+            verdict = self._settles(func, name, line)
+            if verdict is None:
+                continue
+            out.append(
+                Violation(
+                    self.rule,
+                    module.path,
+                    line,
+                    f"'{name}' from {factory}() {verdict}",
+                )
+            )
+        return out
+
+    def _walk_own(self, func: ast.FunctionDef):
+        """Walk ``func`` without descending into nested defs/lambdas."""
+        stack: list[ast.AST] = list(func.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _settles(
+        self, func: ast.FunctionDef, name: str, created_line: int
+    ) -> str | None:
+        """None when the resource is handled; else the complaint."""
+        escaped = False
+        with_managed = False
+        finally_close = False
+        handler_close = False
+        normal_close = False
+
+        finally_ranges: list[tuple[int, int]] = []
+        handler_ranges: list[tuple[int, int]] = []
+        for node in self._walk_own(func):
+            if isinstance(node, ast.Try) and node.finalbody:
+                lo = node.finalbody[0].lineno
+                hi = max(
+                    getattr(s, "end_lineno", s.lineno) or s.lineno
+                    for s in node.finalbody
+                )
+                finally_ranges.append((lo, hi))
+            if isinstance(node, ast.ExceptHandler):
+                lo = node.body[0].lineno if node.body else node.lineno
+                hi = max(
+                    (
+                        getattr(s, "end_lineno", s.lineno) or s.lineno
+                        for s in node.body
+                    ),
+                    default=node.lineno,
+                )
+                handler_ranges.append((lo, hi))
+
+        def in_ranges(line: int, ranges: list[tuple[int, int]]) -> bool:
+            return any(lo <= line <= hi for lo, hi in ranges)
+
+        for node in self._walk_own(func):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Name) and expr.id == name:
+                        with_managed = True
+                    # contextlib.closing(name) / suppress-style wrappers
+                    if isinstance(expr, ast.Call) and any(
+                        isinstance(arg, ast.Name) and arg.id == name
+                        for arg in expr.args
+                    ):
+                        with_managed = True
+            if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                value = getattr(node, "value", None)
+                if value is not None and self._mentions(value, name):
+                    escaped = True
+            if isinstance(node, ast.Assign):
+                stores_elsewhere = any(
+                    not isinstance(t, ast.Name) for t in node.targets
+                )
+                if stores_elsewhere and self._mentions(node.value, name):
+                    escaped = True
+            if isinstance(node, ast.Call):
+                terminal = self._terminal_name(node.func)
+                receiver_is_name = isinstance(
+                    node.func, ast.Attribute
+                ) and self._rooted_at(node.func.value, name)
+                if terminal in _CLEANUP_NAMES and (
+                    receiver_is_name
+                    or any(
+                        self._mentions(arg, name)
+                        for arg in list(node.args)
+                        + [kw.value for kw in node.keywords]
+                    )
+                ):
+                    if in_ranges(node.lineno, finally_ranges):
+                        finally_close = True
+                    elif in_ranges(node.lineno, handler_ranges):
+                        handler_close = True
+                    else:
+                        normal_close = True
+                elif not receiver_is_name and any(
+                    isinstance(arg, ast.Name) and arg.id == name
+                    for arg in list(node.args)
+                    + [kw.value for kw in node.keywords]
+                ):
+                    # handed to another callable: ownership may move
+                    # (cls(fd), atexit.register(rmtree, path), ...)
+                    escaped = True
+
+        if escaped or with_managed or finally_close:
+            return None
+        if handler_close and normal_close:
+            return None  # the close-in-handler + close-on-success idiom
+        if normal_close or handler_close:
+            return (
+                "is closed on some paths only; use `with`, try/finally, "
+                "or pair the handler close with a success-path close"
+            )
+        return "never reaches close/unlink in this function"
+
+    @staticmethod
+    def _mentions(node: ast.AST, name: str) -> bool:
+        return any(
+            isinstance(sub, ast.Name) and sub.id == name
+            for sub in ast.walk(node)
+        )
+
+    @staticmethod
+    def _rooted_at(node: ast.AST, name: str) -> bool:
+        while isinstance(node, ast.Attribute):
+            node = node.value
+        return isinstance(node, ast.Name) and node.id == name
+
+
+@register
+class ExceptionHygieneChecker(Checker):
+    """No bare ``except:``, no silent broad swallows, and thread
+    targets must be shielded. An exception escaping a thread target
+    kills the worker with nothing but a stderr traceback — the webseed
+    bug class: the job hangs instead of failing. A silent broad
+    ``except Exception: pass`` is the same bug in slow motion."""
+
+    rule = "exception-hygiene"
+
+    @staticmethod
+    def _is_broad(type_node: ast.expr | None) -> bool:
+        if type_node is None:
+            return True
+        names = []
+        if isinstance(type_node, ast.Tuple):
+            names = [
+                n.id for n in type_node.elts if isinstance(n, ast.Name)
+            ]
+        elif isinstance(type_node, ast.Name):
+            names = [type_node.id]
+        return any(n in _BROAD_EXCEPTIONS for n in names)
+
+    def check(self, module: Module) -> list[Violation]:
+        out: list[Violation] = []
+        out.extend(self._check_handlers(module))
+        out.extend(self._check_thread_targets(module))
+        return out
+
+    def _check_handlers(self, module: Module) -> list[Violation]:
+        out = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                out.append(
+                    Violation(
+                        self.rule,
+                        module.path,
+                        node.lineno,
+                        "bare 'except:' also swallows KeyboardInterrupt/"
+                        "SystemExit; name the exceptions (or Exception)",
+                    )
+                )
+                continue
+            body_is_silent = all(
+                isinstance(stmt, ast.Pass)
+                or (
+                    isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Constant)
+                )
+                for stmt in node.body
+            )
+            if body_is_silent and self._is_broad(node.type):
+                out.append(
+                    Violation(
+                        self.rule,
+                        module.path,
+                        node.lineno,
+                        "silent broad swallow: narrow the exception type "
+                        "or log what was ignored",
+                    )
+                )
+        return out
+
+    def _check_thread_targets(self, module: Module) -> list[Violation]:
+        # index functions for target resolution
+        methods: dict[tuple[str | None, str], ast.FunctionDef] = {}
+
+        def index(body: list[ast.stmt], cls: str | None) -> None:
+            for node in body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    methods[(cls, node.name)] = node
+                    index(node.body, cls)
+                elif isinstance(node, ast.ClassDef):
+                    index(node.body, node.name)
+
+        index(module.tree.body, None)
+
+        # walk Call nodes carrying the ENCLOSING class, so a
+        # self.<method> target resolves against exactly that class —
+        # never borrowing a same-named (shielded) method elsewhere
+        def iter_calls(node: ast.AST, cls: str | None):
+            for child in ast.iter_child_nodes(node):
+                child_cls = (
+                    child.name if isinstance(child, ast.ClassDef) else cls
+                )
+                if isinstance(child, ast.Call):
+                    yield child, child_cls
+                yield from iter_calls(child, child_cls)
+
+        out = []
+        for node, cls in iter_calls(module.tree, None):
+            terminal = (
+                node.func.attr
+                if isinstance(node.func, ast.Attribute)
+                else node.func.id
+                if isinstance(node.func, ast.Name)
+                else None
+            )
+            if terminal not in ("Thread", "Timer"):
+                continue
+            target = next(
+                (kw.value for kw in node.keywords if kw.arg == "target"),
+                None,
+            )
+            if target is None:
+                continue
+            resolved = self._resolve_target(target, methods, cls)
+            if resolved is None:
+                continue  # lambda/partial/unknown: out of static reach
+            if self._is_shielded(resolved, methods, cls):
+                continue
+            out.append(
+                Violation(
+                    self.rule,
+                    module.path,
+                    node.lineno,
+                    f"thread target '{resolved.name}' has no broad "
+                    "exception handler: an escaped exception kills the "
+                    "worker silently",
+                )
+            )
+        return out
+
+    @staticmethod
+    def _resolve_target(
+        target: ast.expr,
+        methods: dict[tuple[str | None, str], ast.FunctionDef],
+        cls: str | None,
+    ) -> ast.FunctionDef | None:
+        if isinstance(target, ast.Attribute) and isinstance(
+            target.value, ast.Name
+        ) and target.value.id == "self":
+            # exact class only — a base-class method defined in another
+            # module is out of static reach and skipped, never guessed
+            return methods.get((cls, target.attr))
+        if isinstance(target, ast.Name):
+            # module-level function, or a helper def nested in this
+            # class's methods (indexed under the class)
+            return methods.get((None, target.id)) or methods.get(
+                (cls, target.id)
+            )
+        return None
+
+    def _is_shielded(
+        self,
+        func: ast.FunctionDef,
+        methods: dict[tuple[str | None, str], ast.FunctionDef],
+        cls: str | None = None,
+        depth: int = 0,
+    ) -> bool:
+        """A broad handler (bare counts) somewhere in the function's
+        own statement tree. Thin delegating wrappers — a body that is a
+        single call (optionally inside one ``with``, the
+        ``tracing.adopt`` pattern) — are followed up to three hops so
+        the shield can live in the real worker."""
+        stack: list[ast.AST] = list(func.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, ast.ExceptHandler) and self._is_broad(
+                node.type
+            ):
+                # a broad handler that just re-raises is not a shield
+                if not (
+                    len(node.body) == 1
+                    and isinstance(node.body[0], ast.Raise)
+                    and node.body[0].exc is None
+                ):
+                    return True
+            stack.extend(ast.iter_child_nodes(node))
+        if depth >= 3:
+            return False
+        delegate = self._delegation_call(func)
+        if delegate is not None:
+            # delegation stays within the wrapper's own class (the
+            # tracing.adopt wrapper pattern), so resolve with its cls
+            resolved = self._resolve_target(delegate, methods, cls)
+            if resolved is not None and resolved is not func:
+                return self._is_shielded(resolved, methods, cls, depth + 1)
+        return False
+
+    @staticmethod
+    def _delegation_call(func: ast.FunctionDef) -> ast.expr | None:
+        """The callee of a pure one-call wrapper body, else None."""
+        body = [
+            stmt
+            for stmt in func.body
+            if not (
+                isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+            )
+        ]
+        if len(body) == 1 and isinstance(body[0], ast.With):
+            body = body[0].body
+        if (
+            len(body) == 1
+            and isinstance(body[0], ast.Expr)
+            and isinstance(body[0].value, ast.Call)
+        ):
+            return body[0].value.func
+        return None

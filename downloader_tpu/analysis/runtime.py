@@ -1,0 +1,206 @@
+"""Runtime lock-order recorder: the dynamic half of the ``lock-order``
+rule.
+
+The static checker proves the LEXICAL acquisition graph acyclic, but
+it cannot see orders established through calls (session lock held in
+``add_span`` while the part pool takes its own lock two classes away).
+This recorder patches ``threading.Lock``/``threading.RLock`` so every
+lock created while it is installed records, per thread, the stack of
+held locks — and every acquisition adds "held -> acquired" edges to a
+process-wide graph keyed by each lock's CREATION SITE (file:line of
+the constructor call, the runtime analogue of the static checker's
+class-qualified lock path). A cycle in that graph is a deadlock that
+merely hasn't fired yet.
+
+Used by tests/conftest.py around the pipeline/segments/queue suites
+and directly by tests/test_static_analysis.py.
+
+Scope notes: locks created BEFORE ``install()`` are invisible (they
+are real Lock objects already); same-site edges (two instances from
+one constructor line) are skipped — an instance-level ladder over one
+class's lock is out of scope for a site-keyed graph. The wrapper
+implements the private ``_release_save``/``_acquire_restore``/
+``_is_owned`` surface so ``threading.Condition`` keeps working (its
+``wait`` really releases, which the held-stack must mirror).
+"""
+
+from __future__ import annotations
+
+import queue as _queue_module
+import sys
+import threading
+from collections import defaultdict
+
+from .core import find_cycles
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+# exact module files, not name suffixes: a project/test module that
+# happens to be called queue.py (tests/test_queue.py runs under the
+# recorder!) must keep its own creation sites
+_SKIP_FILES = frozenset(
+    {__file__, threading.__file__, _queue_module.__file__}
+)
+
+
+def _creation_site() -> str:
+    """file:line of the nearest caller outside this module and the
+    stdlib threading/queue modules — so a Condition's internal RLock
+    or a queue.Queue's mutex is attributed to the code that made the
+    Condition/Queue, not to the stdlib line that wrapped it."""
+    frame = sys._getframe(2)
+    while frame is not None:
+        filename = frame.f_code.co_filename
+        if filename not in _SKIP_FILES:
+            return f"{filename}:{frame.f_lineno}"
+        frame = frame.f_back
+    return "<unknown>"
+
+
+class _RecordingLock:
+    """Wraps one real lock; mirrors acquire/release into the recorder."""
+
+    def __init__(self, recorder: "LockOrderRecorder", inner, site: str):
+        self._recorder = recorder
+        self._inner = inner
+        self._site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._recorder._note_acquire(self._site)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._recorder._note_release(self._site)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def _at_fork_reinit(self) -> None:
+        # os.register_at_fork handlers (concurrent.futures.thread
+        # registers one at import) reinitialize locks in the child
+        self._inner._at_fork_reinit()
+        held = getattr(self._recorder._tls, "held", None)
+        if held:
+            held.clear()
+
+    def __enter__(self) -> "_RecordingLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.release()
+
+    # -- threading.Condition compatibility surface ------------------------
+
+    def _is_owned(self) -> bool:
+        probe = getattr(self._inner, "_is_owned", None)
+        if probe is not None:
+            return probe()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        self._recorder._note_release(self._site)
+        saver = getattr(self._inner, "_release_save", None)
+        if saver is not None:
+            return saver()
+        self._inner.release()
+        return None
+
+    def _acquire_restore(self, state) -> None:
+        restorer = getattr(self._inner, "_acquire_restore", None)
+        if restorer is not None:
+            restorer(state)
+        else:
+            self._inner.acquire()
+        self._recorder._note_acquire(self._site)
+
+    def __repr__(self) -> str:
+        return f"<recorded {self._inner!r} from {self._site}>"
+
+
+class LockOrderRecorder:
+    def __init__(self) -> None:
+        # (held_site, acquired_site) -> observation count
+        self._edges: dict[tuple[str, str], int] = defaultdict(int)
+        self._edges_lock = _REAL_LOCK()
+        self._tls = threading.local()
+        self._installed = False
+
+    # -- wrapper bookkeeping ----------------------------------------------
+
+    def _held(self) -> list[str]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _note_acquire(self, site: str) -> None:
+        held = self._held()
+        if held:
+            with self._edges_lock:
+                for holder in held:
+                    if holder != site:
+                        self._edges[(holder, site)] += 1
+        held.append(site)
+
+    def _note_release(self, site: str) -> None:
+        held = self._held()
+        # remove the most recent occurrence: out-of-order releases are
+        # legal (lock chaining), LIFO is merely the common case
+        for index in range(len(held) - 1, -1, -1):
+            if held[index] == site:
+                del held[index]
+                return
+
+    # -- install/uninstall -------------------------------------------------
+
+    def install(self) -> "LockOrderRecorder":
+        if self._installed:
+            return self
+        recorder = self
+
+        def make_lock():
+            return _RecordingLock(recorder, _REAL_LOCK(), _creation_site())
+
+        def make_rlock():
+            return _RecordingLock(recorder, _REAL_RLOCK(), _creation_site())
+
+        threading.Lock = make_lock  # type: ignore[assignment]
+        threading.RLock = make_rlock  # type: ignore[assignment]
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        threading.Lock = _REAL_LOCK  # type: ignore[assignment]
+        threading.RLock = _REAL_RLOCK  # type: ignore[assignment]
+        self._installed = False
+
+    def __enter__(self) -> "LockOrderRecorder":
+        return self.install()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.uninstall()
+
+    # -- results -----------------------------------------------------------
+
+    def edges(self) -> dict[tuple[str, str], int]:
+        with self._edges_lock:
+            return dict(self._edges)
+
+    def cycles(self) -> list[list[str]]:
+        """Distinct cycles in the observed acquisition-order graph
+        (each as a site list closing on its first element); empty means
+        every test-observed ordering is consistent with ONE global lock
+        hierarchy — no latent deadlock among the locks exercised."""
+        graph: dict[str, list[str]] = defaultdict(list)
+        for held, acquired in self.edges():
+            graph[held].append(acquired)
+        return [cycle for _, _, cycle in find_cycles(graph)]

@@ -109,8 +109,8 @@ class ConnectionPool:
         self._timeout = timeout
         self._clock = clock
         self._lock = threading.Lock()
-        self._idle: dict[tuple, deque[PooledConnection]] = {}
-        self._closed = False
+        self._idle: dict[tuple, deque[PooledConnection]] = {}  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
 
     # -- lifecycle --------------------------------------------------------
 

@@ -128,10 +128,15 @@ class DispatchClient:
     def _display_loop(self, interval: float) -> None:
         # logs in-flight downloads every `interval` s (downloader.go:115-130)
         while not self._token.wait(interval):
-            for url, percent in sorted(self._progress.snapshot().items()):
-                log.with_fields(
-                    progress=math.ceil(percent * 100) / 100, url=url
-                ).info("download status")
+            try:
+                for url, percent in sorted(self._progress.snapshot().items()):
+                    log.with_fields(
+                        progress=math.ceil(percent * 100) / 100, url=url
+                    ).info("download status")
+            except Exception as exc:
+                # purely cosmetic thread: a formatting bug must not
+                # kill the status ticker for the rest of the process
+                log.debug(f"progress display tick failed: {exc}")
 
     # -- dispatch --------------------------------------------------------
 

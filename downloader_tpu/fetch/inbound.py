@@ -110,6 +110,7 @@ class _InboundPeer:
 
     def _send(self, msg_id: int, payload: bytes = b"") -> None:
         with self._send_lock:
+            # analysis: ignore[no-blocking-under-lock] _send_lock is this connection's dedicated write lock; serializing the blocking send is its entire job
             self._sock.sendall(_frame(msg_id, payload))
 
     def _enqueue(self, frame: bytes) -> None:
@@ -148,9 +149,19 @@ class _InboundPeer:
                 batch += extra
             try:
                 with self._send_lock:
+                    # analysis: ignore[no-blocking-under-lock] _send_lock is this connection's dedicated write lock; serializing the blocking send is its entire job
                     self._sock.sendall(batch)
             except OSError:
                 return  # dying connection; the serve loop reaps it
+            except Exception as exc:
+                # an escaped bug would kill the sender silently while
+                # the serve loop keeps queueing frames into the void;
+                # close the connection so both halves get reaped
+                log.with_fields(peer=self.addr[0]).warning(
+                    f"inbound sender failed: {exc}"
+                )
+                self.close()
+                return
             if done:
                 return
 
@@ -290,6 +301,7 @@ class _InboundPeer:
         reserved[5] |= 0x10  # BEP 10
         reserved[7] |= 0x04  # BEP 6
         with self._send_lock:
+            # analysis: ignore[no-blocking-under-lock] _send_lock is this connection's dedicated write lock; serializing the blocking send is its entire job
             self._sock.sendall(
                 bytes([len(HANDSHAKE_PSTR)])
                 + HANDSHAKE_PSTR
@@ -554,9 +566,19 @@ class PeerListener:
                 sock, addr = self._sock.accept()
             except OSError:
                 return  # listener closed
-            # identity form: mapped-v4 collapses so the allowed-fast
-            # derivation, PEX, and logs see the real v4 address
-            self._admit(sock, display_form(addr))
+            try:
+                # identity form: mapped-v4 collapses so the allowed-fast
+                # derivation, PEX, and logs see the real v4 address
+                self._admit(sock, display_form(addr))
+            except Exception as exc:
+                # one hostile/odd connection must not kill the accept
+                # loop — its death would silently stop ALL inbound
+                # serving for the rest of the process
+                log.warning(f"inbound admit failed: {exc}")
+                try:
+                    sock.close()
+                except OSError:
+                    pass
 
     def _accept_utp(self, stream: "utp.UTPSocket") -> None:
         # uTP streams enter the exact same serving path as TCP ones:
@@ -616,7 +638,13 @@ class PeerListener:
             with self._lock:
                 if self._closed:
                     return
-            self._rechoke()
+            try:
+                self._rechoke()
+            except Exception as exc:
+                # a rechoke bug must not kill the loop: with no choker,
+                # every current slot holder keeps it forever and no new
+                # leecher is ever unchoked
+                log.warning(f"rechoke failed: {exc}")
 
     def _rechoke(self) -> None:
         # the whole redistribution runs under the lock so the slot count
@@ -689,8 +717,8 @@ class PeerListener:
             for peer in heard:  # replay addresses heard before attach
                 try:
                     peer_sink(peer)
-                except Exception:  # pragma: no cover - sink owns errors
-                    pass
+                except Exception as exc:  # pragma: no cover - best effort
+                    log.debug(f"peer sink rejected replayed {peer}: {exc}")
         have = [i for i, done in enumerate(store.have) if done]
         for conn in conns:
             conn.arm(have)
@@ -709,8 +737,8 @@ class PeerListener:
                 return
         try:
             sink(peer)
-        except Exception:  # pragma: no cover - sink owns its errors
-            pass
+        except Exception as exc:  # pragma: no cover - best effort
+            log.debug(f"peer sink rejected heard {peer}: {exc}")
 
     def notify_have(self, index: int) -> None:
         with self._lock:

@@ -241,9 +241,15 @@ class LSD:
                 pass  # transient; the periodic loop retries
 
     def _announce_loop(self) -> None:
-        self._announce()  # immediate presence
-        while not self._closed.wait(timeout=self._interval):
-            self._announce()
+        try:
+            self._announce()  # immediate presence
+            while not self._closed.wait(timeout=self._interval):
+                self._announce()
+        except Exception as exc:
+            # LSD is a best-effort discovery side channel: a dead
+            # announce loop must degrade to "no LAN presence", never
+            # take anything else down — but say so, once
+            log.warning(f"LSD announce loop stopped: {exc}")
 
     # -- listening -------------------------------------------------------
 
@@ -278,8 +284,8 @@ class LSD:
                 continue
             try:
                 self._on_peer((addr[0], peer_port))
-            except Exception:  # pragma: no cover - callback owns errors
-                pass
+            except Exception as exc:  # pragma: no cover - best effort
+                log.debug(f"LSD peer callback failed for {addr[0]}: {exc}")
             # responsive announce for NEW peers: the sender may have
             # started after our last announce and not know us. Floored
             # (see RESPONSIVE_FLOOR); when the floor blocks it, the

@@ -574,9 +574,14 @@ class SwarmDownloader:
     def _announce_teardown(
         self, completed: bool, port: int, uploaded: int, downloaded: int, left: int
     ) -> None:
-        if completed:
-            self._announce_event("completed", port, uploaded, downloaded, 0)
-        self._announce_event("stopped", port, uploaded, downloaded, left)
+        try:
+            if completed:
+                self._announce_event("completed", port, uploaded, downloaded, 0)
+            self._announce_event("stopped", port, uploaded, downloaded, left)
+        except Exception as exc:
+            # lifecycle events are best-effort courtesy to the tracker;
+            # the job is already settled when this thread runs
+            log.debug(f"tracker teardown announce failed: {exc}")
 
     def _run(
         self, token: CancelToken, progress, listener: "PeerListener | None"

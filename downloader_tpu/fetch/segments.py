@@ -197,7 +197,7 @@ class SpanJournal:
                  validator: str = ""):
         self.path = path
         self.total = total
-        self.spans = spans
+        self.spans = spans  # guarded-by: _lock
         self._lock = threading.Lock()
         mode = "w" if fresh else "a"
         self._sink = open(path, mode)
@@ -338,10 +338,12 @@ class _FetchState:
         self.trace_parent = trace_parent
         self._progress_interval = progress_interval
         self._lock = threading.Lock()
-        self._queue: list[_Segment] = [_Segment(lo, hi) for lo, hi in ranges]
-        self._active: list[_Segment] = []
-        self.failure: BaseException | None = None
-        self.redispatches = 0
+        self._queue: list[_Segment] = [  # guarded-by: _lock
+            _Segment(lo, hi) for lo, hi in ranges
+        ]
+        self._active: list[_Segment] = []  # guarded-by: _lock
+        self.failure: BaseException | None = None  # guarded-by: _lock
+        self.redispatches = 0  # guarded-by: _lock
         # endgame budget: ONE rescue per fetch (the ISSUE's "re-issue
         # the slowest segment's remaining range", singular). Healthy
         # segments all finish around the same time; letting every
@@ -349,9 +351,9 @@ class _FetchState:
         # tail of the file in duplicate — measured 0.78x on the bench
         # instead of a win. One rescue bounds the duplicate waste to
         # one segment while still unsticking a genuinely dead tail.
-        self._rescue_budget = 1
-        self._bytes_done = 0
-        self._last_tick = time.monotonic()
+        self._rescue_budget = 1  # guarded-by: _lock
+        self._bytes_done = 0  # guarded-by: _lock
+        self._last_tick = time.monotonic()  # guarded-by: _lock
 
     # -- work distribution ------------------------------------------------
 
@@ -472,7 +474,7 @@ class SegmentedFetcher:
         self._timeout = timeout
         self._max_attempts = max_attempts
         self._progress_interval = progress_interval
-        self._declined: dict[str, float] = {}  # url -> expiry
+        self._declined: dict[str, float] = {}  # url -> expiry; guarded-by: _declined_lock
         self._declined_lock = threading.Lock()
 
     @property

@@ -44,7 +44,10 @@ import struct
 import threading
 import time
 
+from ..utils import get_logger
 from .dualstack import bind_dual_stack_udp, display_form
+
+log = get_logger("fetch.utp")
 
 ST_DATA = 0
 ST_FIN = 1
@@ -607,6 +610,7 @@ class UTPSocket:
                         if remain <= 0:
                             raise UTPError("uTP send timed out")
                         wait = min(wait, remain)
+                    # analysis: ignore[no-blocking-under-lock] Condition on self._lock releases it while waiting
                     self._writable.wait(timeout=wait)
                     continue
                 chunk = bytes(view[offset : offset + MSS])
@@ -644,6 +648,7 @@ class UTPSocket:
                     remain = deadline - time.monotonic()
                     if remain <= 0:
                         raise TimeoutError("timed out")
+                # analysis: ignore[no-blocking-under-lock] Condition on self._lock releases it while waiting
                 self._readable.wait(timeout=remain)
             take = bytes(self._stream[:count])
             del self._stream[:count]
@@ -837,7 +842,13 @@ class UTPMultiplexer:
                         return
                     conns = list(self._conns.values())
                 for conn in conns:
-                    conn._on_tick()
+                    try:
+                        conn._on_tick()
+                    except Exception as exc:
+                        # one stream's bug must not kill the pump: this
+                        # thread is the ONLY reader of the shared UDP
+                        # socket, so its death deadlocks every stream
+                        log.warning(f"uTP tick failed: {exc}")
                 continue
             except OSError:
                 return  # closed
@@ -870,23 +881,30 @@ class UTPMultiplexer:
                 except IndexError:
                     continue  # malformed extension chain
             display = self._display_form(addr)
-            if ptype == ST_SYN:
-                self._on_syn(display, addr, conn_id, seq)
-                continue
-            with self._lock:
-                conn = self._conns.get((display, conn_id))
-            if conn is not None:
-                conn._on_packet(
-                    ptype, seq, ack, ts, ts_diff, wnd, payload, sack
-                )
-            elif ptype != ST_RESET:
-                # unknown stream: tell the remote to stop retrying
-                try:
-                    self.sock.sendto(
-                        _pack(ST_RESET, conn_id, 0, 0, 0, seq), addr
+            try:
+                if ptype == ST_SYN:
+                    self._on_syn(display, addr, conn_id, seq)
+                    continue
+                with self._lock:
+                    conn = self._conns.get((display, conn_id))
+                if conn is not None:
+                    conn._on_packet(
+                        ptype, seq, ack, ts, ts_diff, wnd, payload, sack
                     )
-                except OSError:
-                    pass
+                elif ptype != ST_RESET:
+                    # unknown stream: tell the remote to stop retrying
+                    try:
+                        self.sock.sendto(
+                            _pack(ST_RESET, conn_id, 0, 0, 0, seq), addr
+                        )
+                    except OSError:
+                        pass
+            except Exception as exc:
+                # one malformed datagram or one stream's bug must not
+                # kill the pump: this thread is the only reader of the
+                # shared UDP socket, so its death deadlocks every
+                # stream multiplexed on it
+                log.warning(f"uTP packet dispatch failed: {exc}")
 
     def _on_syn(self, display, raw_addr, conn_id: int, seq: int) -> None:
         if self.on_accept is None:

@@ -242,7 +242,12 @@ class _ClientSession:
             try:
                 with self._write_lock:
                     wire.write_frame(self._sock, wire.FRAME_HEARTBEAT, 0, b"")
-            except OSError:
+            except Exception as exc:
+                # broad: ANY escaped exception would end heartbeating
+                # silently, and real RabbitMQ would then drop the
+                # (healthy-looking) session on the client's schedule
+                if not isinstance(exc, OSError):
+                    log.warning(f"heartbeat write failed: {exc}")
                 self.kill()
                 return
 

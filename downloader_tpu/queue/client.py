@@ -112,15 +112,15 @@ class QueueClient:
 
         self._lock = threading.RLock()
         self._prefetch = DEFAULT_PREFETCH
-        self._connection: Connection | None = None
-        self._shards: dict[str, _Shard] = {}  # queue_name -> shard
+        self._connection: Connection | None = None  # guarded-by: _lock
+        self._shards: dict[str, _Shard] = {}  # queue_name -> shard; guarded-by: _lock
         self._publish_buffer: "queue_mod.Queue[_PendingPublish]" = queue_mod.Queue()
-        self._publish_rk: dict[str, int] = {}
-        self._ensured_topics: set[str] = set()  # reset on reconnect
-        self._publisher_alive = False
-        self._publisher_channel: Channel | None = None
-        self._unsettled = 0
-        self._publishes_pending = 0  # buffered but not yet on the broker
+        self._publish_rk: dict[str, int] = {}  # guarded-by: _lock
+        self._ensured_topics: set[str] = set()  # reset on reconnect; guarded-by: _lock
+        self._publisher_alive = False  # guarded-by: _lock
+        self._publisher_channel: Channel | None = None  # guarded-by: _lock
+        self._unsettled = 0  # guarded-by: _lock
+        self._publishes_pending = 0  # not yet on the broker; guarded-by: _lock
         self._reconcile_lock = threading.Lock()
         self._done = threading.Event()
         self.stats = ClientStats()
@@ -138,7 +138,11 @@ class QueueClient:
         while True:
             self._token.raise_if_cancelled()
             try:
-                self._connection = self._connect()
+                connection = self._connect()
+                # publish under the lock: the supervisor thread calls
+                # this while connected() reads from the health thread
+                with self._lock:
+                    self._connection = connection
                 return
             except (BrokerError, OSError) as exc:
                 log.error(f"failed to dial broker: {exc}")
@@ -514,8 +518,17 @@ class QueueClient:
                 log.with_fields(topic=pending.topic, rk=routing_key).debug(
                     "published message"
                 )
-            except BrokerError as exc:
-                # real exponential backoff with jitter — the reference's
+            except Exception as exc:
+                # Broad on purpose (not just BrokerError): an escaped
+                # exception would kill this thread while
+                # ``_publisher_alive`` stays True, so the supervisor
+                # would never recreate the publisher and every later
+                # publish would buffer unsent forever. Either way the
+                # recovery is identical: re-buffer the message, mark the
+                # publisher dead, hand the channel back, let the
+                # supervisor rebuild — at-least-once beats silent loss.
+                #
+                # Real exponential backoff with jitter — the reference's
                 # `backoff ^ 2` XOR bug oscillated 0↔2ms (client.go:226)
                 pending.attempts += 1
                 backoff = min(

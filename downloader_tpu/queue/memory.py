@@ -17,7 +17,10 @@ import threading
 from collections import deque
 from typing import Callable
 
+from ..utils import get_logger
 from .broker import BrokerError, Message
+
+log = get_logger("queue.memory")
 
 
 class MemoryBroker:
@@ -251,10 +254,10 @@ class _Consumer:
     def deliver(self, message: Message) -> None:
         try:
             self.callback(message)
-        except Exception:
+        except Exception as exc:
             # consumer callbacks must not kill the pump; leave unacked so
             # the message redelivers on connection teardown
-            pass
+            log.debug(f"consumer callback raised; left unacked: {exc}")
 
 
 class MemoryChannel:

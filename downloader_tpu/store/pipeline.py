@@ -131,21 +131,21 @@ class _FileStream:
         self.key = key
         self.upload_id = upload_id
         self.plan = PartPlan(total, part_size)
-        self.spans = SpanSet()
-        self.submitted: set[int] = set()
-        self.futures: dict[int, Future] = {}
-        self.etags: dict[int, str] = {}
-        self.failed: str | None = None  # first failure reason
-        self.sealed = False  # no new parts may be submitted
-        self.settled = False  # completed or aborted; terminal
-        self.fetch_done_at: float | None = None
+        self.spans = SpanSet()  # guarded-by: _session._lock
+        self.submitted: set[int] = set()  # guarded-by: _session._lock
+        self.futures: dict[int, Future] = {}  # guarded-by: _session._lock
+        self.etags: dict[int, str] = {}  # guarded-by: _session._lock
+        self.failed: str | None = None  # first failure; guarded-by: _session._lock
+        self.sealed = False  # no new part submissions; guarded-by: _session._lock
+        self.settled = False  # completed/aborted, terminal; guarded-by: _session._lock
+        self.fetch_done_at: float | None = None  # guarded-by: _session._lock
         self.first_part_at: float | None = None
         self.last_part_done_at: float | None = None
-        self.overlapped_bytes = 0
+        self.overlapped_bytes = 0  # guarded-by: _session._lock
 
     # -- coverage → part submission (session lock held) ------------------
 
-    def feed(self, start: int, end: int) -> list[int]:
+    def feed(self, start: int, end: int) -> list[int]:  # holds: _session._lock
         """Merge a completed range; return part numbers that just became
         fully covered and should ship.
 
@@ -246,14 +246,18 @@ class _FileStream:
         re-create an aborted upload's part storage. ``cancel=False``
         (complete): every submitted part must finish."""
         if cancel:
+            # analysis: ignore[guarded-by] sealed was set under the lock before every _drain call, so feed() adds no new futures; the list() snapshot is atomic under the GIL
             for future in list(self.futures.values()):
                 future.cancel()
+        # analysis: ignore[guarded-by] same sealed-before-drain argument as above; waiting on futures under the session lock would deadlock ship()
         for future in list(self.futures.values()):
             if not future.cancelled():
                 try:
                     future.result()
-                except Exception:  # ship() already recorded the failure
-                    pass
+                except Exception as exc:
+                    # ship() already recorded the first failure for the
+                    # fallback decision; later ones only get a breadcrumb
+                    log.debug(f"streamed part settled with error: {exc}")
 
     def complete(self) -> str | None:
         """Fetch succeeded and the scan accepted this file: wait for
@@ -270,10 +274,11 @@ class _FileStream:
                 not self.failed
                 and len(self.etags) == self.plan.num_parts
             )
+            failed = self.failed
+            manifest = sorted(self.etags.items())
         if not complete_ok:
-            self.abort("incomplete stream" if not self.failed else self.failed)
+            self.abort("incomplete stream" if not failed else failed)
             return None
-        manifest = sorted(self.etags.items())
         try:
             self._session._client.complete_multipart(
                 self._session._bucket, self.key, self.upload_id, manifest
@@ -313,6 +318,7 @@ class _FileStream:
     def _observe_completion(self) -> None:
         metrics.GLOBAL.add("pipeline_streamed_files")
         metrics.GLOBAL.add("pipeline_streamed_bytes", self.total)
+        # analysis: ignore[guarded-by] runs only after complete() settled the stream; every part worker has finished, so no writer remains
         ratio = self.overlapped_bytes / self.total if self.total else 0.0
         metrics.GLOBAL.observe(
             "pipeline_overlap_ratio", ratio, buckets=metrics.RATIO_BUCKETS
@@ -350,7 +356,8 @@ class PipelineSession:
         self._media_id = media_id
         self._token = token
         self._lock = threading.Lock()
-        self._files: dict[str, _FileStream | None] = {}  # None = ineligible
+        # a None value marks the path ineligible for streaming
+        self._files: dict[str, _FileStream | None] = {}  # guarded-by: _lock
         self._trace_parent = tracing.current_span()
 
     # -- TransferSink protocol --------------------------------------------
@@ -505,7 +512,7 @@ class StreamingPipeline:
         # job of the process creates the bucket exactly like
         # store-and-forward would)
         self._prepare = prepare or (lambda: None)
-        self._pool: ThreadPoolExecutor | None = None
+        self._pool: ThreadPoolExecutor | None = None  # guarded-by: _pool_lock
         self._pool_lock = threading.Lock()
 
     def session(

@@ -27,11 +27,13 @@ import time
 import urllib.parse
 from typing import BinaryIO, Mapping
 
-from ..utils import tracing, zero_copy_from_env
+from ..utils import get_logger, tracing, zero_copy_from_env
 from ..utils.cancel import CancelToken
 from ..utils.netio import SocketWaiter
 from . import sigv4
 from .credentials import Credentials
+
+log = get_logger("store.s3")
 
 _STREAM_CHUNK = 1024 * 1024
 _SENDFILE_WINDOW = 4 * 1024 * 1024
@@ -587,9 +589,15 @@ class S3Client:
                 offset += length
             self.complete_multipart(bucket, key, upload_id, etags, token=token)
         except BaseException:
-            # best-effort: prompt teardown beats a guaranteed abort
+            # best-effort: prompt teardown beats a guaranteed abort,
+            # but a failed abort leaves orphaned part storage accruing
+            # charges — worth a breadcrumb even while re-raising the
+            # original error
             try:
                 self.abort_multipart(bucket, key, upload_id)
-            except Exception:
-                pass
+            except (S3Error, OSError, http.client.HTTPException) as exc:
+                # HTTPException included: _request re-raises it unwrapped
+                # (e.g. BadStatusLine from a half-closed origin), and it
+                # escaping here would REPLACE the original upload error
+                log.debug(f"abort-multipart for {key} failed: {exc}")
             raise

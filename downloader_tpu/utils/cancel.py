@@ -14,6 +14,10 @@ from __future__ import annotations
 import threading
 from typing import Callable
 
+from .logging import get_logger
+
+log = get_logger("cancel")
+
 
 class Cancelled(Exception):
     """Raised by ``raise_if_cancelled`` once a token is cancelled."""
@@ -42,8 +46,12 @@ class CancelToken:
         for callback in callbacks:
             try:
                 callback()  # type: ignore[operator]
-            except Exception:
-                pass  # cancellation must never fail because a hook did
+            except Exception as exc:
+                # cancellation must never fail because a hook did — but
+                # a hook that cannot run usually means some I/O it was
+                # meant to interrupt will now block to its timeout;
+                # leave a trace for whoever debugs the slow shutdown
+                log.debug(f"cancel hook raised: {exc}")
         for child in children:
             child.cancel()
 

@@ -50,10 +50,10 @@ RATIO_BUCKETS = (0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0)
 class Counters:
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._values: "defaultdict[str, int]" = defaultdict(int)
-        self._gauges: "defaultdict[str, float]" = defaultdict(float)
+        self._values: "defaultdict[str, int]" = defaultdict(int)  # guarded-by: _lock
+        self._gauges: "defaultdict[str, float]" = defaultdict(float)  # guarded-by: _lock
         # name -> (le-bucket bounds, counts parallel to them, sum, count)
-        self._hists: dict[
+        self._hists: dict[  # guarded-by: _lock
             str, tuple[tuple[float, ...], list[int], float, int]
         ] = {}
 

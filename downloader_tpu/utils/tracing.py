@@ -243,8 +243,8 @@ class Trace:
         self.wall_start = time.time()
         self.status = "in-flight"
         self._lock = threading.Lock()
-        self._span_count = 1
-        self.dropped_spans = 0
+        self._span_count = 1  # guarded-by: _lock
+        self.dropped_spans = 0  # guarded-by: _lock
         self.root = Span("job", self)
 
     def add_span(
@@ -295,9 +295,9 @@ class Tracer:
     def __init__(self, capacity: int = DEFAULT_RING, enabled: bool = True):
         self.enabled = enabled
         self._lock = threading.Lock()
-        self._ring: "deque[Trace]" = deque(maxlen=capacity)
-        self._in_flight: dict[int, Trace] = {}
-        self._seq = 0
+        self._ring: "deque[Trace]" = deque(maxlen=capacity)  # guarded-by: _lock
+        self._in_flight: dict[int, Trace] = {}  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
 
     def set_capacity(self, capacity: int) -> None:
         with self._lock:

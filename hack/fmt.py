@@ -26,10 +26,17 @@ def _string_interior_lines(text: str) -> set[int]:
     analogue never rewrites string contents. Code sharing those lines is
     deliberately unchecked; safety beats coverage here."""
     interior: set[int] = set()
+    # FSTRING_MIDDLE only exists on Python >= 3.12 (PEP 701 tokenizer);
+    # on 3.10/3.11 f-strings arrive as single STRING tokens, so the
+    # STRING branch already covers them
+    string_types = (tokenize.STRING,)
+    fstring_middle = getattr(tokenize, "FSTRING_MIDDLE", None)
+    if fstring_middle is not None:
+        string_types = (tokenize.STRING, fstring_middle)
     try:
         tokens = tokenize.generate_tokens(io.StringIO(text).readline)
         for tok in tokens:
-            if tok.type in (tokenize.STRING, tokenize.FSTRING_MIDDLE):
+            if tok.type in string_types:
                 start, end = tok.start[0], tok.end[0]
                 if end > start:
                     interior.update(range(start, end + 1))

@@ -28,3 +28,41 @@ def pytest_configure(config):
         jax.config.update("jax_platforms", "cpu")
     except ImportError:  # pragma: no cover - jax is baked into the image
         pass
+
+
+# Runtime lock-order recording (the dynamic half of the lock-order
+# rule, see downloader_tpu/analysis): the concurrency-heavy suites run
+# with threading.Lock/RLock patched so every observed "held A, took B"
+# pair lands in an acquisition graph keyed by lock creation site. At
+# module teardown the graph must be acyclic — a cycle is a deadlock
+# that merely hasn't interleaved yet. Scoped to the suites that
+# exercise the cross-class lock interactions (pipeline sessions ×
+# part pool, segment workers × journal × connection pool, queue
+# supervisor × publisher × delivery settling) rather than the whole
+# run, keeping the wrapper overhead off unrelated tests.
+_LOCK_ORDER_MODULES = {
+    "test_pipeline",
+    "test_segments",
+    "test_queue",
+}
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _runtime_lock_order_guard(request):
+    module = request.module.__name__
+    if module not in _LOCK_ORDER_MODULES:
+        yield
+        return
+    from downloader_tpu.analysis.runtime import LockOrderRecorder
+
+    recorder = LockOrderRecorder().install()
+    try:
+        yield
+    finally:
+        recorder.uninstall()
+        cycles = recorder.cycles()
+        assert not cycles, (
+            f"lock-order cycles observed at runtime in {module}: {cycles}"
+        )

@@ -1,0 +1,25 @@
+"""Known-bad fixture: silent swallow, unshielded thread, bare except."""
+
+import threading
+
+
+def careless(callback):
+    try:
+        callback()
+    except Exception:
+        pass
+
+
+def helper():
+    raise RuntimeError("boom")
+
+
+def spawn():
+    return threading.Thread(target=helper)
+
+
+def legacy(callback):
+    try:
+        callback()
+    except:
+        return None

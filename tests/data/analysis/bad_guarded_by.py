@@ -1,0 +1,16 @@
+"""Known-bad fixture: guarded attribute touched without its lock."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0  # guarded-by: _lock
+
+    def bump(self):
+        with self._lock:
+            self.value += 1
+
+    def racy_read(self):
+        return self.value

@@ -1,0 +1,19 @@
+"""Known-bad fixture: two locks taken in opposite orders."""
+
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._src_lock = threading.Lock()
+        self._dst_lock = threading.Lock()
+
+    def forward(self):
+        with self._src_lock:
+            with self._dst_lock:
+                pass
+
+    def backward(self):
+        with self._dst_lock:
+            with self._src_lock:
+                pass

@@ -1,0 +1,13 @@
+"""Known-bad fixture: blocking sleep while holding a lock."""
+
+import threading
+import time
+
+
+class Pacer:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def nap(self):
+        with self._lock:
+            time.sleep(1.0)

@@ -1,0 +1,8 @@
+"""Known-bad fixture: file handle leaks when read() raises."""
+
+
+def read_header(path):
+    handle = open(path, "rb")
+    data = handle.read(16)
+    handle.close()
+    return data

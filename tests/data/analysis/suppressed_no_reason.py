@@ -1,0 +1,13 @@
+"""Round-trip fixture: a suppression missing its reason is reported."""
+
+import threading
+import time
+
+
+class Napper:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def nap(self):
+        with self._lock:
+            time.sleep(0.01)  # analysis: ignore[no-blocking-under-lock]

@@ -1,0 +1,18 @@
+"""Round-trip fixture: every violation suppressed, with reasons."""
+
+import threading
+import time
+
+
+class Pacer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0  # guarded-by: _lock
+
+    def nap(self):
+        with self._lock:
+            time.sleep(0.01)  # analysis: ignore[no-blocking-under-lock] fixture: demonstrates the inline suppression style
+
+    def racy_read(self):
+        # analysis: ignore[guarded-by] fixture: demonstrates the standalone-line suppression style
+        return self.value
