@@ -35,7 +35,8 @@ pair, default 4), BENCH_REPEATS (pairs, default 5), BENCH_DIR (default
 /dev/shm if present), BENCH_ABLATION=0 to skip the sub-ratio ablation,
 BENCH_ABLATION_REPEATS (interleaved triples, default 3), BENCH_PIPELINE=0
 to skip the streaming-pipeline ablation, BENCH_PIPELINE_REPEATS
-(interleaved pipelined/store-and-forward pairs, default 3).
+(interleaved pipelined/store-and-forward pairs, default 3),
+BENCH_WATCHDOG=0 to skip the stall-watchdog heartbeat ablation.
 
 On the measurement noise: this box's absolute throughput swings ~3x on
 multi-second timescales (the same configuration has measured 85 and 580
@@ -722,6 +723,60 @@ def run_latency(
         pipeline.close()
 
 
+def run_watchdog_ablation(
+    site: str, samples: int, concurrency: int, repeats: int = 3
+) -> dict:
+    """The stall-watchdog ablation: per-job latency with progress
+    heartbeats + the scanning thread live (production default) vs the
+    watchdog disabled (WATCHDOG_STALL_S=0 semantics: no-op watches on
+    the streaming path). Interleaved off/on pairs, median of per-pair
+    deltas — the heartbeat contract is 'a counter bump, nothing more',
+    so the delta should be statistically indistinguishable from zero;
+    tests/test_watchdog.py separately guards the isolated per-job cost
+    at <= 0.5 ms."""
+    from downloader_tpu.utils import watchdog as watchdog_mod
+
+    monitor = watchdog_mod.MONITOR
+
+    def run_arm(enabled: bool) -> float:
+        monitor.reset()
+        if enabled:
+            monitor.configure(stall_s=60.0, action="log")
+            monitor.start()
+        else:
+            monitor.stall_s = 0.0  # job()/loop() hand out no-op watches
+        pipeline = _Pipeline(
+            concurrency, concurrency, site, payload="tiny.bin"
+        )
+        try:
+            laps: list[float] = []
+            for i in range(samples):
+                start = time.monotonic()
+                pipeline.publish_job(i)
+                pipeline.wait_converts(i + 1, timeout=60.0)
+                laps.append((time.monotonic() - start) * 1000.0)
+        finally:
+            pipeline.close()
+            monitor.reset()
+            monitor.stall_s = watchdog_mod.DEFAULT_STALL_S
+        laps.sort()
+        return laps[len(laps) // 2]
+
+    pairs = []
+    for _ in range(repeats):
+        off_ms = run_arm(False)
+        on_ms = run_arm(True)
+        pairs.append({"off_ms": round(off_ms, 2), "on_ms": round(on_ms, 2),
+                      "delta_ms": round(on_ms - off_ms, 3)})
+    deltas = sorted(p["delta_ms"] for p in pairs)
+    return {
+        "metric": "watchdog_overhead",
+        "unit": "ms",
+        "delta_ms": deltas[len(deltas) // 2],
+        "pairs": pairs,
+    }
+
+
 def main() -> None:
     jobs = int(os.environ.get("BENCH_JOBS", 24))
     mb_per_job = int(os.environ.get("BENCH_MB", 48))
@@ -898,6 +953,20 @@ def main() -> None:
             f"stage medians {json.dumps(stage_attribution)}"
         )
 
+        watchdog_ablation = None
+        if os.environ.get("BENCH_WATCHDOG", "1") != "0":
+            _log(
+                f"bench: watchdog ablation, interleaved off/on pairs of "
+                f"{latency_samples} tiny jobs"
+            )
+            watchdog_ablation = run_watchdog_ablation(
+                site, latency_samples, concurrency
+            )
+            _log(
+                "bench: watchdog ablation median delta "
+                f"{watchdog_ablation['delta_ms']:+.3f} ms/job"
+            )
+
         extra_metrics = [
             {
                 "metric": "job_overhead_latency_ms",
@@ -929,6 +998,8 @@ def main() -> None:
             extra_metrics.append(pipeline_ablation)
         if segmented_ablation is not None:
             extra_metrics.append(segmented_ablation)
+        if watchdog_ablation is not None:
+            extra_metrics.append(watchdog_ablation)
         if os.environ.get("BENCH_DIGEST", "1") != "0":
             _log("bench: digest kernel micro-benchmark (pallas vs hashlib)")
             try:

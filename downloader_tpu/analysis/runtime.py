@@ -130,6 +130,12 @@ class LockOrderRecorder:
         self._edges: dict[tuple[str, str], int] = defaultdict(int)
         self._edges_lock = _REAL_LOCK()
         self._tls = threading.local()
+        # thread ident -> that thread's live held-stack list (the same
+        # object _tls holds), so an incident capture (utils/incident.py)
+        # can dump WHO holds WHAT from outside the owning threads. The
+        # lists mutate GIL-atomically (append/del); a snapshot copy may
+        # be momentarily torn, which is fine for diagnostics.
+        self._held_by_thread: dict[int, list[str]] = {}  # guarded-by: _edges_lock
         self._installed = False
 
     # -- wrapper bookkeeping ----------------------------------------------
@@ -138,7 +144,21 @@ class LockOrderRecorder:
         held = getattr(self._tls, "held", None)
         if held is None:
             held = self._tls.held = []
+            with self._edges_lock:
+                self._held_by_thread[threading.get_ident()] = held
         return held
+
+    def held_snapshot(self) -> dict[str, list[str]]:
+        """Lock creation sites currently held, per live thread — the
+        incident bundle's 'who is holding what' view."""
+        names = {t.ident: t.name for t in threading.enumerate()}
+        with self._edges_lock:
+            items = list(self._held_by_thread.items())
+        return {
+            names.get(ident, f"thread-{ident}"): list(held)
+            for ident, held in items
+            if held and ident in names
+        }
 
     def _note_acquire(self, site: str) -> None:
         held = self._held()
@@ -174,6 +194,8 @@ class LockOrderRecorder:
         threading.Lock = make_lock  # type: ignore[assignment]
         threading.RLock = make_rlock  # type: ignore[assignment]
         self._installed = True
+        global _CURRENT
+        _CURRENT = self
         return self
 
     def uninstall(self) -> None:
@@ -182,6 +204,9 @@ class LockOrderRecorder:
         threading.Lock = _REAL_LOCK  # type: ignore[assignment]
         threading.RLock = _REAL_RLOCK  # type: ignore[assignment]
         self._installed = False
+        global _CURRENT
+        if _CURRENT is self:
+            _CURRENT = None
 
     def __enter__(self) -> "LockOrderRecorder":
         return self.install()
@@ -204,3 +229,14 @@ class LockOrderRecorder:
         for held, acquired in self.edges():
             graph[held].append(acquired)
         return [cycle for _, _, cycle in find_cycles(graph)]
+
+
+# the recorder currently patched into threading (install()/uninstall()
+# maintain it), or None. The incident flight recorder reads this to
+# fold live lock-acquisition state into bundles when a diagnostic
+# session has one installed.
+_CURRENT: "LockOrderRecorder | None" = None
+
+
+def current() -> "LockOrderRecorder | None":
+    return _CURRENT

@@ -37,6 +37,7 @@ from ..queue.delivery import Delivery
 from ..scan import scan_dir
 from ..store import Uploader, UploadError
 from ..utils import metrics, configure_from_env, get_logger, tracing
+from ..utils import incident, watchdog
 from ..utils.cancel import Cancelled, CancelToken
 from ..wire import Convert, Download, WireError
 from .config import Config
@@ -135,6 +136,28 @@ class Daemon:
                 trace.set_status("requeued")
                 return
 
+        # per-job cancellation: a child token so the stall watchdog can
+        # release ONE wedged job (WATCHDOG_ACTION=cancel) without
+        # touching its siblings; shutdown still cancels everything
+        # through the parent. The job watch travels thread-locally like
+        # the trace and the transfer sink — backends beat its stage
+        # heartbeats as bytes actually flush.
+        job_token = self._token.child()
+        watch = watchdog.MONITOR.job(media.id, cancel=job_token.cancel)
+        try:
+            with watchdog.install(watch):
+                self._process_watched(
+                    delivery, trace, media, job_log, job_token, watch, started
+                )
+        finally:
+            watchdog.MONITOR.unregister(watch)
+            # drop the job token from the daemon token's fan-out list,
+            # or the parent accumulates one dead child per job forever
+            job_token.detach()
+
+    def _process_watched(
+        self, delivery, trace, media, job_log, job_token, watch, started
+    ) -> None:
         # streaming fetch→upload pipeline: the session consumes the
         # fetch backends' progress reports (write offsets, verified
         # piece spans) and ships S3 multipart parts while the fetch is
@@ -142,21 +165,26 @@ class Daemon:
         # instead of fetch + upload. None when PIPELINE=off; every
         # failure path converges on session.close(), which aborts any
         # speculative multipart upload not explicitly completed.
-        session = self._uploader.streaming_session(media.id, self._token)
+        session = self._uploader.streaming_session(media.id, job_token)
         try:
+            watch.stage("fetch")
             with tracing.span(
                 "fetch", url=tracing.redact_url(media.source_uri)
             ), transfer_progress.install(session):
-                job_dir = self._dispatcher.download(media.id, media.source_uri)
+                job_dir = self._dispatcher.download(
+                    media.id, media.source_uri, token=job_token
+                )
+            watch.stage("scan")
             with tracing.span("scan"):
                 files = scan_dir(job_dir)
             job_log.with_field("count", len(files)).info("found media files")
+            watch.stage("upload")
             with tracing.span("upload", files=len(files)):
                 # completes streams the scan accepted, aborts streams
                 # it rejected; completed files skip store-and-forward
                 streamed = session.finalize(files) if session else {}
                 self._uploader.upload_files(
-                    self._token, media.id, files, streamed=streamed
+                    job_token, media.id, files, streamed=streamed
                 )
         except UnsupportedJobError as exc:
             job_log.error("unsupported job; dropping", exc=exc)
@@ -165,24 +193,19 @@ class Daemon:
             trace.set_status("dropped")
             return
         except (TransferError, UploadError, OSError) as exc:
-            if delivery.retries < self._config.max_job_retries:
-                job_log.with_field("retries", delivery.retries).error(
-                    "job failed; scheduling retry", exc=exc
-                )
-                with tracing.span("retry-republish"):
-                    delivery.error()
-                self.stats.bump(retried=1)
-                trace.set_status("retried")
-            else:
-                job_log.error(
-                    f"job failed after {delivery.retries} retries; dropping",
-                    exc=exc,
-                )
-                delivery.nack()
-                self.stats.bump(failed=1)
-                trace.set_status("failed")
+            self._settle_transient(delivery, job_log, trace, exc)
             return
         except Cancelled:
+            if not self._token.cancelled():
+                # job-level cancel with the daemon still running: the
+                # watchdog released a stalled job. Retry it like any
+                # transient failure (capped), not like a shutdown — the
+                # broker pacing gives the stall cause time to clear.
+                self._settle_transient(
+                    delivery, job_log, trace,
+                    Cancelled("watchdog cancelled stalled job"),
+                )
+                return
             # shutdown mid-job: requeue so another instance picks it up
             delivery.nack(requeue=True)
             trace.set_status("requeued")
@@ -195,11 +218,20 @@ class Daemon:
         convert = Convert(
             created_at=time.strftime("%Y-%m-%d %H:%M:%S %z"), media=media
         )
+        # the confirm wait is where a wedged publisher thread surfaces:
+        # no publish progress inside the deadline flags THIS job's
+        # publish stage (the publisher loop has its own watch too).
+        # The job token rides along so WATCHDOG_ACTION=cancel releases
+        # a job wedged HERE too — the wait returns unconfirmed and the
+        # job requeues, instead of the cancel being logged but the
+        # worker staying blocked to the full confirm timeout
+        watch.stage("publish")
         with tracing.span("publish"):
             confirmed = self._client.publish(
                 self._config.publish_topic,
                 convert.marshal(),
                 wait=self._config.publish_confirm_timeout,
+                cancel=job_token,
             )
         if not confirmed:
             # the Convert hand-off is the job's whole point: never ack a
@@ -212,6 +244,7 @@ class Daemon:
             trace.set_status("requeued")
             return
         job_log.info("finished processing")
+        watch.stage("ack")
         with tracing.span("ack"):
             delivery.ack()
         self.stats.bump(processed=1)
@@ -224,28 +257,59 @@ class Daemon:
             "job_duration_seconds", time.monotonic() - started
         )
 
+    def _settle_transient(self, delivery, job_log, trace, exc) -> None:
+        """One retry-or-drop policy for every transient job failure —
+        transfer/upload errors and watchdog-cancelled stalls alike."""
+        if delivery.retries < self._config.max_job_retries:
+            job_log.with_field("retries", delivery.retries).error(
+                "job failed; scheduling retry", exc=exc
+            )
+            with tracing.span("retry-republish"):
+                delivery.error()
+            self.stats.bump(retried=1)
+            trace.set_status("retried")
+        else:
+            job_log.error(
+                f"job failed after {delivery.retries} retries; dropping",
+                exc=exc,
+            )
+            delivery.nack()
+            self.stats.bump(failed=1)
+            trace.set_status("failed")
+
     # -- worker loop -----------------------------------------------------
 
     def _worker(self, deliveries: "queue_mod.Queue[Delivery]") -> None:
-        while not self._token.cancelled():
-            try:
-                delivery = deliveries.get(timeout=0.2)
-            except queue_mod.Empty:
-                continue
-            try:
-                self.process_delivery(delivery)
-            except Exception as exc:  # never kill the worker thread
-                log.error("unexpected error processing job", exc=exc)
-                if not delivery.settled:
-                    # cap like the normal failure path, or a poison message
-                    # that crashes outside the caught exceptions would
-                    # retry forever
-                    if delivery.retries < self._config.max_job_retries:
-                        delivery.error()
-                        self.stats.bump(retried=1)
-                    else:
-                        delivery.nack()
-                        self.stats.bump(failed=1)
+        # dequeue-liveness watch: this loop ticks at >= 5 Hz when idle,
+        # so a worker thread that stops iterating OUTSIDE a job (the
+        # job watch owns in-job time) reads as wedged
+        watch = watchdog.MONITOR.loop(
+            f"{threading.current_thread().name}-dequeue"
+        )
+        try:
+            while not self._token.cancelled():
+                watch.beat()
+                try:
+                    delivery = deliveries.get(timeout=0.2)
+                except queue_mod.Empty:
+                    continue
+                with watch.suspend():
+                    try:
+                        self.process_delivery(delivery)
+                    except Exception as exc:  # never kill the worker thread
+                        log.error("unexpected error processing job", exc=exc)
+                        if not delivery.settled:
+                            # cap like the normal failure path, or a poison
+                            # message that crashes outside the caught
+                            # exceptions would retry forever
+                            if delivery.retries < self._config.max_job_retries:
+                                delivery.error()
+                                self.stats.bump(retried=1)
+                            else:
+                                delivery.nack()
+                                self.stats.bump(failed=1)
+        finally:
+            watchdog.MONITOR.unregister(watch)
 
     def run(self) -> None:
         """Start consuming; returns once cancellation completes drain."""
@@ -282,6 +346,22 @@ class Daemon:
 
 # ---------------------------------------------------------------------------
 # wiring
+
+
+def capture_stall_incident(watch, stage: str, idle: float) -> None:
+    """The watchdog→flight-recorder hand-off: a stall episode captures
+    one bounded incident bundle (utils/incident.py rate-limits mass
+    stalls) carrying the job's trace, thread stacks, and subsystem
+    internals."""
+    incident.RECORDER.capture(
+        reason=(
+            f"watchdog: no forward progress in stage '{stage}' "
+            f"for {idle:.1f}s"
+        ),
+        job_id=watch.name if watch.kind == "job" else None,
+        trigger="watchdog",
+        extra={"watch": watch.name, "kind": watch.kind, "stage": stage},
+    )
 
 
 def build_connection_factory(config: Config):
@@ -325,6 +405,21 @@ def serve(
 
     tracing.TRACER.enabled = config.trace
     tracing.TRACER.set_capacity(config.trace_ring)
+
+    # stall watchdog + incident flight recorder: stages report progress
+    # heartbeats; a job whose active stage stops advancing for
+    # WATCHDOG_STALL_S is flagged (and under WATCHDOG_ACTION=cancel,
+    # released through its per-job token), capturing an incident bundle
+    incident.RECORDER.configure(
+        directory=config.incident_dir, keep=config.incident_keep
+    )
+    watchdog.MONITOR.configure(
+        stall_s=config.watchdog_stall_s,
+        action=config.watchdog_action,
+        stage_overrides=config.watchdog_stages,
+        on_stall=capture_stall_incident,
+    )
+    watchdog.MONITOR.start()
 
     token = token or CancelToken()
     if install_signal_handlers:
@@ -376,6 +471,7 @@ def serve(
     try:
         daemon.run()
     finally:
+        watchdog.MONITOR.stop()
         if health is not None:
             health.stop()
         uploader.close()  # drains the streaming pipeline's part pool

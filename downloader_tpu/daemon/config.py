@@ -55,6 +55,15 @@ class Config:
     http_segments: int = 8
     http_pool_per_host: int = 6
     http_pool_idle: float = 30.0
+    # stall watchdog + incident flight recorder (utils/watchdog.py,
+    # utils/incident.py): no-forward-progress deadline (0 disables),
+    # per-stage overrides, what to do about a stall, and where bundles
+    # persist / how many are retained
+    watchdog_stall_s: float = 120.0
+    watchdog_action: str = "log"
+    watchdog_stages: "dict[str, float]" = field(default_factory=dict)
+    incident_dir: str = ""
+    incident_keep: int = 16
 
     @classmethod
     def from_env(cls, environ: Mapping[str, str] | None = None) -> "Config":
@@ -102,4 +111,11 @@ class Config:
         config.http_segments = segments_from_env(env)
         config.http_pool_per_host = pool_per_host_from_env(env)
         config.http_pool_idle = pool_idle_from_env(env)
+        from ..utils import incident, watchdog
+
+        config.watchdog_stall_s = watchdog.stall_from_env(env)
+        config.watchdog_action = watchdog.action_from_env(env)
+        config.watchdog_stages = watchdog.stage_overrides_from_env(env)
+        config.incident_dir = incident.dir_from_env(env)
+        config.incident_keep = incident.keep_from_env(env)
         return config

@@ -16,6 +16,20 @@ server exposes:
   this job's time go" is answerable from a running daemon without a
   profiler. ``GET /debug/trace`` serves the same data as Chrome
   trace-event JSON (load in chrome://tracing or Perfetto).
+- ``GET /debug/watchdog`` — the stall watchdog's live registry
+  (utils/watchdog.py): per watched job/loop, the active stage, its
+  idle seconds against the deadline, and progress counters.
+- ``GET /debug/logs`` — the in-memory structured-log ring
+  (utils/logging.py) with job/trace correlation fields.
+- ``GET /debug/incidents`` — captured incident bundles
+  (utils/incident.py); ``/debug/incidents/<id>`` serves one bundle.
+  ``POST /debug/incident`` captures a bundle on demand.
+
+The server is a ``ThreadingHTTPServer`` (daemon threads) on purpose: a
+slow ``/debug/trace`` serialization or a fat incident bundle must
+never block the ``/healthz`` liveness probe an orchestrator restarts
+on — tests pin this by answering /healthz while another handler is
+deliberately wedged.
 
 Enabled by ``HEALTH_PORT`` (0 = disabled, the default); binds loopback
 unless ``HEALTH_HOST`` says otherwise.
@@ -27,7 +41,8 @@ import http.server
 import json
 import threading
 
-from ..utils import get_logger, metrics, tracing
+from ..utils import get_logger, incident, metrics, tracing, watchdog
+from ..utils.logging import ring_tail
 
 log = get_logger("daemon.health")
 
@@ -52,6 +67,16 @@ class HealthServer:
                         code, body, ctype = health._debug_jobs()
                     elif self.path == "/debug/trace":
                         code, body, ctype = health._debug_trace()
+                    elif self.path == "/debug/watchdog":
+                        code, body, ctype = health._debug_watchdog()
+                    elif self.path == "/debug/logs":
+                        code, body, ctype = health._debug_logs()
+                    elif self.path == "/debug/incidents":
+                        code, body, ctype = health._debug_incidents()
+                    elif self.path.startswith("/debug/incidents/"):
+                        code, body, ctype = health._debug_incident(
+                            self.path[len("/debug/incidents/"):]
+                        )
                     else:
                         code, body, ctype = 404, b"not found\n", "text/plain"
                 except Exception as exc:  # a view bug must answer, not abort
@@ -59,6 +84,22 @@ class HealthServer:
                     code, body, ctype = (
                         500, b"internal error\n", "text/plain"
                     )
+                self._reply(code, body, ctype)
+
+            def do_POST(self):
+                try:
+                    if self.path == "/debug/incident":
+                        code, body, ctype = health._capture_incident()
+                    else:
+                        code, body, ctype = 404, b"not found\n", "text/plain"
+                except Exception as exc:
+                    log.error("health view failed", exc=exc)
+                    code, body, ctype = (
+                        500, b"internal error\n", "text/plain"
+                    )
+                self._reply(code, body, ctype)
+
+            def _reply(self, code, body, ctype):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
@@ -136,13 +177,67 @@ class HealthServer:
             "application/json",
         )
 
+    def _debug_watchdog(self) -> tuple[int, bytes, str]:
+        payload = watchdog.MONITOR.snapshot()
+        return (
+            200,
+            (json.dumps(payload, indent=1) + "\n").encode(),
+            "application/json",
+        )
+
+    def _debug_logs(self) -> tuple[int, bytes, str]:
+        payload = {"records": ring_tail()}
+        return (
+            200,
+            (json.dumps(payload, indent=1, default=str) + "\n").encode(),
+            "application/json",
+        )
+
+    def _debug_incidents(self) -> tuple[int, bytes, str]:
+        payload = {"incidents": incident.RECORDER.list_incidents()}
+        return (
+            200,
+            (json.dumps(payload, indent=1) + "\n").encode(),
+            "application/json",
+        )
+
+    def _debug_incident(self, bundle_id: str) -> tuple[int, bytes, str]:
+        bundle = incident.RECORDER.get(bundle_id)
+        if bundle is None:
+            return 404, b"no such incident\n", "text/plain"
+        return (
+            200,
+            (json.dumps(bundle, indent=1, default=str) + "\n").encode(),
+            "application/json",
+        )
+
+    def _capture_incident(self) -> tuple[int, bytes, str]:
+        bundle = incident.RECORDER.capture(
+            "operator-requested capture (POST /debug/incident)",
+            trigger="manual",
+        )
+        payload = {"id": bundle["id"], "persisted": bundle.get("persisted")}
+        return (
+            200,
+            (json.dumps(payload) + "\n").encode(),
+            "application/json",
+        )
+
     def _metrics(self) -> tuple[int, bytes, str]:
+        # Prometheus exposition: every family gets one well-formed
+        # `# HELP` + `# TYPE` pair before its samples (metrics.py keeps
+        # the help catalog) — tests/test_metrics_lint.py gates the
+        # format, histogram triples, and family uniqueness
         lines = []
         for name, value in self._counters().items():
             metric = f"downloader_{name}"
+            lines.append(f"# HELP {metric} {metrics.help_text(name)}")
             lines.append(f"# TYPE {metric} counter")
             lines.append(f"{metric} {value}")
         metric = "downloader_broker_connected"
+        lines.append(
+            f"# HELP {metric} {metrics.help_text('broker_connected')}"
+        )
         lines.append(f"# TYPE {metric} gauge")
         lines.append(f"{metric} {1 if self._connected() else 0}")
         # live levels (active swarms / peer connections) — the level
@@ -156,6 +251,7 @@ class HealthServer:
         }
         for name, value in sorted(gauges.items()):
             metric = f"downloader_{name}"
+            lines.append(f"# HELP {metric} {metrics.help_text(name)}")
             lines.append(f"# TYPE {metric} gauge")
             lines.append(f"{metric} {value:g}")
         # fixed-bucket histograms, Prometheus exposition: cumulative
@@ -186,6 +282,7 @@ class HealthServer:
             histograms.items()
         ):
             metric = f"downloader_{name}"
+            lines.append(f"# HELP {metric} {metrics.help_text(name)}")
             lines.append(f"# TYPE {metric} histogram")
             for le, bucket_count in zip(bounds, counts):
                 lines.append(

@@ -32,7 +32,7 @@ import threading
 import time
 from collections import deque
 
-from ..utils import get_logger, metrics
+from ..utils import get_logger, incident, metrics
 from ..utils.netio import create_connection
 
 log = get_logger("fetch.connpool")
@@ -111,6 +111,27 @@ class ConnectionPool:
         self._lock = threading.Lock()
         self._idle: dict[tuple, deque[PooledConnection]] = {}  # guarded-by: _lock
         self._closed = False  # guarded-by: _lock
+        # incident-bundle introspection: which hosts hold how many
+        # parked connections. WeakMethod-held, expires with the pool;
+        # close() unregisters eagerly for determinism.
+        self._probe_name = incident.RECORDER.register_probe(
+            "http-connpool", self._incident_probe
+        )
+
+    def _incident_probe(self) -> dict:
+        with self._lock:
+            shelves = {
+                f"{key[0]}://{key[1]}:{key[2]}": len(shelf)
+                for key, shelf in self._idle.items()
+            }
+            closed = self._closed
+        return {
+            "closed": closed,
+            "per_host_cap": self._per_host,
+            "idle_ttl_s": self._idle_ttl,
+            "idle_by_host": shelves,
+            "idle_total": sum(shelves.values()),
+        }
 
     # -- lifecycle --------------------------------------------------------
 
@@ -182,6 +203,7 @@ class ConnectionPool:
             return sum(len(shelf) for shelf in self._idle.values())
 
     def close(self) -> None:
+        incident.RECORDER.unregister_probe(self._probe_name)
         with self._lock:
             self._closed = True
             shelves = list(self._idle.values())

@@ -160,8 +160,14 @@ class DispatchClient:
             f"unsupported fileext '{ext}' or protocol '{parsed.scheme}'"
         )
 
-    def download(self, media_id: str, url: str) -> str:
+    def download(
+        self, media_id: str, url: str, token: CancelToken | None = None
+    ) -> str:
         """Download a job into ``base_dir/<media_id>/`` and return that dir.
+
+        ``token`` scopes cancellation to this job (the daemon passes a
+        per-job child so the stall watchdog can release one wedged
+        download); None falls back to the client-wide token.
 
         Raises UnsupportedJobError for unroutable URLs and propagates
         backend errors (unlike the reference's HTTP backend, which
@@ -177,7 +183,7 @@ class DispatchClient:
                 "backend", backend=backend.register().name
             ):
                 backend.download(
-                    self._token, job_dir, self._progress.update, url
+                    token or self._token, job_dir, self._progress.update, url
                 )
         finally:
             # whatever happened, stop displaying this URL

@@ -32,7 +32,7 @@ try:
 except ImportError:  # non-Unix: the splice path is gated off with it
     fcntl = None  # type: ignore[assignment]
 
-from ..utils import get_logger, metrics, tracing
+from ..utils import get_logger, metrics, tracing, watchdog
 from ..utils.netio import SocketWaiter
 from ..utils.cancel import Cancelled, CancelToken
 from . import progress as transfer_progress
@@ -309,6 +309,10 @@ class HTTPBackend:
         # while this transfer is still running. No-op outside a job
         # with an installed sink.
         stream_sink = transfer_progress.current()
+        # stall-watchdog heartbeat (utils/watchdog.py): one counter
+        # bump per flushed chunk, captured once so the hot loop never
+        # touches thread-local state
+        fetch_hb = watchdog.current().heartbeat("fetch")
         announced = False
         reported_high = 0
         sink_file: list = [None]  # the open part file, for flush-before-report
@@ -405,6 +409,7 @@ class HTTPBackend:
                         nonlocal offset, last_tick, reported_high
                         if token.cancelled():
                             raise Cancelled()
+                        fetch_hb.beat(got)
                         offset += got
                         if announced and offset > reported_high:
                             # only fd-flushed bytes may be advertised: a

@@ -14,7 +14,7 @@ import os
 import threading
 
 from ..parallel import DigestEngine, default_engine
-from ..utils import get_logger, metrics
+from ..utils import get_logger, metrics, watchdog
 from . import progress as transfer_progress
 from .http import TransferError
 from .peerwire import PeerProtocolError
@@ -103,6 +103,10 @@ class PieceStore:
         # Pieces are SHA-1 verified before write, so unlike the HTTP
         # write offset these spans can ship out of order safely.
         self._transfer_sink = transfer_progress.current()
+        # stall-watchdog heartbeat, captured on the job thread like the
+        # sink; beaten per SHA-1-verified piece from whichever worker
+        # thread won it (a counter bump — no lock, no clock)
+        self._fetch_hb = watchdog.current().heartbeat("fetch")
         for (path, length), is_pad in zip(self.files, self.pad_file):
             if not is_pad and length > 0:
                 self._transfer_sink.begin_file(path, length)
@@ -155,6 +159,9 @@ class PieceStore:
         job's transfer sink (streaming upload): per overlapped file,
         the file-relative span the piece covers. Pad ranges are never
         on disk and never advertised."""
+        # forward progress for the stall watchdog: a verified piece is
+        # the torrent backend's unit of durable progress
+        self._fetch_hb.beat(self.piece_size(index))
         if self._transfer_sink is transfer_progress.NOOP:
             return  # keep the per-piece hot path free of the file walk
         offset = index * self.piece_length

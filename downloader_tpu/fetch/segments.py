@@ -48,7 +48,7 @@ import time
 import urllib.parse
 import urllib.request
 
-from ..utils import get_logger, metrics, tracing
+from ..utils import get_logger, incident, metrics, tracing, watchdog
 from ..utils.cancel import Cancelled, CancelToken
 from . import progress as transfer_progress
 from .connpool import ConnectionPool
@@ -337,6 +337,10 @@ class _FetchState:
         self.progress = progress
         self.trace_parent = trace_parent
         self._progress_interval = progress_interval
+        # stall-watchdog heartbeat, captured on the job thread (like
+        # trace_parent); segment workers bump it per received chunk —
+        # a plain counter add, safe from any thread
+        self.fetch_hb = watchdog.current().heartbeat("fetch")
         self._lock = threading.Lock()
         self._queue: list[_Segment] = [  # guarded-by: _lock
             _Segment(lo, hi) for lo, hi in ranges
@@ -354,6 +358,36 @@ class _FetchState:
         self._rescue_budget = 1  # guarded-by: _lock
         self._bytes_done = 0  # guarded-by: _lock
         self._last_tick = time.monotonic()  # guarded-by: _lock
+        # incident-bundle introspection: this transfer's live internals
+        # (active segment positions, queue depth, coverage). Held via
+        # WeakMethod, so the probe expires with the state — no
+        # unregister needed on the many exit paths of fetch()
+        incident.RECORDER.register_probe(
+            "http-segment-fetch", self.probe_state
+        )
+
+    def probe_state(self) -> dict:
+        with self._lock:
+            active = [
+                {"start": seg.start, "end": seg.end, "pos": seg.pos,
+                 "done": seg.done}
+                for seg in self._active
+            ]
+            queued = len(self._queue)
+            failure = str(self.failure) if self.failure else None
+            redispatches = self.redispatches
+        return {
+            "url": tracing.redact_url(self.url),
+            "total": self.probe.total,
+            "covered_bytes": sum(
+                hi - lo for lo, hi in self.journal.covered_spans()
+            ),
+            "queued_segments": queued,
+            "active_segments": active,
+            "redispatches": redispatches,
+            "failure": failure,
+            "heartbeat": self.fetch_hb.count,
+        }
 
     # -- work distribution ------------------------------------------------
 
@@ -438,6 +472,7 @@ class _FetchState:
         self.sink.add_span(self.final_path, lo, hi)
 
     def note_bytes(self, got: int) -> None:
+        self.fetch_hb.beat(got)
         with self._lock:
             self._bytes_done += got
             now = time.monotonic()

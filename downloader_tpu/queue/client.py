@@ -38,6 +38,7 @@ import time
 from dataclasses import dataclass, field
 
 from ..utils import get_logger
+from ..utils import incident, watchdog
 from ..utils.cancel import CancelToken
 from .broker import BrokerError, Channel, Connection, ConnectionFactory, Message
 from .delivery import Delivery
@@ -124,6 +125,12 @@ class QueueClient:
         self._reconcile_lock = threading.Lock()
         self._done = threading.Event()
         self.stats = ClientStats()
+        # incident-bundle introspection (utils/incident.py): buffer
+        # depth + settlement state is exactly what a wedged-publisher
+        # post-mortem needs. WeakMethod-held; expires with the client.
+        incident.RECORDER.register_probe(
+            "queue-client", self._incident_probe
+        )
 
         self._create_connection()  # blocks with backoff, like NewClient
         self._supervisor = threading.Thread(
@@ -172,6 +179,30 @@ class QueueClient:
         except BrokerError:
             return False
 
+    def _incident_probe(self) -> dict:
+        with self._lock:
+            unsettled = self._unsettled
+            publishes_pending = self._publishes_pending
+            publisher_alive = self._publisher_alive
+            shards = {
+                name: shard.alive() for name, shard in self._shards.items()
+            }
+        return {
+            "connected": self.connected(),
+            "unsettled_deliveries": unsettled,
+            "publishes_pending": publishes_pending,
+            "publish_buffer_depth": self._publish_buffer.qsize(),
+            "publisher_alive": publisher_alive,
+            "shards_alive": shards,
+            "stats": {
+                "published": self.stats.published,
+                "delivered": self.stats.delivered,
+                "publish_retries": self.stats.publish_retries,
+                "reconnects": self.stats.reconnects,
+                "consumer_errors": self.stats.consumer_errors,
+            },
+        }
+
     @staticmethod
     def shard_name(topic: str, index: int) -> str:
         return f"{topic}-{index}"  # reference getRk, client.go:376-378
@@ -205,6 +236,7 @@ class QueueClient:
         headers: dict | None = None,
         wait: float | None = None,
         routing_key: str | None = None,
+        cancel: CancelToken | None = None,
     ) -> bool:
         """Enqueue for the publisher thread; survives broker outages by
         retrying with exponential backoff, and is drained (not dropped) at
@@ -216,6 +248,18 @@ class QueueClient:
         hand-off, Delivery.error retries) pass a timeout and only ack
         their upstream delivery on True. Fire-and-forget (`wait=None`)
         returns True immediately.
+
+        ``cancel`` lets a watched caller stop WAITING early (the stall
+        watchdog releasing a job wedged at its publish stage): the wait
+        returns the current confirm state as soon as the token reads
+        cancelled — but ONLY for a job-level cancel. When the
+        client-wide token is also cancelled (graceful shutdown cancels
+        every job's child token), the wait runs to the full timeout as
+        before: the publisher keeps draining through shutdown, so the
+        confirm usually still arrives and the job acks instead of
+        requeueing a Convert that was published anyway (a duplicate
+        downstream). The message itself stays buffered either way —
+        only the caller's block is interruptible.
 
         ``routing_key`` publishes to exchange ``topic`` with that exact
         key instead of the shard round-robin — required for the default
@@ -233,7 +277,17 @@ class QueueClient:
         self._publish_buffer.put(pending)
         if wait is None:
             return True
-        return pending.flushed.wait(wait)
+        if cancel is None:
+            return pending.flushed.wait(wait)
+        deadline = time.monotonic() + wait
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return pending.flushed.is_set()
+            if pending.flushed.wait(min(0.2, remaining)):
+                return True
+            if cancel.cancelled() and not self._token.cancelled():
+                return pending.flushed.is_set()
 
     def stop_consuming(self) -> None:
         """Close all shard consumers and forget them so the supervisor
@@ -460,6 +514,34 @@ class QueueClient:
         return self.shard_name(topic, index)
 
     def _publish_loop(self, my_channel: Channel) -> None:
+        # stall-watchdog liveness: this loop ticks at >= 5 Hz when idle
+        # (buffer get timeout 0.2 s) and beats per publish attempt, so
+        # a publisher thread wedged inside a broker write — the exact
+        # regression class PR 4 catalogued — reads as stalled instead
+        # of silently stranding every later publish in the buffer
+        watch = watchdog.MONITOR.loop("queue-publisher")
+        try:
+            self._publish_loop_watched(my_channel, watch)
+        except Exception as exc:
+            # an exception escaping the inner loop's own handling would
+            # kill this thread with ``_publisher_alive`` stuck True —
+            # the exact wedged-publisher class the watchdog exists for.
+            # Mark the publisher dead so the supervisor rebuilds it.
+            log.error("publisher loop crashed; supervisor will rebuild", exc=exc)
+            with self._lock:
+                if self._publisher_channel is my_channel:
+                    self._publisher_alive = False
+                    self._publisher_channel = None
+            try:
+                my_channel.close()
+            except BrokerError:
+                pass
+        finally:
+            watchdog.MONITOR.unregister(watch)
+
+    def _publish_loop_watched(
+        self, my_channel: Channel, watch
+    ) -> None:
         # keeps running after cancellation until the buffer drains (or the
         # drain deadline passes), so Convert messages enqueued by jobs that
         # were just acked are not dropped on shutdown.
@@ -472,6 +554,7 @@ class QueueClient:
         # reconnects.
         drain_deadline: float | None = None
         while True:
+            watch.beat()
             with self._lock:
                 if self._publisher_channel is not my_channel:
                     return  # superseded; a newer generation owns the state

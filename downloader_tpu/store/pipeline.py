@@ -40,11 +40,12 @@ from __future__ import annotations
 import os
 import threading
 import time
+import weakref
 from concurrent.futures import Future, ThreadPoolExecutor
 
 from ..fetch.progress import SpanSet  # noqa: F401  (re-export: span math lives with the writers)
 from ..scan import MEDIA_EXTENSIONS
-from ..utils import get_logger, metrics, tracing
+from ..utils import get_logger, incident, metrics, tracing, watchdog
 from ..utils.cancel import Cancelled, CancelToken
 from .s3 import S3Client, S3Error
 from .uploader import object_key
@@ -198,6 +199,9 @@ class _FileStream:
                     # part landed while the fetch was still running:
                     # genuinely overlapped egress
                     self.overlapped_bytes += length
+            # a completed part is the streaming path's unit of upload
+            # progress for the stall watchdog
+            session._upload_hb.beat()
         except (S3Error, OSError, ValueError, Cancelled) as exc:
             with session._lock:
                 if not self.failed:
@@ -359,6 +363,38 @@ class PipelineSession:
         # a None value marks the path ineligible for streaming
         self._files: dict[str, _FileStream | None] = {}  # guarded-by: _lock
         self._trace_parent = tracing.current_span()
+        # captured on the job thread (construction site); part workers
+        # beat it as parts complete — upload-stage forward progress for
+        # the stall watchdog
+        self._upload_hb = watchdog.current().heartbeat("upload")
+        pipeline._track(self)
+
+    def probe_state(self) -> dict:
+        """This session's live stream states for incident bundles —
+        exactly the evidence a dangling-multipart post-mortem needs."""
+        with self._lock:
+            files = []
+            for path, stream in self._files.items():
+                if stream is None:
+                    files.append(
+                        {"path": os.path.basename(path), "streaming": False}
+                    )
+                    continue
+                files.append(
+                    {
+                        "path": os.path.basename(path),
+                        "streaming": True,
+                        "key": stream.key,
+                        "total": stream.total,
+                        "parts_planned": stream.plan.num_parts,
+                        "parts_submitted": len(stream.submitted),
+                        "parts_done": len(stream.etags),
+                        "failed": stream.failed,
+                        "sealed": stream.sealed,
+                        "settled": stream.settled,
+                    }
+                )
+        return {"media_id": self._media_id, "files": files}
 
     # -- TransferSink protocol --------------------------------------------
 
@@ -514,6 +550,22 @@ class StreamingPipeline:
         self._prepare = prepare or (lambda: None)
         self._pool: ThreadPoolExecutor | None = None  # guarded-by: _pool_lock
         self._pool_lock = threading.Lock()
+        # live sessions for incident-bundle introspection; weak so a
+        # leaked session expires instead of pinning its job's state
+        self._sessions: "weakref.WeakSet[PipelineSession]" = weakref.WeakSet()
+        incident.RECORDER.register_probe(
+            "streaming-pipeline", self._incident_probe
+        )
+
+    def _track(self, session: "PipelineSession") -> None:
+        self._sessions.add(session)
+
+    def _incident_probe(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "part_workers": self._part_workers,
+            "sessions": [s.probe_state() for s in list(self._sessions)],
+        }
 
     def session(
         self, media_id: str, token: CancelToken | None = None

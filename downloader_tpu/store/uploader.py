@@ -30,7 +30,7 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
-from ..utils import get_logger, metrics, tracing
+from ..utils import get_logger, metrics, tracing, watchdog
 from ..utils.cancel import CancelToken
 from .credentials import from_env
 from .s3 import S3Client, S3Error
@@ -193,6 +193,12 @@ class Uploader:
         outcomes: list[tuple[str, str, Exception | None] | None]
         outcomes = [None] * len(pending)
 
+        # stall-watchdog heartbeat for the store-and-forward path:
+        # captured on the job thread, beaten per settled file from the
+        # pool workers (a failed upload is still forward progress — the
+        # batch is moving; only silence means wedged)
+        upload_hb = watchdog.current().heartbeat("upload")
+
         def upload_at(index: int) -> None:
             file_path = pending[index]
             key = object_key(media_id, file_path)
@@ -200,10 +206,12 @@ class Uploader:
                 size = self._upload_one(token, file_path, key)
             except (OSError, S3Error) as exc:
                 outcomes[index] = (file_path, key, exc)
+                upload_hb.beat()
                 return
             metrics.GLOBAL.add("s3_bytes_uploaded", size)
             metrics.GLOBAL.add("s3_objects_uploaded")
             outcomes[index] = (file_path, key, None)
+            upload_hb.beat()
 
         if len(pending) <= 1:
             for index in range(len(pending)):

@@ -87,3 +87,20 @@ class CancelToken:
     def child(self) -> "CancelToken":
         """Derive a token cancelled when either it or this token cancels."""
         return CancelToken(parent=self)
+
+    def detach(self) -> None:
+        """Unlink this token from its parent's fan-out list. A
+        per-job child token that is not detached when its job settles
+        accumulates in the daemon-lifetime parent forever — one dead
+        token per processed job. Idempotent; a detached token can
+        still be cancelled directly, it just no longer hears parent
+        cancellation (by detach time the job is over and there is
+        nothing left to interrupt)."""
+        parent, self._parent = self._parent, None
+        if parent is None:
+            return
+        with parent._lock:
+            try:
+                parent._children.remove(self)
+            except ValueError:
+                pass  # parent cancelled meanwhile; list already swapped

@@ -10,6 +10,15 @@ This module reproduces that surface: a leveled, field-structured logger with
 ``with_fields`` chaining (logrus ``WithFields``), a text formatter that
 renders ``time=... level=... msg="..." key=value`` lines and a JSON
 formatter, both thread-safe.
+
+Flight-recorder addition: every emitted record also lands in a bounded
+in-memory ring (``LOG_RING`` records, default 256; ``0`` disables)
+with job-id/trace-id correlation fields pulled from the active tracing
+context — so an incident bundle (utils/incident.py) and ``/debug/logs``
+can answer "what was this process saying just before it wedged"
+without grepping an external stream. The tracing module registers the
+context provider at import (``set_context_provider``), keeping the
+logging→tracing dependency inverted (tracing already imports us).
 """
 
 from __future__ import annotations
@@ -21,7 +30,8 @@ import sys
 import threading
 import time
 import traceback
-from typing import Any, Mapping, TextIO
+from collections import deque
+from typing import Any, Callable, Mapping, TextIO
 
 _LEVELS = {
     "trace": 5,
@@ -35,6 +45,53 @@ _LEVELS = {
 _LEVEL_NAMES = {10: "debug", 20: "info", 30: "warning", 40: "error", 50: "fatal"}
 
 _lock = threading.Lock()
+
+DEFAULT_RING = 256
+
+# the flight-recorder ring: recent structured records as dicts. None
+# when LOG_RING=0 — record capture then costs one attribute read.
+_ring: "deque[dict] | None" = deque(maxlen=DEFAULT_RING)  # guarded-by: _lock
+# returns correlation fields ({"job_id": ..., "trace": ...}) for the
+# calling thread, or None; installed by utils.tracing at import
+_context_provider: "Callable[[], dict | None] | None" = None
+
+
+def set_context_provider(provider: "Callable[[], dict | None]") -> None:
+    global _context_provider
+    _context_provider = provider
+
+
+def ring_capacity_from_env(environ=None) -> int:
+    """``LOG_RING``: records kept in the in-memory ring; 0 disables."""
+    env = os.environ if environ is None else environ
+    raw = (env.get("LOG_RING") or "").strip()
+    if not raw:
+        return DEFAULT_RING
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        get_logger("logging").with_fields(value=raw).warning(
+            "ignoring invalid LOG_RING (want an integer)"
+        )
+        return DEFAULT_RING
+
+
+def set_ring_capacity(capacity: int) -> None:
+    global _ring
+    with _lock:
+        _ring = deque(_ring or (), maxlen=capacity) if capacity > 0 else None
+
+
+def ring_tail(limit: int | None = None) -> list[dict]:
+    """The newest ``limit`` ring records (all when None), oldest
+    first — what /debug/logs serves and incident bundles embed."""
+    with _lock:
+        records = list(_ring) if _ring is not None else []
+    if limit is not None:
+        # explicit 0 branch: records[-0:] would slice the WHOLE list,
+        # inverting the contract for a 0-means-none caller
+        records = records[-limit:] if limit > 0 else []
+    return records
 
 
 class _Config:
@@ -73,6 +130,7 @@ def configure_from_env(environ: Mapping[str, str] | None = None) -> None:
         json_format=env.get("LOG_FORMAT", "").lower() == "json",
         report_caller=level == "debug",
     )
+    set_ring_capacity(ring_capacity_from_env(env))
 
 
 def _quote(value: str) -> str:
@@ -123,6 +181,26 @@ class Logger:
             record[key] = self.fields[key]
         if exc is not None:
             record["error"] = f"{type(exc).__name__}: {exc}"
+
+        if _ring is not None:
+            # flight-recorder copy BEFORE the text formatter mutates
+            # the record; correlation fields come from the active trace
+            # so /debug/logs and incident bundles line records up with
+            # the job that emitted them
+            entry = dict(record)
+            entry["ts"] = time.time()
+            provider = _context_provider
+            if provider is not None:
+                try:
+                    context = provider()
+                except Exception:
+                    context = None  # a tracing bug must not kill logging
+                if context:
+                    for key, value in context.items():
+                        entry.setdefault(key, value)
+            with _lock:
+                if _ring is not None:
+                    _ring.append(entry)
 
         if _config.json_format:
             line = json.dumps(record, default=str)

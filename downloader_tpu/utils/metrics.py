@@ -46,6 +46,47 @@ COUNT_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0)
 # overlap improves, not tail latency
 RATIO_BUCKETS = (0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0)
 
+# `# HELP` text for the best-known series on /metrics; anything not
+# listed gets a derived one-liner from help_text() so every exported
+# family still carries a well-formed HELP line (the exposition lint in
+# tests/test_metrics_lint.py enforces presence and shape for ALL
+# families, catalogued or not)
+HELP = {
+    "jobs_processed": "jobs completed end-to-end (consume through ack)",
+    "jobs_failed": "jobs dropped after exhausting their retry budget",
+    "jobs_retried": "job attempts republished for retry",
+    "jobs_dropped": "jobs nacked as malformed or unsupported",
+    "queue_published": "messages confirmed onto the broker",
+    "queue_delivered": "messages delivered to this consumer",
+    "queue_publish_retries": "publish attempts that failed and re-buffered",
+    "queue_reconnects": "broker connections re-established",
+    "queue_consumer_errors": "shard consumer create failures",
+    "broker_connected": "whether the broker connection is up (1) or down (0)",
+    "job_duration_seconds": "completed job latency, consume to ack",
+    "fetch_seconds": "per-job fetch stage duration",
+    "scan_seconds": "per-job media scan stage duration",
+    "upload_seconds": "per-job upload stage duration",
+    "publish_seconds": "per-job Convert publish stage duration",
+    "stream_upload_seconds": "per-file streamed-egress interval duration",
+    "overhead_seconds": "per-job framework overhead (root minus stages)",
+    "pipeline_overlap_ratio": (
+        "fraction of streamed bytes uploaded while the fetch still ran"
+    ),
+    "watchdog_stalls": "stall episodes flagged (no forward progress)",
+    "watchdog_cancels": "stalled jobs cancelled (WATCHDOG_ACTION=cancel)",
+    "watchdog_stalled_tasks": "watched tasks currently flagged as stalled",
+    "incident_captures": "incident bundles captured",
+    "incident_captures_suppressed": (
+        "watchdog-triggered captures suppressed by rate limiting"
+    ),
+}
+
+
+def help_text(name: str) -> str:
+    """HELP line body for series ``name``: catalogued text, else a
+    derived one so the exposition stays well-formed for every family."""
+    return HELP.get(name, f"{name.replace('_', ' ')} (downloader)")
+
 
 class Counters:
     def __init__(self) -> None:

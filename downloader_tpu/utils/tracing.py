@@ -361,6 +361,23 @@ class Tracer:
         with self._lock:
             return self._ring[-1] if self._ring else None
 
+    def find(self, job_id: str) -> dict | None:
+        """The newest trace for ``job_id`` — in-flight first (a stalled
+        job is by definition still in flight; a retried job also has a
+        COMPLETED earlier attempt in the ring, and an incident bundle
+        embedding that healthy-looking finished tree instead of the
+        live wedged one would point the post-mortem at the wrong
+        attempt), then the completed ring (the watchdog may capture
+        just after a cancel completed the trace)."""
+        with self._lock:
+            # ring first in the list so reversed() visits every
+            # in-flight trace before any completed one
+            candidates = list(self._ring) + list(self._in_flight.values())
+        for trace in reversed(candidates):
+            if trace.job_id == job_id:
+                return trace.to_dict()
+        return None
+
     def clear(self) -> None:
         """Test isolation only."""
         with self._lock:
@@ -476,6 +493,25 @@ def span(name: str, **meta):
     if parent is None:
         return NOOP
     return parent.child(name, **meta)
+
+
+def _log_context() -> dict | None:
+    """Correlation fields for the log ring (utils/logging.py): which
+    job/trace the calling thread is working for right now."""
+    span = current_span()
+    trace = getattr(span, "_trace", None)
+    if trace is None:
+        return None
+    context: dict = {"trace": trace.seq}
+    if trace.job_id:
+        context["job_id"] = trace.job_id
+    return context
+
+
+# logging cannot import tracing (we import it); hand it the provider
+from . import logging as _logging  # noqa: E402
+
+_logging.set_context_provider(_log_context)
 
 
 class adopt:

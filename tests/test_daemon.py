@@ -258,7 +258,7 @@ def test_poison_message_capped(harness, monkeypatch):
     cap instead of looping forever (review finding)."""
     calls = []
 
-    def explode(media_id, url):
+    def explode(media_id, url, token=None):
         calls.append(1)
         raise RuntimeError("poison")
 
@@ -340,6 +340,56 @@ def test_health_endpoint(harness):
         except urllib.error.HTTPError as err:
             assert err.code == 404
     finally:
+        server.stop()
+
+
+def test_healthz_answers_while_another_handler_is_blocked(harness):
+    """The health server is threaded (ThreadingHTTPServer) so a slow
+    debug view — a fat /debug/trace serialization, an incident dump —
+    cannot block the /healthz liveness probe an orchestrator restarts
+    on (ISSUE 5 satellite). A deliberately wedged handler holds one
+    server thread; /healthz must still answer promptly."""
+    import json
+    import threading as threading_mod
+    import urllib.request
+
+    from downloader_tpu.daemon.health import HealthServer
+
+    server = HealthServer(harness.daemon, harness.daemon._client, 0, "127.0.0.1")
+    entered = threading_mod.Event()
+    release = threading_mod.Event()
+    real_trace = server._debug_trace
+
+    def wedged_trace():
+        entered.set()
+        release.wait(15)  # hold the handler thread hostage
+        return real_trace()
+
+    server._debug_trace = wedged_trace
+    server.start()
+    try:
+        blocked = threading_mod.Thread(
+            target=lambda: urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/debug/trace", timeout=20
+            ).read(),
+            daemon=True,
+        )
+        blocked.start()
+        assert entered.wait(5), "wedged handler never entered"
+
+        start = time.monotonic()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/healthz", timeout=5
+        ) as resp:
+            assert resp.status == 200
+            payload = json.loads(resp.read())
+        assert payload["broker_connected"] is True
+        assert time.monotonic() - start < 2.0, (
+            "/healthz waited on the blocked handler"
+        )
+    finally:
+        release.set()
+        blocked.join(timeout=10)
         server.stop()
 
 

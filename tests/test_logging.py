@@ -83,3 +83,78 @@ def test_warn_level_alias(stream):
     assert stream.getvalue() == ""
     ulog.get_logger().warning("shown")
     assert "shown" in stream.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# in-memory log ring (the incident flight recorder's tail)
+
+
+@pytest.fixture
+def ring(stream):
+    ulog.set_ring_capacity(8)
+    yield
+    ulog.set_ring_capacity(ulog.DEFAULT_RING)
+
+
+def test_ring_captures_structured_records(ring):
+    ulog.get_logger("queue").with_fields(topic="v1.download").info("sent")
+    records = ulog.ring_tail()
+    assert records
+    record = records[-1]
+    assert record["msg"] == "sent"
+    assert record["level"] == "info"
+    assert record["logger"] == "queue"
+    assert record["topic"] == "v1.download"
+    assert isinstance(record["ts"], float)
+
+
+def test_ring_is_bounded_and_tail_limited(ring):
+    for i in range(30):
+        ulog.get_logger().info(f"m{i}")
+    records = ulog.ring_tail()
+    assert len(records) == 8  # capacity
+    assert records[-1]["msg"] == "m29"
+    assert [r["msg"] for r in ulog.ring_tail(3)] == ["m27", "m28", "m29"]
+    # 0 means none, matching the LOG_RING=0 convention — not the whole
+    # ring via the records[-0:] slice trap
+    assert ulog.ring_tail(0) == []
+
+
+def test_ring_respects_level_filter(ring):
+    ulog.get_logger().debug("filtered out")
+    assert all(r["msg"] != "filtered out" for r in ulog.ring_tail())
+
+
+def test_ring_correlates_with_active_trace(ring):
+    """Records emitted inside a job's span tree carry job_id/trace
+    correlation fields pulled from the tracing context (the provider
+    tracing.py registers at import)."""
+    from downloader_tpu.utils import tracing
+
+    tracing.TRACER.clear()
+    with tracing.TRACER.job("job-7") as root:
+        root.annotate(job_id="job-7")
+        with tracing.span("fetch"):
+            ulog.get_logger("fetch.http").info("correlated line")
+    record = next(
+        r for r in ulog.ring_tail() if r["msg"] == "correlated line"
+    )
+    assert record["job_id"] == "job-7"
+    assert isinstance(record["trace"], int)
+    tracing.TRACER.clear()
+
+
+def test_ring_disabled_by_zero_capacity(stream):
+    ulog.set_ring_capacity(0)
+    try:
+        ulog.get_logger().info("not recorded")
+        assert ulog.ring_tail() == []
+    finally:
+        ulog.set_ring_capacity(ulog.DEFAULT_RING)
+
+
+def test_ring_capacity_from_env():
+    assert ulog.ring_capacity_from_env({}) == ulog.DEFAULT_RING
+    assert ulog.ring_capacity_from_env({"LOG_RING": "32"}) == 32
+    assert ulog.ring_capacity_from_env({"LOG_RING": "0"}) == 0
+    assert ulog.ring_capacity_from_env({"LOG_RING": "x"}) == ulog.DEFAULT_RING

@@ -1,0 +1,209 @@
+"""Prometheus exposition lint for /metrics (ISSUE 5 satellite).
+
+The exposition format is a contract with the scraper: a family without
+``# HELP``/``# TYPE``, a histogram whose cumulative buckets decrease or
+whose ``+Inf`` count disagrees with ``_count``, or one family declared
+twice are all silently mis-ingested (or dropped) by real Prometheus
+servers rather than failing loudly. This suite renders the REAL
+``/metrics`` view over a fully populated registry — every bucket
+layout the codebase uses — and lints the text the scraper would see.
+"""
+
+import re
+
+import pytest
+
+from downloader_tpu.daemon.health import HealthServer
+from downloader_tpu.utils import metrics
+
+NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? ([^ ]+)$"
+)
+
+
+class _FakeDaemonStats:
+    processed = 2
+    failed = 1
+    retried = 0
+    dropped = 0
+
+
+class _FakeDaemon:
+    stats = _FakeDaemonStats()
+    worker_count = 3
+
+
+class _FakeQueueStats:
+    published = 5
+    delivered = 6
+    publish_retries = 0
+    reconnects = 1
+    consumer_errors = 0
+
+
+class _FakeClient:
+    stats = _FakeQueueStats()
+
+    def connected(self):
+        return True
+
+
+@pytest.fixture
+def exposition():
+    """The /metrics body over a registry populated with every metric
+    shape (counter, gauge, and one histogram per bucket layout)."""
+    metrics.GLOBAL.reset()
+    metrics.GLOBAL.add("http_files_fetched", 4)
+    metrics.GLOBAL.add("watchdog_stalls", 1)
+    metrics.GLOBAL.gauge_set("pipeline_parts_in_flight", 2)
+    metrics.GLOBAL.gauge_set("watchdog_stalled_tasks", 1)
+    metrics.GLOBAL.observe("job_duration_seconds", 0.5)
+    metrics.GLOBAL.observe(
+        "overhead_seconds", 0.002, buckets=metrics.OVERHEAD_BUCKETS
+    )
+    metrics.GLOBAL.observe(
+        "http_segments_per_fetch", 4, buckets=metrics.COUNT_BUCKETS
+    )
+    metrics.GLOBAL.observe(
+        "pipeline_overlap_ratio", 0.7, buckets=metrics.RATIO_BUCKETS
+    )
+    metrics.GLOBAL.observe("pipeline_overlap_ratio", 1.5)  # over-bound tail
+    server = HealthServer(_FakeDaemon(), _FakeClient(), 0)
+    try:
+        code, body, ctype = server._metrics()
+    finally:
+        server._httpd.server_close()
+    assert code == 200
+    assert ctype.startswith("text/plain")
+    metrics.GLOBAL.reset()
+    return body.decode()
+
+
+def _parse(text):
+    """(families, samples): family -> {'help': str, 'type': str},
+    sample name -> [(labels, value)]."""
+    families: dict[str, dict] = {}
+    samples: dict[str, list] = {}
+    declared_order: dict[str, int] = {}
+    for lineno, line in enumerate(text.splitlines()):
+        assert line.strip() == line and line, f"ragged line {lineno}: {line!r}"
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):]
+            name, _, help_text = rest.partition(" ")
+            assert NAME_RE.fullmatch(name), f"bad HELP name: {line!r}"
+            assert help_text.strip(), f"empty HELP text: {line!r}"
+            assert name not in families, f"duplicate HELP for {name}"
+            families[name] = {"help": help_text, "type": None}
+            declared_order[name] = lineno
+        elif line.startswith("# TYPE "):
+            rest = line[len("# TYPE "):]
+            name, _, kind = rest.partition(" ")
+            assert kind in ("counter", "gauge", "histogram"), (
+                f"bad TYPE: {line!r}"
+            )
+            assert name in families, f"TYPE before HELP for {name}"
+            assert families[name]["type"] is None, f"duplicate TYPE for {name}"
+            families[name]["type"] = kind
+        elif line.startswith("#"):
+            raise AssertionError(f"unknown comment line: {line!r}")
+        else:
+            match = SAMPLE_RE.match(line)
+            assert match, f"unparseable sample line: {line!r}"
+            name, labels, value = match.groups()
+            float(value)  # must parse
+            samples.setdefault(name, []).append((labels or "", float(value)))
+    return families, samples
+
+
+def _family_of(sample_name, families):
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix):
+            base = sample_name[: -len(suffix)]
+            if base in families and families[base]["type"] == "histogram":
+                return base
+    return sample_name
+
+
+def test_every_family_has_help_and_type(exposition):
+    families, samples = _parse(exposition)
+    for sample_name in samples:
+        family = _family_of(sample_name, families)
+        assert family in families, f"sample {sample_name} has no family"
+        meta = families[family]
+        assert meta["type"] is not None, f"{family} missing # TYPE"
+        assert meta["help"].strip(), f"{family} missing # HELP"
+    # and no family is declared without samples
+    for family in families:
+        owned = [
+            s for s in samples if _family_of(s, families) == family
+        ]
+        assert owned, f"family {family} declared but has no samples"
+
+
+def test_no_duplicate_families(exposition):
+    # _parse asserts duplicate HELP/TYPE; also assert no sample name
+    # appears under two declarations (counter vs gauge collision)
+    families, samples = _parse(exposition)
+    seen = {}
+    for sample_name, entries in samples.items():
+        family = _family_of(sample_name, families)
+        kind = families[family]["type"]
+        if kind != "histogram":
+            assert len(entries) == 1, (
+                f"{sample_name} sampled {len(entries)} times"
+            )
+        previous = seen.setdefault(sample_name, family)
+        assert previous == family
+
+
+def test_histogram_triples_consistent(exposition):
+    families, samples = _parse(exposition)
+    histograms = [
+        name for name, meta in families.items()
+        if meta["type"] == "histogram"
+    ]
+    assert histograms, "no histogram families rendered"
+    for name in histograms:
+        buckets = samples.get(f"{name}_bucket", [])
+        assert buckets, f"{name}: no _bucket samples"
+        les = []
+        for labels, value in buckets:
+            match = re.fullmatch(r'\{le="([^"]+)"\}', labels)
+            assert match, f"{name}: bucket without le label: {labels!r}"
+            les.append((match.group(1), value))
+        assert les[-1][0] == "+Inf", f"{name}: buckets must end at +Inf"
+        bounds = [float(le) for le, _ in les[:-1]]
+        assert bounds == sorted(bounds), f"{name}: le bounds out of order"
+        counts = [value for _, value in les]
+        assert counts == sorted(counts), (
+            f"{name}: cumulative bucket counts decrease: {counts}"
+        )
+        (sum_labels, total), = samples.get(f"{name}_sum", [("", None)])
+        (count_labels, count), = samples.get(f"{name}_count", [("", None)])
+        assert total is not None, f"{name}: missing _sum"
+        assert count is not None, f"{name}: missing _count"
+        assert sum_labels == "" and count_labels == ""
+        assert counts[-1] == count, (
+            f"{name}: +Inf bucket {counts[-1]} != _count {count}"
+        )
+        # an observation above the top finite bound must still land in
+        # +Inf/_count (the over-bound tail observed in the fixture)
+        assert count >= counts[-2] if len(counts) > 1 else True
+
+
+def test_expected_series_present(exposition):
+    """The families the dashboards/alerts reference exist in one scrape
+    of a populated registry."""
+    for needle in (
+        "downloader_jobs_processed",
+        "downloader_broker_connected",
+        "downloader_watchdog_stalls",
+        "downloader_watchdog_stalled_tasks",
+        "downloader_job_duration_seconds_bucket",
+        "downloader_overhead_seconds_count",
+        "downloader_pipeline_overlap_ratio_sum",
+    ):
+        assert re.search(
+            rf"^{re.escape(needle)}[ {{]", exposition, re.M
+        ), f"missing series {needle}"

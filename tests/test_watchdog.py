@@ -1,0 +1,607 @@
+"""Stall watchdog + incident flight recorder (utils/watchdog.py,
+utils/incident.py).
+
+Layers:
+
+- watchdog unit semantics: progress-based stall episodes (flag once,
+  re-arm on recovery), per-stage deadline overrides, loop suspension,
+  disabled mode handing out no-op watches;
+- the per-job cost guard mirroring the tracing overhead bound: a fully
+  watched job lifecycle must cost <= 0.5 ms (ISSUE 5 satellite);
+- incident recorder: bundle contents (thread stacks, metrics deltas,
+  probes, log-ring tail), disk persistence + retention pruning,
+  weak-probe expiry, watchdog-trigger rate limiting;
+- the e2e acceptance: a stub HTTP server wedges mid-stream; the
+  watchdog flags the right job+stage within the deadline, the incident
+  bundle carries stacks + the job's span tree + the log tail,
+  /debug/incidents serves it, and WATCHDOG_ACTION=cancel releases the
+  job with ZERO dangling multipart uploads.
+"""
+
+import http.server
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from downloader_tpu.daemon.app import Daemon, capture_stall_incident
+from downloader_tpu.daemon.config import Config
+from downloader_tpu.daemon.health import HealthServer
+from downloader_tpu.fetch import DispatchClient, HTTPBackend
+from downloader_tpu.queue import MemoryBroker, QueueClient
+from downloader_tpu.store import Credentials, S3Client, Uploader
+from downloader_tpu.store.stub import S3Stub
+from downloader_tpu.utils import incident, metrics, tracing, watchdog
+from downloader_tpu.utils import logging as ulog
+from downloader_tpu.utils.cancel import CancelToken
+from downloader_tpu.wire import Download, Media
+
+CREDS = Credentials(access_key="testkey", secret_key="testsecret")
+PART = 64 * 1024
+THRESHOLD = 128 * 1024
+PAYLOAD_SIZE = 256 * 1024
+
+
+def wait_for(predicate, timeout=15.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture(autouse=True)
+def clean_observability():
+    watchdog.MONITOR.reset()
+    watchdog.MONITOR.configure(
+        stall_s=watchdog.DEFAULT_STALL_S, action="log",
+        stage_overrides={}, on_stall=None,
+    )
+    incident.RECORDER.reset()
+    tracing.TRACER.clear()
+    yield
+    watchdog.MONITOR.reset()
+    watchdog.MONITOR.configure(
+        stall_s=watchdog.DEFAULT_STALL_S, action="log",
+        stage_overrides={}, on_stall=None,
+    )
+    incident.RECORDER.reset()
+    tracing.TRACER.clear()
+
+
+# ---------------------------------------------------------------------------
+# watchdog unit semantics
+
+
+class TestWatchdogUnit:
+    def test_env_parsers(self):
+        assert watchdog.stall_from_env({}) == watchdog.DEFAULT_STALL_S
+        assert watchdog.stall_from_env({"WATCHDOG_STALL_S": "45"}) == 45.0
+        assert watchdog.stall_from_env({"WATCHDOG_STALL_S": "off"}) == 0.0
+        assert (
+            watchdog.stall_from_env({"WATCHDOG_STALL_S": "nope"})
+            == watchdog.DEFAULT_STALL_S
+        )
+        assert watchdog.action_from_env({}) == "log"
+        assert (
+            watchdog.action_from_env({"WATCHDOG_ACTION": "CANCEL"})
+            == "cancel"
+        )
+        assert watchdog.action_from_env({"WATCHDOG_ACTION": "explode"}) == "log"
+        assert watchdog.stage_overrides_from_env(
+            {"WATCHDOG_STALL_STAGES": "fetch=600, publish=30,bad"}
+        ) == {"fetch": 600.0, "publish": 30.0}
+
+    def test_progress_defers_stall_slow_is_not_stalled(self):
+        """A SLOW stage that keeps advancing never flags; only silence
+        past the deadline does — the distinction the whole module
+        exists for."""
+        w = watchdog.Watchdog(stall_s=10.0)
+        watch = w.job("j")
+        hb = watch.stage("fetch")
+        now = time.monotonic()
+        w.scan(now=now)
+        for step in range(1, 30):  # 29 "seconds" of slow progress
+            hb.beat(1)
+            assert w.scan(now=now + step) == []
+        assert not watch.stalled
+        # then silence past the deadline
+        assert [x.name for x in w.scan(now=now + 45)] == ["j"]
+        assert watch.stalled
+
+    def test_stall_is_episode_flagged_once_then_rearmed(self):
+        w = watchdog.Watchdog(stall_s=1.0)
+        watch = w.job("j")
+        hb = watch.stage("fetch")
+        now = time.monotonic()
+        w.scan(now=now)
+        assert len(w.scan(now=now + 5)) == 1
+        assert w.scan(now=now + 10) == []  # same episode, no re-flag
+        hb.beat()  # recovery
+        assert w.scan(now=now + 11) == []
+        assert not watch.stalled
+        assert len(w.scan(now=now + 30)) == 1  # new episode
+        assert watch.stall_count == 2
+
+    def test_stage_transition_counts_as_progress(self):
+        w = watchdog.Watchdog(stall_s=1.0)
+        watch = w.job("j")
+        watch.stage("fetch")
+        now = time.monotonic()
+        w.scan(now=now)
+        watch.stage("scan")  # moved on: fetch silence is forgiven
+        assert w.scan(now=now + 5) == []  # baseline for the new stage
+        assert w.scan(now=now + 5.5) == []
+
+    def test_per_stage_override_beats_default(self):
+        w = watchdog.Watchdog(
+            stall_s=100.0, stage_overrides={"publish": 1.0}
+        )
+        watch = w.job("j")
+        watch.stage("publish")
+        now = time.monotonic()
+        w.scan(now=now)
+        flagged = w.scan(now=now + 2)
+        assert [x.name for x in flagged] == ["j"]
+
+    def test_cancel_action_fires_job_cancel_hook(self):
+        cancelled = []
+        w = watchdog.Watchdog(stall_s=0.5, action="cancel")
+        watch = w.job("j", cancel=lambda: cancelled.append(True))
+        watch.stage("fetch")
+        now = time.monotonic()
+        w.scan(now=now)
+        w.scan(now=now + 1)
+        assert cancelled == [True]
+
+    def test_loop_suspension_pauses_the_deadline(self):
+        w = watchdog.Watchdog(stall_s=100.0, loop_stall_s=1.0)
+        watch = w.loop("worker")
+        now = time.monotonic()
+        w.scan(now=now)
+        with watch.suspend():
+            assert w.scan(now=now + 50) == []  # busy in a job: exempt
+        # resume re-baselines; silence AFTER resume flags
+        assert w.scan(now=now + 51) == []
+        assert [x.name for x in w.scan(now=now + 60)] == ["worker"]
+
+    def test_disabled_watchdog_hands_out_noop_watches(self):
+        w = watchdog.Watchdog(stall_s=0.0)
+        watch = w.job("j")
+        assert watch is watchdog.NOOP_WATCH
+        watch.stage("fetch").beat(100)  # all no-ops, nothing registered
+        w.unregister(watch)
+        assert w.snapshot()["tasks"] == []
+        assert w.start() is w  # refuses to spin a thread
+        assert w.snapshot()["running"] is False
+
+    def test_unregister_clears_stalled_gauge(self):
+        metrics.GLOBAL.reset()
+        w = watchdog.Watchdog(stall_s=0.5)
+        watch = w.job("j")
+        watch.stage("fetch")
+        now = time.monotonic()
+        w.scan(now=now)
+        w.scan(now=now + 1)
+        assert metrics.GLOBAL.gauges()["watchdog_stalled_tasks"] == 1
+        w.unregister(watch)
+        assert metrics.GLOBAL.gauges()["watchdog_stalled_tasks"] == 0
+
+    def test_snapshot_shape(self):
+        w = watchdog.Watchdog(stall_s=30.0)
+        watch = w.job("job-9")
+        watch.stage("fetch").beat(5)
+        w.scan()
+        snap = w.snapshot()
+        assert snap["enabled"] and snap["stall_s"] == 30.0
+        (task,) = snap["tasks"]
+        assert task["name"] == "job-9"
+        assert task["stage"] == "fetch"
+        assert task["counts"]["fetch"] >= 5
+        assert task["idle_s"] >= 0
+        assert task["deadline_s"] == 30.0
+
+    def test_thread_local_install_and_noop_current(self):
+        assert watchdog.current() is watchdog.NOOP_WATCH
+        w = watchdog.Watchdog(stall_s=10)
+        watch = w.job("j")
+        with watchdog.install(watch):
+            assert watchdog.current() is watch
+            hb = watchdog.current().heartbeat("fetch")
+            hb.beat(10)
+        assert watchdog.current() is watchdog.NOOP_WATCH
+        assert watch.counts()["fetch"] == 10
+
+
+def test_watchdog_overhead_bounded():
+    """The satellite's cost guard, mirroring the tracing overhead
+    bound: one fully watched job lifecycle — register, install, five
+    stage transitions, 64 fetch beats + 8 upload beats (more than a
+    256 KiB streamed job ever emits), unregister — must cost <= 0.5 ms
+    at the median over 200 reps."""
+    monitor = watchdog.Watchdog(stall_s=120.0)
+
+    def one_job():
+        watch = monitor.job("bench")
+        with watchdog.install(watch):
+            hb = watch.stage("fetch")
+            for _ in range(64):
+                hb.beat(1024)
+            watch.stage("scan")
+            watch.stage("upload")
+            upload_hb = watchdog.current().heartbeat("upload")
+            for _ in range(8):
+                upload_hb.beat()
+            watch.stage("publish")
+            watch.stage("ack")
+        monitor.unregister(watch)
+
+    one_job()  # warm
+    laps = []
+    for _ in range(200):
+        start = time.perf_counter()
+        one_job()
+        laps.append(time.perf_counter() - start)
+    laps.sort()
+    median_ms = laps[len(laps) // 2] * 1000
+    assert median_ms < 0.5, (
+        f"watchdog costs {median_ms:.3f} ms/job — over the 0.5 ms "
+        "per-job budget (ISSUE 5 satellite)"
+    )
+
+
+# ---------------------------------------------------------------------------
+# incident recorder
+
+
+class TestIncidentRecorder:
+    def test_bundle_contents(self):
+        # a throwaway counter name: the registry is process-wide, and
+        # leaking e.g. jobs_processed=5 into it would corrupt the
+        # /healthz payload of every later harness in the run
+        metrics.GLOBAL.reset()
+        metrics.GLOBAL.add("incident_test_counter", 3)
+        ulog.get_logger("test").with_fields(k="v").info("breadcrumb one")
+        recorder = incident.IncidentRecorder()
+        recorder.register_probe("static", lambda: {"depth": 7})
+        first = recorder.capture("first")
+        metrics.GLOBAL.add("incident_test_counter", 2)
+        bundle = recorder.capture("second", job_id="nope")
+        try:
+            assert bundle["reason"] == "second"
+            assert bundle["trigger"] == "manual"
+            # every live thread appears with a formatted stack
+            names = [t["name"] for t in bundle["threads"]]
+            assert "MainThread" in names
+            assert all("File" in t["stack"] for t in bundle["threads"])
+            # counter delta since the previous capture
+            assert bundle["metrics_delta"]["incident_test_counter"] == 2
+            assert bundle["metrics"]["counters"]["incident_test_counter"] == 5
+            assert bundle["probes"]["static"] == {"depth": 7}
+            assert any(
+                r["msg"] == "breadcrumb one" for r in bundle["log_tail"]
+            )
+            assert bundle["trace"] is None  # no such job traced
+            assert first["id"] != bundle["id"]
+        finally:
+            metrics.GLOBAL.reset()
+
+    def test_capture_embeds_job_trace(self):
+        with tracing.TRACER.job("job-42") as root:
+            root.annotate(job_id="job-42")
+            with tracing.span("fetch"):
+                bundle = incident.IncidentRecorder().capture(
+                    "wedged", job_id="job-42"
+                )
+        assert bundle["trace"]["job_id"] == "job-42"
+        spans = bundle["trace"]["spans"]
+        assert spans["name"] == "job"
+        assert any(c["name"] == "fetch" for c in spans["children"])
+
+    def test_probe_errors_and_weak_expiry(self):
+        recorder = incident.IncidentRecorder()
+
+        def bad():
+            raise RuntimeError("probe exploded")
+
+        recorder.register_probe("bad", bad)
+
+        class Owner:
+            def probe(self):
+                return {"alive": True}
+
+        owner = Owner()
+        name = recorder.register_probe("weak", owner.probe)
+        bundle = recorder.capture("x")
+        assert "RuntimeError" in bundle["probes"]["bad"]["error"]
+        assert bundle["probes"]["weak"] == {"alive": True}
+        del owner  # WeakMethod expires with its owner
+        bundle = recorder.capture("y")
+        assert "weak" not in bundle["probes"]
+        assert name == "weak"
+
+    def test_duplicate_probe_names_uniquified(self):
+        recorder = incident.IncidentRecorder()
+        assert recorder.register_probe("p", lambda: 1) == "p"
+        assert recorder.register_probe("p", lambda: 2) == "p-2"
+        bundle = recorder.capture("x")
+        assert bundle["probes"]["p"] == 1
+        assert bundle["probes"]["p-2"] == 2
+
+    def test_persistence_and_retention(self, tmp_path):
+        recorder = incident.IncidentRecorder()
+        recorder.configure(directory=str(tmp_path), keep=3)
+        ids = []
+        for i in range(5):
+            bundle = recorder.capture(f"r{i}")
+            ids.append(bundle["id"])
+            assert bundle["persisted"].endswith(f"{bundle['id']}.json")
+        names = sorted(os.listdir(tmp_path))
+        assert len(names) == 3  # oldest two pruned
+        assert names == [f"{i}.json" for i in ids[-3:]]
+        # a persisted bundle round-trips as JSON
+        loaded = recorder.get(ids[-1])
+        assert loaded["reason"] == "r4"
+        # listing merges memory and disk, sorted by id
+        listed = [e["id"] for e in recorder.list_incidents()]
+        assert listed == sorted(set(listed))
+        assert ids[-1] in listed
+
+    def test_watchdog_trigger_rate_limited(self):
+        recorder = incident.IncidentRecorder()
+        recorder.min_auto_interval = 3600.0
+        assert recorder.capture("s1", trigger="watchdog") is not None
+        assert recorder.capture("s2", trigger="watchdog") is None
+        # manual captures bypass the auto limiter
+        assert recorder.capture("manual") is not None
+
+
+# ---------------------------------------------------------------------------
+# e2e: wedged fetch → flag → incident bundle → cancel, zero dangling
+
+
+class WedgeHandler(http.server.BaseHTTPRequestHandler):
+    """Serves PAYLOAD_SIZE bytes but stops mid-stream and HOLDS the
+    socket open — the canonical wedged transfer: no data, no error."""
+
+    release = threading.Event()
+    payload = os.urandom(PAYLOAD_SIZE)
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        self.send_response(200)
+        self.send_header("Content-Length", str(PAYLOAD_SIZE))
+        self.end_headers()
+        self.wfile.write(WedgeHandler.payload[: PAYLOAD_SIZE // 2])
+        self.wfile.flush()
+        WedgeHandler.release.wait(30)  # wedge: keep the socket open
+
+
+@pytest.fixture
+def wedge_server():
+    WedgeHandler.release = threading.Event()
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), WedgeHandler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield base
+    WedgeHandler.release.set()
+    httpd.shutdown()
+
+
+@pytest.fixture
+def wedged_harness(wedge_server, tmp_path):
+    """Fully wired daemon whose fetch WILL wedge: memory broker, S3
+    stub with a small multipart threshold (the speculative upload is
+    live when the stall hits), watchdog armed with a sub-second
+    deadline and the production stall→incident hook, health server for
+    /debug/incidents."""
+    token = CancelToken()
+    broker = MemoryBroker()
+    stub = S3Stub(credentials=CREDS).start()
+    config = Config(
+        broker="memory", base_dir=str(tmp_path), concurrency=1,
+        max_job_retries=1, retry_delay=0.05,
+    )
+    client = QueueClient(
+        token, broker.connect, supervisor_interval=0.05, drain_timeout=5
+    )
+    dispatcher = DispatchClient(
+        token,
+        str(tmp_path),
+        [
+            HTTPBackend(
+                progress_interval=0.01, timeout=2.0, zero_copy=False,
+                segments=1,  # single-stream: the wedge is one socket
+            )
+        ],
+    )
+    uploader = Uploader(
+        config.bucket,
+        S3Client(
+            stub.endpoint, CREDS,
+            multipart_threshold=THRESHOLD, part_size=PART,
+        ),
+    )
+    uploader.configure_pipeline(True, part_workers=2)
+    daemon = Daemon(token, client, dispatcher, uploader, config)
+
+    incident.RECORDER.configure(
+        directory=str(tmp_path / "incidents"), keep=8
+    )
+    incident.RECORDER.min_auto_interval = 0.0
+    stalls = []
+
+    def on_stall(watch, stage, idle):
+        stalls.append((watch.name, stage, idle, time.monotonic()))
+        capture_stall_incident(watch, stage, idle)
+
+    watchdog.MONITOR.configure(
+        stall_s=0.6, action="cancel", stage_overrides={}, on_stall=on_stall
+    )
+    watchdog.MONITOR.start(poll_interval=0.05)
+
+    health = HealthServer(daemon, client, 0).start()
+    runner = threading.Thread(target=daemon.run, daemon=True)
+    runner.start()
+    time.sleep(0.1)
+    producer = broker.connect().channel()
+
+    class Harness:
+        pass
+
+    h = Harness()
+    h.daemon = daemon
+    h.stub = stub
+    h.health_port = health.port
+    h.stalls = stalls
+    h.enqueued_at = None
+
+    def enqueue(media_id, url):
+        h.enqueued_at = time.monotonic()
+        body = Download(media=Media(id=media_id, source_uri=url)).marshal()
+        producer.publish("v1.download", "v1.download-0", body)
+
+    h.enqueue = enqueue
+    yield h
+    WedgeHandler.release.set()
+    token.cancel()
+    runner.join(timeout=15)
+    watchdog.MONITOR.stop()
+    health.stop()
+    uploader.close()
+    stub.stop()
+
+
+def test_e2e_wedged_fetch_flagged_captured_cancelled(
+    wedged_harness, wedge_server
+):
+    """ISSUE 5 acceptance: stub server stops mid-stream → the watchdog
+    flags job+stage within the deadline → the incident bundle carries
+    thread stacks, the job's span tree, and the log-ring tail →
+    /debug/incidents serves it → WATCHDOG_ACTION=cancel releases the
+    job with zero dangling multipart uploads."""
+    h = wedged_harness
+    ulog.get_logger("test").info("pre-wedge breadcrumb")
+    h.enqueue("wedged-1", f"{wedge_server}/movie.mkv")
+
+    # the speculative multipart upload goes live once headers arrive
+    assert wait_for(lambda: h.stub.list_multipart_uploads() != [])
+
+    # -- the watchdog flags the right job+stage, within the deadline --
+    assert wait_for(lambda: h.stalls, timeout=10)
+    name, stage, idle, flagged_at = h.stalls[0]
+    assert name == "wedged-1"
+    assert stage == "fetch"
+    assert idle >= 0.6
+    # flagged promptly: deadline (0.6) + scan granularity + slack, not
+    # the socket timeout (2 s) and nothing like the job timeout
+    assert flagged_at - h.enqueued_at < 2.0
+    assert metrics.GLOBAL.snapshot().get("watchdog_stalls", 0) >= 1
+
+    # -- the incident bundle has the evidence --
+    assert wait_for(
+        lambda: incident.RECORDER.list_incidents() != [], timeout=5
+    )
+    bundles = incident.RECORDER.list_incidents()
+    bundle = incident.RECORDER.get(bundles[-1]["id"])
+    assert bundle["trigger"] == "watchdog"
+    assert bundle["job_id"] == "wedged-1"
+    # thread stacks: the wedged job worker is visible mid-read
+    stacks = {t["name"]: t["stack"] for t in bundle["threads"]}
+    assert any("job-worker" in n for n in stacks)
+    # the job's span tree, in flight, with the fetch span open
+    assert bundle["trace"]["job_id"] == "wedged-1"
+    span_names = [
+        c["name"] for c in bundle["trace"]["spans"]["children"]
+    ]
+    assert "fetch" in span_names
+    # the log-ring tail carries the pre-wedge breadcrumb
+    assert any(
+        r["msg"] == "pre-wedge breadcrumb" for r in bundle["log_tail"]
+    )
+    # watchdog snapshot inside the bundle shows the stalled task
+    assert any(
+        t["name"] == "wedged-1" and t["stalled"]
+        for t in bundle["watchdog"]["tasks"]
+    )
+    # subsystem probes rode along (names may carry -N suffixes when
+    # earlier suites' clients are still alive)
+    assert any(k.startswith("queue-client") for k in bundle["probes"])
+    assert any(
+        k.startswith("streaming-pipeline") for k in bundle["probes"]
+    )
+    # and it persisted to INCIDENT_DIR
+    assert bundle["persisted"] and os.path.exists(bundle["persisted"])
+
+    # -- /debug/incidents serves the bundle --
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{h.health_port}/debug/incidents", timeout=5
+    ) as response:
+        listing = json.loads(response.read())
+    served_ids = [e["id"] for e in listing["incidents"]]
+    assert bundle["id"] in served_ids
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{h.health_port}/debug/incidents/{bundle['id']}",
+        timeout=5,
+    ) as response:
+        served = json.loads(response.read())
+    assert served["job_id"] == "wedged-1"
+    assert served["threads"]
+
+    # -- cancel releases the job; retry wedges again, then drops --
+    # attempt 1: watchdog-cancelled -> retried; attempt 2: retries
+    # exhausted -> failed. Either way the job is RELEASED, the worker
+    # returns to dequeue, and no multipart upload is left behind.
+    assert wait_for(lambda: h.daemon.stats.retried >= 1, timeout=15)
+    assert wait_for(lambda: h.daemon.stats.failed >= 1, timeout=30)
+    assert metrics.GLOBAL.snapshot().get("watchdog_cancels", 0) >= 1
+    assert wait_for(
+        lambda: h.stub.list_multipart_uploads() == [], timeout=10
+    ), "dangling multipart upload after watchdog cancel"
+
+
+def test_e2e_on_demand_incident_capture(wedged_harness, wedge_server):
+    """POST /debug/incident captures a bundle without any stall."""
+    h = wedged_harness
+    request = urllib.request.Request(
+        f"http://127.0.0.1:{h.health_port}/debug/incident", method="POST"
+    )
+    with urllib.request.urlopen(request, timeout=5) as response:
+        payload = json.loads(response.read())
+    assert payload["id"].startswith("incident-")
+    bundle = incident.RECORDER.get(payload["id"])
+    assert bundle["trigger"] == "manual"
+    assert bundle["threads"]
+
+
+# ---------------------------------------------------------------------------
+# per-job token hygiene
+
+
+def test_detached_child_token_does_not_accumulate_on_parent():
+    """Per-job child tokens must detach when their job settles: the
+    daemon-lifetime parent would otherwise grow one dead child per
+    processed job (and a later shutdown cancel would walk millions of
+    corpses)."""
+    parent = CancelToken()
+    for _ in range(100):
+        child = parent.child()
+        child.detach()
+    assert parent._children == []
+    # a detached token is still directly cancellable
+    child = parent.child()
+    child.detach()
+    child.cancel()
+    assert child.cancelled()
+    assert not parent.cancelled()
+    # detach is idempotent and safe after parent cancellation
+    other = parent.child()
+    parent.cancel()
+    other.detach()
+    assert other.cancelled()  # heard the cancel before detaching
