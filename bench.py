@@ -36,7 +36,9 @@ pair, default 4), BENCH_REPEATS (pairs, default 5), BENCH_DIR (default
 BENCH_ABLATION_REPEATS (interleaved triples, default 3), BENCH_PIPELINE=0
 to skip the streaming-pipeline ablation, BENCH_PIPELINE_REPEATS
 (interleaved pipelined/store-and-forward pairs, default 3),
-BENCH_WATCHDOG=0 to skip the stall-watchdog heartbeat ablation.
+BENCH_WATCHDOG=0 to skip the stall-watchdog heartbeat ablation,
+BENCH_SMALL=0 to skip the small-object batched/unbatched arm
+(BENCH_SMALL_WAVE jobs per wave, BENCH_SMALL_WAVES rounds).
 
 On the measurement noise: this box's absolute throughput swings ~3x on
 multi-second timescales (the same configuration has measured 85 and 580
@@ -244,6 +246,8 @@ class _Pipeline:
         server: tuple[str, tuple[str, ...]] | None = None,
         http_segments: int | None = None,
         segment_min_bytes: int | None = None,
+        batch_jobs: int | None = None,
+        batch_wait_ms: float | None = None,
     ):
         self.token = CancelToken()
         self.payload = payload
@@ -266,6 +270,10 @@ class _Pipeline:
                 prefetch=prefetch,
                 publish_confirm_timeout=60.0,
             )
+            if batch_jobs is not None:
+                self.config.batch_jobs = batch_jobs
+            if batch_wait_ms is not None:
+                self.config.batch_wait_ms = batch_wait_ms
             connect = build_connection_factory(self.config)
             self.client = QueueClient(self.token, connect, drain_timeout=10.0)
             self.client.set_prefetch(self.config.prefetch)
@@ -723,6 +731,91 @@ def run_latency(
         pipeline.close()
 
 
+def run_small_object_arm(
+    site: str, wave: int = 16, waves: int = 3
+) -> dict:
+    """Small-object per-job overhead: p50/p99 per object size (1 KB /
+    64 KB / 1 MB), batched fast path vs unbatched ablation, against the
+    HEAD-capable Range server with no throttle (the probe cache and the
+    pooled single-connection GET need a server that answers HEAD — the
+    plain payload server doesn't).
+
+    Unbatched jobs run one at a time, so each lap is a true per-job
+    latency. Batched jobs are published a wave at a time and the wall
+    clock is amortized over the wave (the per-job cost OF the batch),
+    one sample per wave. Interleaved unbatched/batched rounds per size,
+    percentiles over the samples — the standard noise defense."""
+    sizes = (("1k", 1024), ("64k", 64 * 1024), ("1m", 1024 * 1024))
+    server = (_RANGE_SERVER, ("0",))
+    for label, size in sizes:
+        path = os.path.join(site, f"so_{label}.mkv")
+        if not os.path.exists(path):
+            with open(path, "wb") as sink:
+                sink.write(os.urandom(size))
+
+    def pct(values: list[float], q: float) -> float:
+        ordered = sorted(values)
+        return round(ordered[min(len(ordered) - 1, int(len(ordered) * q))], 2)
+
+    out_sizes: dict = {}
+    for label, size in sizes:
+        laps: dict[str, list[float]] = {"unbatched": [], "batched": []}
+        for _ in range(waves):
+            pipeline = _Pipeline(
+                1, wave * 2, site, payload=f"so_{label}.mkv",
+                server=server, batch_jobs=1,
+            )
+            try:
+                for i in range(wave):
+                    start = time.monotonic()
+                    pipeline.publish_job(i)
+                    pipeline.wait_converts(i + 1, timeout=60.0)
+                    laps["unbatched"].append(
+                        (time.monotonic() - start) * 1e3
+                    )
+            finally:
+                pipeline.close()
+            pipeline = _Pipeline(
+                1, wave * 2, site, payload=f"so_{label}.mkv",
+                server=server, batch_jobs=wave,
+            )
+            try:
+                start = time.monotonic()
+                for i in range(wave):
+                    pipeline.publish_job(i)
+                pipeline.wait_converts(wave, timeout=120.0)
+                laps["batched"].append(
+                    (time.monotonic() - start) * 1e3 / wave
+                )
+            finally:
+                pipeline.close()
+        entry = {
+            "unbatched_p50_ms": pct(laps["unbatched"], 0.5),
+            "unbatched_p99_ms": pct(laps["unbatched"], 0.99),
+            "batched_p50_ms": pct(laps["batched"], 0.5),
+            "batched_p99_ms": pct(laps["batched"], 0.99),
+        }
+        entry["batched_vs_unbatched"] = round(
+            entry["unbatched_p50_ms"] / max(entry["batched_p50_ms"], 1e-9), 2
+        )
+        out_sizes[label] = entry
+        _log(
+            f"bench: small-object {label}: unbatched p50 "
+            f"{entry['unbatched_p50_ms']:.2f} ms / p99 "
+            f"{entry['unbatched_p99_ms']:.2f} ms, batched p50 "
+            f"{entry['batched_p50_ms']:.2f} ms / p99 "
+            f"{entry['batched_p99_ms']:.2f} ms "
+            f"({entry['batched_vs_unbatched']:.2f}x)"
+        )
+    return {
+        "metric": "small_object_overhead",
+        "unit": "ms",
+        "wave": wave,
+        "waves": waves,
+        "sizes": out_sizes,
+    }
+
+
 def run_watchdog_ablation(
     site: str, samples: int, concurrency: int, repeats: int = 3
 ) -> dict:
@@ -953,6 +1046,19 @@ def main() -> None:
             f"stage medians {json.dumps(stage_attribution)}"
         )
 
+        small_object = None
+        if os.environ.get("BENCH_SMALL", "1") != "0":
+            small_wave = max(2, int(os.environ.get("BENCH_SMALL_WAVE", 16)))
+            small_waves = max(1, int(os.environ.get("BENCH_SMALL_WAVES", 3)))
+            _log(
+                f"bench: small-object arm, {small_waves} interleaved "
+                f"unbatched/batched waves of {small_wave} jobs at "
+                "1 KB / 64 KB / 1 MB"
+            )
+            small_object = run_small_object_arm(
+                site, wave=small_wave, waves=small_waves
+            )
+
         watchdog_ablation = None
         if os.environ.get("BENCH_WATCHDOG", "1") != "0":
             _log(
@@ -998,6 +1104,8 @@ def main() -> None:
             extra_metrics.append(pipeline_ablation)
         if segmented_ablation is not None:
             extra_metrics.append(segmented_ablation)
+        if small_object is not None:
+            extra_metrics.append(small_object)
         if watchdog_ablation is not None:
             extra_metrics.append(watchdog_ablation)
         if os.environ.get("BENCH_DIGEST", "1") != "0":

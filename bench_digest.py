@@ -55,6 +55,16 @@ def digest_line(report: dict) -> dict:
                 arm = rounds[-1]["arms"].get("segmented_large", {})
                 out["segmented_overlap_ratio"] = arm.get("overlap_ratio")
                 out["segmented_pool_reuse_hits"] = arm.get("pool_reuse_hits")
+        elif metric == "small_object_overhead":
+            sizes = extra.get("sizes") or {}
+            for label in ("1k", "64k", "1m"):
+                entry = sizes.get(label)
+                if not entry:
+                    continue
+                out[f"small_{label}_batched_p50_ms"] = entry.get(
+                    "batched_p50_ms"
+                )
+                out[f"small_{label}_x"] = entry.get("batched_vs_unbatched")
         elif metric == "digest_kernel":
             out["hashlib_GBps"] = extra.get("hashlib_GBps")
             out["pallas_GBps"] = extra.get("pallas_GBps")

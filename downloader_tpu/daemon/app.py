@@ -33,7 +33,7 @@ from dataclasses import dataclass, field
 from ..fetch import DispatchClient, TransferError, UnsupportedJobError
 from ..fetch import progress as transfer_progress
 from ..queue import QueueClient
-from ..queue.delivery import Delivery
+from ..queue.delivery import Delivery, ack_batch
 from ..scan import scan_dir
 from ..store import Uploader, UploadError
 from ..utils import metrics, configure_from_env, get_logger, tracing
@@ -57,6 +57,44 @@ class DaemonStats:
         with self.lock:
             for name, delta in deltas.items():
                 setattr(self, name, getattr(self, name) + delta)
+
+
+@dataclass
+class _FastJob:
+    """One batched-lane job's open state between its pipeline phase and
+    the batch's coalesced settle (confirm flush + multiple-ack)."""
+
+    delivery: Delivery
+    media: object
+    trace: object  # tracing.OpenTrace
+    watch: object
+    token: CancelToken
+    job_log: object
+    started: float
+    publish_span: object
+    pending: object  # queue client publish handle
+
+
+# _run_fast_job outcome: the fast path declined late (stale probe,
+# redirect, object grew) — the caller reruns the job through the full
+# pipeline, which owns every such case
+_FALLBACK = object()
+
+
+class _AnyCancelled:
+    """Cancel view over a batch's job tokens for the coalesced confirm
+    flush: a watchdog releasing ANY job wedged at its publish stage
+    stops the shared wait (confirmed batch-mates still ack; unconfirmed
+    ones requeue) — the batched analogue of the unbatched path passing
+    ``cancel=job_token`` to ``publish(wait=...)``."""
+
+    __slots__ = ("_tokens",)
+
+    def __init__(self, tokens):
+        self._tokens = tokens
+
+    def cancelled(self) -> bool:
+        return any(token.cancelled() for token in self._tokens)
 
 
 class Daemon:
@@ -277,6 +315,319 @@ class Daemon:
             self.stats.bump(failed=1)
             trace.set_status("failed")
 
+    # -- batched small-object fast path -----------------------------------
+
+    def _settle_crashed(self, delivery: Delivery, exc: Exception) -> None:
+        """The never-kill-the-worker backstop: settle a delivery whose
+        processing raised outside the caught exceptions, capped like
+        the normal failure path — a poison message that crashes would
+        otherwise retry forever."""
+        log.error("unexpected error processing job", exc=exc)
+        if delivery.settled:
+            return
+        if delivery.retries < self._config.max_job_retries:
+            delivery.error()
+            self.stats.bump(retried=1)
+        else:
+            delivery.nack()
+            self.stats.bump(failed=1)
+
+    def _process_safely(self, delivery: Delivery) -> None:
+        try:
+            self.process_delivery(delivery)
+        except Exception as exc:  # never kill the worker thread
+            self._settle_crashed(delivery, exc)
+
+    def _collect_batch(
+        self, first: Delivery, deliveries: "queue_mod.Queue[Delivery]"
+    ) -> "list[Delivery]":
+        """One dequeue wave: greedily drain deliveries ALREADY waiting
+        behind ``first`` (up to BATCH_JOBS); once at least one more was
+        waiting — a burst is in progress — linger up to BATCH_WAIT_MS
+        for the rest of it. A lone job never waits, so unbatched
+        latency is untouched."""
+        limit = self._config.batch_jobs
+        batch = [first]
+        if limit <= 1:
+            return batch
+        while len(batch) < limit:
+            try:
+                batch.append(deliveries.get_nowait())
+            except queue_mod.Empty:
+                break
+        if len(batch) == 1 or len(batch) >= limit:
+            return batch
+        deadline = time.monotonic() + self._config.batch_wait_ms / 1000.0
+        while len(batch) < limit and not self._token.cancelled():
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                batch.append(deliveries.get(timeout=remaining))
+            except queue_mod.Empty:
+                break
+        return batch
+
+    def _peek_media(self, delivery: Delivery):
+        """Classification-only decode (is this a small HTTP job?). The
+        slow lane re-decodes under its trace so the malformed-message
+        handling stays in exactly one place; the ~30 µs duplicate is
+        noise against the round trips batching removes."""
+        try:
+            job = Download.unmarshal(delivery.body)
+        except WireError:
+            return None
+        media = job.media
+        if media is None or not media.id or not media.source_uri:
+            return None
+        return media
+
+    # the fast lane defers every ack to the batch settle, so the wave's
+    # cumulative bytes bound how long deliveries stay unacked (and how
+    # much disk one settle window can touch): a wave admits fast-lane
+    # jobs up to this many ceiling-sized objects' worth of bytes —
+    # many tiny jobs still fill the whole wave, a run of near-ceiling
+    # ones overflows to the normal per-job path
+    WAVE_BYTE_BUDGET_FACTOR = 4
+
+    def process_batch(self, batch: "list[Delivery]") -> None:
+        """Process one dequeue wave. Singleton waves take the unbatched
+        path bit-for-bit. Larger waves are classified by (cached-)
+        probed object size: jobs at most BATCH_MAX_BYTES — bounded by
+        the wave byte budget (``WAVE_BYTE_BUDGET_FACTOR × BATCH_MAX_
+        BYTES`` cumulative) — run the batched fast lane; everything
+        else (large, unknown size, retry pacing, non-HTTP, malformed)
+        runs the normal per-job pipeline, untouched. Every delivery is
+        settled by exactly one lane."""
+        if len(batch) == 1:
+            self._process_safely(batch[0])
+            return
+        fast: "list[tuple[Delivery, object]]" = []
+        slow: "list[Delivery]" = []
+        budget = self._config.batch_max_bytes * self.WAVE_BYTE_BUDGET_FACTOR
+        admitted = 0
+        for delivery in batch:
+            media = self._peek_media(delivery)
+            if media is None or delivery.retries > 0:
+                slow.append(delivery)
+                continue
+            try:
+                # the daemon token (no per-job token exists yet): a
+                # shutdown mid-classification aborts the probe promptly
+                size = self._dispatcher.probe_size(
+                    media.source_uri, token=self._token
+                )
+            except Exception as exc:
+                # classification must never decide a job's fate: an
+                # unprobeable URL just takes the normal path
+                log.debug(f"batch size probe failed for {media.id}: {exc}")
+                size = None
+            if (
+                size is None
+                or size > self._config.batch_max_bytes
+                or admitted + size > budget
+            ):
+                slow.append(delivery)
+                continue
+            admitted += size
+            fast.append((delivery, media))
+        if len(fast) < 2:
+            # nothing to amortize: the whole wave runs unbatched
+            for delivery in batch:
+                self._process_safely(delivery)
+            return
+        metrics.GLOBAL.observe(
+            "batch_jobs_per_wave", len(fast), buckets=metrics.COUNT_BUCKETS
+        )
+        self._process_fast_batch(fast)
+        for delivery in slow:
+            self._process_safely(delivery)
+
+    def _process_fast_batch(
+        self, jobs: "list[tuple[Delivery, object]]"
+    ) -> None:
+        """The batched lane. Per-job traces, watches, and child cancel
+        tokens keep observability and cancel isolation identical to
+        the unbatched path; what amortizes is the traffic — one store
+        connection scope for all the PUTs, ONE publish-confirm wait
+        covering the batch's Convert hand-offs, and a multiple-ack
+        settle. A mid-batch failure settles only its own delivery."""
+        ready: "list[_FastJob]" = []
+        with self._uploader.batch_scope():
+            for delivery, media in jobs:
+                if self._token.cancelled():
+                    delivery.nack(requeue=True)  # shutting down
+                    continue
+                try:
+                    outcome = self._run_fast_job(delivery, media)
+                except Exception as exc:  # never kill the batch
+                    self._settle_crashed(delivery, exc)
+                    continue
+                if outcome is _FALLBACK:
+                    self._process_safely(delivery)
+                elif outcome is not None:
+                    ready.append(outcome)
+                # jobs already parked at their publish stage see the
+                # batch advancing — the wave moving IS their forward
+                # progress, so a long tail of batch-mates doesn't read
+                # as a publish stall (slow != stalled)
+                for state in ready:
+                    state.watch.beat()
+        if not ready:
+            return
+        # ONE confirm wait covers every Convert hand-off in the batch;
+        # unconfirmed jobs requeue individually — never ack a download
+        # whose pipeline hand-off is not durably on the broker
+        confirmed = self._client.flush(
+            [state.pending for state in ready],
+            self._config.publish_confirm_timeout,
+            cancel=_AnyCancelled([state.token for state in ready]),
+        )
+        acks: "list[_FastJob]" = []
+        for state, flushed in zip(ready, confirmed):
+            state.publish_span.finish()
+            if flushed:
+                acks.append(state)
+                continue
+            state.job_log.error("convert publish unconfirmed; requeueing job")
+            state.delivery.nack(requeue=True)
+            self.stats.bump(retried=1)
+            state.trace.root.set_status("requeued")
+            self._finish_fast_job(state)
+        if not acks:
+            return
+        for state in acks:
+            state.watch.stage("ack")
+        ack_started = time.monotonic()
+        ack_batch([state.delivery for state in acks])
+        ack_ended = time.monotonic()
+        metrics.GLOBAL.add("batch_fast_jobs", len(acks))
+        for state in acks:
+            # the coalesced settle is shared wall time; each trace
+            # records the interval so /debug/jobs still shows it
+            state.trace.root.record("ack", ack_started, ack_ended)
+            state.job_log.info("finished processing")
+            state.trace.root.set_status("ok")
+            self._finish_fast_job(state)
+            self.stats.bump(processed=1)
+            metrics.GLOBAL.observe(
+                "job_duration_seconds", time.monotonic() - state.started
+            )
+
+    def _finish_fast_job(self, state: "_FastJob") -> None:
+        state.trace.complete()
+        watchdog.MONITOR.unregister(state.watch)
+        # drop the job token from the daemon token's fan-out list, or
+        # the parent accumulates one dead child per job forever
+        state.token.detach()
+
+    def _run_fast_job(self, delivery: Delivery, media):
+        """One fast-lane job through fetch→scan→upload plus the ASYNC
+        Convert enqueue. Returns the open ``_FastJob`` for the batch
+        settle, ``_FALLBACK`` when the fast path declined late, or None
+        when the job was settled here — the failure paths mirror
+        ``_process_watched``'s semantics exactly."""
+        started = time.monotonic()
+        trace = tracing.TRACER.open_job(media.id)
+        job_token = self._token.child()
+        watch = watchdog.MONITOR.job(media.id, cancel=job_token.cancel)
+        job_log = log.with_fields(id=media.id, url=media.source_uri)
+        keep = False
+        try:
+            with trace.activate():
+                root = trace.root
+                root.annotate(
+                    job_id=media.id,
+                    url=tracing.redact_url(media.source_uri),
+                    batched=True,
+                )
+                root.record(
+                    "dequeue", delivery.received_at, started,
+                    queue=delivery.queue_name,
+                )
+                job_log.info("got message")
+                try:
+                    with watchdog.install(watch):
+                        watch.stage("fetch")
+                        with tracing.span(
+                            "fetch",
+                            url=tracing.redact_url(media.source_uri),
+                            fast_path=True,
+                        ):
+                            job_dir = self._dispatcher.fast_fetch(
+                                media.id,
+                                media.source_uri,
+                                self._config.batch_max_bytes,
+                                token=job_token,
+                            )
+                        if job_dir is not None:
+                            watch.stage("scan")
+                            with tracing.span("scan"):
+                                files = scan_dir(job_dir)
+                            job_log.with_field("count", len(files)).info(
+                                "found media files"
+                            )
+                            watch.stage("upload")
+                            with tracing.span("upload", files=len(files)):
+                                # small objects are single PUTs on the
+                                # batch's scoped store connection; no
+                                # streaming session exists to close
+                                self._uploader.upload_files(
+                                    job_token, media.id, files
+                                )
+                except (TransferError, UploadError, OSError) as exc:
+                    self._settle_transient(delivery, job_log, root, exc)
+                    return None
+                except Cancelled:
+                    if not self._token.cancelled():
+                        # watchdog released THIS job; its batch-mates
+                        # are untouched (their own tokens, own settles)
+                        self._settle_transient(
+                            delivery, job_log, root,
+                            Cancelled("watchdog cancelled stalled job"),
+                        )
+                        return None
+                    delivery.nack(requeue=True)
+                    root.set_status("requeued")
+                    return None
+                if job_dir is None:
+                    root.set_status("fallback")
+                    return _FALLBACK
+                log.info("creating v1.convert message")
+                watch.stage("publish")
+                convert = Convert(
+                    created_at=time.strftime("%Y-%m-%d %H:%M:%S %z"),
+                    media=media,
+                )
+                # opened now, finished after the batch flush: the span
+                # covers enqueue→confirmed, same interval the unbatched
+                # publish span measures
+                publish_span = root.child("publish", coalesced=True)
+                pending = self._client.publish_async(
+                    self._config.publish_topic, convert.marshal()
+                )
+                keep = True
+                return _FastJob(
+                    delivery=delivery,
+                    media=media,
+                    trace=trace,
+                    watch=watch,
+                    token=job_token,
+                    job_log=job_log,
+                    started=started,
+                    publish_span=publish_span,
+                    pending=pending,
+                )
+        except BaseException:
+            if trace.status == "in-flight":
+                trace.root.set_status("error")
+            raise
+        finally:
+            if not keep:
+                trace.complete()
+                watchdog.MONITOR.unregister(watch)
+                job_token.detach()
+
     # -- worker loop -----------------------------------------------------
 
     def _worker(self, deliveries: "queue_mod.Queue[Delivery]") -> None:
@@ -294,20 +645,13 @@ class Daemon:
                 except queue_mod.Empty:
                     continue
                 with watch.suspend():
+                    batch = self._collect_batch(delivery, deliveries)
                     try:
-                        self.process_delivery(delivery)
+                        self.process_batch(batch)
                     except Exception as exc:  # never kill the worker thread
-                        log.error("unexpected error processing job", exc=exc)
-                        if not delivery.settled:
-                            # cap like the normal failure path, or a poison
-                            # message that crashes outside the caught
-                            # exceptions would retry forever
-                            if delivery.retries < self._config.max_job_retries:
-                                delivery.error()
-                                self.stats.bump(retried=1)
-                            else:
-                                delivery.nack()
-                                self.stats.bump(failed=1)
+                        for stranded in batch:
+                            if not stranded.settled:
+                                self._settle_crashed(stranded, exc)
         finally:
             watchdog.MONITOR.unregister(watch)
 
@@ -436,7 +780,17 @@ def serve(
         build_connection_factory(config),
         publish_confirm_timeout=config.publish_confirm_timeout,
     )
-    client.set_prefetch(config.prefetch)
+    prefetch = config.prefetch
+    if config.batch_jobs > 1 and prefetch < config.batch_jobs:
+        # a dequeue wave can never exceed the consumer's unacked
+        # window: with the reference-default prefetch of 1 the batched
+        # fast path would silently never engage. Give it headroom;
+        # operators who want a strict window set BATCH_JOBS=1.
+        prefetch = config.batch_jobs
+        log.with_fields(
+            prefetch=prefetch, batch_jobs=config.batch_jobs
+        ).info("raising prefetch to the batch size for the fast path")
+    client.set_prefetch(prefetch)
     log.info("connected")
 
     from ..cli import _default_backends

@@ -55,6 +55,16 @@ class Config:
     http_segments: int = 8
     http_pool_per_host: int = 6
     http_pool_idle: float = 30.0
+    # batched small-object fast path (daemon/app.py): one dequeue wave
+    # drains up to batch_jobs already-waiting deliveries (lingering at
+    # most batch_wait_ms once a burst is in progress — a lone job never
+    # waits); jobs whose probed size is at most batch_max_bytes run the
+    # batched lane (pooled single-connection fetch, per-batch store
+    # connection, one coalesced confirm wait, multiple-ack settle).
+    # batch_jobs <= 1 disables batching entirely.
+    batch_jobs: int = 16
+    batch_wait_ms: float = 20.0
+    batch_max_bytes: int = 4 * 1024 * 1024
     # stall watchdog + incident flight recorder (utils/watchdog.py,
     # utils/incident.py): no-forward-progress deadline (0 disables),
     # per-stage overrides, what to do about a stall, and where bundles
@@ -95,6 +105,13 @@ class Config:
         )
         config.health_port = int(env.get("HEALTH_PORT", config.health_port))
         config.health_host = env.get("HEALTH_HOST", config.health_host)
+        config.batch_jobs = int(env.get("BATCH_JOBS", config.batch_jobs))
+        config.batch_wait_ms = float(
+            env.get("BATCH_WAIT_MS", config.batch_wait_ms)
+        )
+        config.batch_max_bytes = int(
+            env.get("BATCH_MAX_BYTES", config.batch_max_bytes)
+        )
         from ..utils import flag_from_env
         from ..utils.tracing import ring_from_value
 

@@ -160,6 +160,56 @@ class DispatchClient:
             f"unsupported fileext '{ext}' or protocol '{parsed.scheme}'"
         )
 
+    def probe_size(
+        self, url: str, token: CancelToken | None = None
+    ) -> int | None:
+        """Object size when the routed backend can answer cheaply (a
+        cached HEAD), else None. Never raises for unroutable URLs —
+        None just keeps the job on the normal path, where routing
+        errors surface with their proper handling."""
+        try:
+            backend = self._select_backend(url)
+        except UnsupportedJobError:
+            return None
+        probe_size = getattr(backend, "probe_size", None)
+        if probe_size is None:
+            return None
+        return probe_size(url, token)
+
+    def fast_fetch(
+        self,
+        media_id: str,
+        url: str,
+        max_bytes: int,
+        token: CancelToken | None = None,
+    ) -> str | None:
+        """Small-object fast path: fetch ``url`` into the job dir over
+        the backend's pooled connection, skipping striping/multipart.
+        Returns the job dir on success, None when the fast path cannot
+        own this job (caller falls back to ``download``). Transfer
+        errors propagate exactly like ``download``'s."""
+        try:
+            backend = self._select_backend(url)
+        except UnsupportedJobError:
+            return None
+        fetch_small = getattr(backend, "fetch_small", None)
+        if fetch_small is None:
+            return None
+
+        job_dir = os.path.join(self._base_dir, media_id)
+        os.makedirs(job_dir, exist_ok=True)
+        try:
+            with tracing.span(
+                "backend", backend=backend.register().name, fast_path=True
+            ):
+                done = fetch_small(
+                    token or self._token, job_dir, self._progress.update,
+                    url, max_bytes,
+                )
+        finally:
+            self._progress.update(url, 100.0)
+        return job_dir if done else None
+
     def download(
         self, media_id: str, url: str, token: CancelToken | None = None
     ) -> str:

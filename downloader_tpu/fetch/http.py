@@ -243,12 +243,15 @@ class HTTPBackend:
         # this backend's lifetime. A custom opener opts out: segments
         # speak http.client directly and would bypass whatever the
         # opener was installed to do (auth handlers, test fakes).
+        # The fetcher is kept even with striping off (HTTP_SEGMENTS=1):
+        # the small-object fast path and its probe cache ride the same
+        # pool and work regardless of the stripe width.
         self._segmenter = None
         if opener is None:
             from .connpool import ConnectionPool
             from .segments import SegmentedFetcher
 
-            fetcher = SegmentedFetcher(
+            self._segmenter = SegmentedFetcher(
                 pool=ConnectionPool(
                     per_host=pool_per_host,
                     idle_ttl=pool_idle,
@@ -260,10 +263,6 @@ class HTTPBackend:
                 max_attempts=max_resume_attempts,
                 progress_interval=progress_interval,
             )
-            if fetcher.enabled:
-                self._segmenter = fetcher
-            else:
-                fetcher.close()
 
     def register(self) -> BackendRegistration:
         # reference registers protocols only, no extensions (http.go:25-34)
@@ -273,6 +272,31 @@ class HTTPBackend:
         """Release pooled keep-alive connections (daemon shutdown)."""
         if self._segmenter is not None:
             self._segmenter.close()
+
+    # -- small-object fast path -------------------------------------------
+
+    def probe_size(self, url: str, token: CancelToken | None = None) -> int | None:
+        """Object size when a (cached) HEAD can say, else None — how
+        the daemon's batch classifier sorts jobs into the fast lane."""
+        if self._segmenter is None:
+            return None
+        return self._segmenter.probe_size(url, token)
+
+    def fetch_small(
+        self,
+        token: CancelToken,
+        base_dir: str,
+        progress: ProgressFn,
+        url: str,
+        max_bytes: int,
+    ) -> bool:
+        """Fetch a small object over one pooled keep-alive connection
+        (fetch/segments.py fetch_small). False → run ``download``."""
+        if self._segmenter is None:
+            return False
+        return self._segmenter.fetch_small(
+            token, base_dir, progress, url, max_bytes
+        )
 
     # -- download --------------------------------------------------------
 
@@ -290,7 +314,7 @@ class HTTPBackend:
     def download(
         self, token: CancelToken, base_dir: str, progress: ProgressFn, url: str
     ) -> None:
-        if self._segmenter is not None:
+        if self._segmenter is not None and self._segmenter.enabled:
             # the segmented path handles everything when the probe says
             # the server supports ranges and the object is big enough;
             # False means "run the single-stream path" — either the

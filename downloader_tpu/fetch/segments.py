@@ -72,6 +72,13 @@ _CHUNK = 256 * 1024
 # means one transfer runs unsegmented, never a wrong byte.
 DECLINE_TTL = 60.0
 _DECLINE_CACHE_MAX = 256
+# probe results (size, validator, Accept-Ranges) are remembered for
+# this long so the small-object fast path classifies batch jobs
+# WITHOUT a per-job HEAD. A stale entry is gate-only: the actual GET's
+# headers are re-validated, so the worst case is one fast-path attempt
+# falling back — never a wrong byte.
+PROBE_TTL = 60.0
+_PROBE_CACHE_MAX = 256
 
 _CONTENT_RANGE = re.compile(r"bytes (\d+)-(\d+)/(\d+)$")
 
@@ -268,10 +275,10 @@ class SpanJournal:
 
 class _Probe:
     __slots__ = ("scheme", "host", "port", "request_path", "total",
-                 "content_disposition", "validator")
+                 "content_disposition", "validator", "accept_ranges")
 
     def __init__(self, scheme, host, port, request_path, total, cd,
-                 validator=""):
+                 validator="", accept_ranges=True):
         self.scheme = scheme
         self.host = host
         self.port = port
@@ -282,6 +289,9 @@ class _Probe:
         # to THIS version of the object and rides If-Range on segment
         # GETs (a weak ETag can do the former but not the latter)
         self.validator = validator
+        # segmentation needs ranges; the small-object fast path does
+        # not — it issues one whole-object GET either way
+        self.accept_ranges = accept_ranges
 
     @property
     def strong_validator(self) -> str:
@@ -511,6 +521,13 @@ class SegmentedFetcher:
         self._progress_interval = progress_interval
         self._declined: dict[str, float] = {}  # url -> expiry; guarded-by: _declined_lock
         self._declined_lock = threading.Lock()
+        # url -> (probe | None, expiry): every HEAD verdict — usable or
+        # not — is remembered so batch classification and the fast path
+        # pay at most one probe round trip per URL per PROBE_TTL.
+        # None records "HEAD answered but unusable" (redirect, no
+        # length); connection-level failures are NOT cached (transient).
+        self._probes: dict[str, tuple[_Probe | None, float]] = {}  # guarded-by: _probes_lock
+        self._probes_lock = threading.Lock()
 
     @property
     def enabled(self) -> bool:
@@ -539,6 +556,52 @@ class SegmentedFetcher:
                 self._declined = live
             self._declined[url] = now + DECLINE_TTL
 
+    # -- probe cache ------------------------------------------------------
+
+    _PROBE_MISS = object()  # "nothing cached" (None is a cached verdict)
+
+    def _remember_probe(self, url: str, probe: "_Probe | None") -> None:
+        now = time.monotonic()
+        with self._probes_lock:
+            if len(self._probes) >= _PROBE_CACHE_MAX:
+                live = {
+                    key: entry for key, entry in self._probes.items()
+                    if entry[1] > now
+                }
+                while len(live) >= _PROBE_CACHE_MAX:
+                    live.pop(min(live, key=lambda k: live[k][1]))
+                self._probes = live
+            self._probes[url] = (probe, now + PROBE_TTL)
+
+    def _forget_probe(self, url: str) -> None:
+        with self._probes_lock:
+            self._probes.pop(url, None)
+
+    def _cached_probe(self, url: str):
+        """The cached probe verdict: a ``_Probe``, None (probed and
+        unusable), or ``_PROBE_MISS`` (never probed / expired)."""
+        now = time.monotonic()
+        with self._probes_lock:
+            entry = self._probes.get(url)
+            if entry is None:
+                return self._PROBE_MISS
+            probe, expires = entry
+            if expires <= now:
+                del self._probes[url]
+                return self._PROBE_MISS
+        metrics.GLOBAL.add("http_probe_cache_hits")
+        return probe
+
+    def probe_size(self, url: str, token: CancelToken | None = None) -> int | None:
+        """Object size in bytes when a (possibly cached) HEAD can say,
+        else None — the batch classifier's one question. Warm cache
+        answers without any network round trip."""
+        cached = self._cached_probe(url)
+        if cached is not self._PROBE_MISS:
+            return None if cached is None else cached.total
+        probe = self.probe(url, token)
+        return None if probe is None else probe.total
+
     def close(self) -> None:
         self.pool.close()
 
@@ -547,8 +610,12 @@ class SegmentedFetcher:
     def probe(
         self, url: str, token: CancelToken | None = None
     ) -> _Probe | None:
-        """One HEAD through the pool; None means 'not segmentable' for
-        any reason — the caller falls back with no side effects."""
+        """One HEAD through the pool; None means the HEAD was unusable
+        (non-http scheme, userinfo, proxy env, redirect, no
+        Content-Length) — the caller falls back with no side effects.
+        A returned probe may still decline STRIPING (``accept_ranges``
+        False); the small-object fast path doesn't care. Every verdict
+        that cost a round trip lands in the probe cache."""
         parsed = urllib.parse.urlsplit(url)
         if parsed.scheme not in ("http", "https"):
             return None
@@ -608,15 +675,13 @@ class SegmentedFetcher:
                 remove_cancel_hook()
         self.pool.release(pooled, reusable=not response.will_close)
         if response.status != 200:
+            self._remember_probe(url, None)
             return None  # redirects/405/errors: urllib handles those
-        if "bytes" not in (
-            response.getheader("Accept-Ranges") or ""
-        ).lower():
-            return None
         length = response.getheader("Content-Length") or ""
         if not length.isdigit() or int(length) <= 0:
+            self._remember_probe(url, None)
             return None
-        return _Probe(
+        probe = _Probe(
             parsed.scheme, host, port, request_path, int(length),
             response.getheader("Content-Disposition"),
             validator=(
@@ -624,7 +689,12 @@ class SegmentedFetcher:
                 or response.getheader("Last-Modified")
                 or ""
             ).strip(),
+            accept_ranges="bytes" in (
+                response.getheader("Accept-Ranges") or ""
+            ).lower(),
         )
+        self._remember_probe(url, probe)
+        return probe
 
     # -- the transfer ------------------------------------------------------
 
@@ -637,7 +707,7 @@ class SegmentedFetcher:
         if not self.enabled or self._declined_recently(url):
             return False
         probe = self.probe(url, token)
-        if probe is None:
+        if probe is None or not probe.accept_ranges:
             # a probe killed by cancellation is not a verdict on the
             # server — caching it would single-stream the next 60 s
             token.raise_if_cancelled()
@@ -758,6 +828,182 @@ class SegmentedFetcher:
         metrics.GLOBAL.add("http_segmented_fetches")
         progress(url, 100.0)
         return True
+
+    # -- small-object fast path --------------------------------------------
+
+    def fetch_small(
+        self,
+        token: CancelToken,
+        base_dir: str,
+        progress,
+        url: str,
+        max_bytes: int,
+    ) -> bool:
+        """One whole-object GET over a pooled keep-alive connection for
+        objects at most ``max_bytes`` — the batched small-job data
+        path. No striping, no preallocation, no span journal, no
+        streaming sink (small objects are below the multipart floor, so
+        store-and-forward is the upload path either way): the fixed
+        cost left is ONE request on an (ideally reused) connection.
+
+        True: the file is complete at its final path. False: the fast
+        path can't own this URL (unknown size, too big, redirect, the
+        GET's headers disagree with the probe) — run the normal path,
+        which handles every such case already. Transfer-level failures
+        after eligibility raise TransferError like any backend."""
+        from .http import TransferError, filename_for
+
+        probe = self._cached_probe(url)
+        if probe is self._PROBE_MISS:
+            probe = self.probe(url, token)
+        if probe is None:
+            token.raise_if_cancelled()
+            return False
+        if probe.total > max_bytes:
+            return False
+
+        final_path = os.path.join(
+            base_dir, filename_for(url, probe.content_disposition)
+        )
+        part_path = final_path + ".part"
+        fetch_hb = watchdog.current().heartbeat("fetch")
+        attempts = 0
+        span = tracing.span("http-small", url=tracing.redact_url(url))
+        with span:
+            while True:
+                token.raise_if_cancelled()
+                pooled = self.pool.acquire(
+                    probe.scheme, probe.host, probe.port, self._timeout
+                )
+                reused = not pooled.fresh
+                conn = pooled.conn
+                remove_cancel_hook = token.add_callback(
+                    lambda: _abort_connection(conn)
+                )
+                try:
+                    try:
+                        pooled.conn.request(
+                            "GET", probe.request_path,
+                            headers={"Accept-Encoding": "identity"},
+                        )
+                        response = pooled.conn.getresponse()
+                    except (http.client.HTTPException, OSError) as exc:
+                        self.pool.release(pooled, reusable=False)
+                        token.raise_if_cancelled()
+                        if reused:
+                            # parked keep-alive the server closed while
+                            # idle: stale pool entry, retry free
+                            continue
+                        attempts += 1
+                        if attempts > self._max_attempts:
+                            raise TransferError(
+                                f"small-object request failed: {exc}"
+                            ) from exc
+                        time.sleep(min(0.2 * attempts, 1.0))
+                        continue
+                    try:
+                        got = self._consume_small(
+                            token, probe, url, response, part_path,
+                            max_bytes, fetch_hb,
+                        )
+                    except BaseException:
+                        # deterministic HTTP error or cancel: the
+                        # checked-out socket must not strand
+                        self.pool.release(pooled, reusable=False)
+                        raise
+                    if got is None:
+                        # headers disagree with the probe (redirect,
+                        # changed object, now-too-big): hand the job to
+                        # the normal path, which handles all of those
+                        self.pool.release(pooled, reusable=False)
+                        self._forget_probe(url)
+                        return False
+                    self.pool.release(
+                        pooled,
+                        reusable=getattr(response, "length", None) == 0
+                        and not response.will_close,
+                    )
+                    if got:
+                        span.annotate(bytes=got, reused=reused)
+                        break
+                    # short read: restart the tiny transfer from scratch
+                    attempts += 1
+                    if attempts > self._max_attempts:
+                        raise TransferError(
+                            f"small-object fetch stalled after "
+                            f"{attempts} attempts"
+                        )
+                    time.sleep(min(0.2 * attempts, 1.0))
+                finally:
+                    remove_cancel_hook()
+
+        os.replace(part_path, final_path)
+        try:
+            # a stale span journal from an earlier segmented attempt
+            # must not outlive the part file it described
+            os.unlink(part_path + ".spans")
+        except OSError:
+            pass
+        metrics.GLOBAL.add("http_bytes_fetched", got)
+        metrics.GLOBAL.add("http_files_fetched")
+        metrics.GLOBAL.add("http_small_fetches")
+        progress(url, 100.0)
+        return True
+
+    def _consume_small(
+        self,
+        token: CancelToken,
+        probe: _Probe,
+        url: str,
+        response: http.client.HTTPResponse,
+        part_path: str,
+        max_bytes: int,
+        fetch_hb,
+    ) -> int | None:
+        """Write one whole-object response to ``part_path``. Returns
+        the byte count on success, 0 on a short read (caller retries),
+        None when this response proves the fast path wrong for the URL
+        (caller falls back). Raises TransferError on deterministic
+        HTTP errors."""
+        from .http import TransferError
+
+        with response:
+            if response.status != 200:
+                if response.status >= 500 or response.status == 429:
+                    response.read()  # drain; transient, caller retries
+                    return 0
+                if response.status in (301, 302, 303, 307, 308):
+                    return None  # urllib's redirect handling owns these
+                raise TransferError(
+                    f"http status {response.status} for small-object GET"
+                )
+            length = response.getheader("Content-Length") or ""
+            if not length.isdigit():
+                return None  # chunked/unknown: the urllib path owns it
+            total = int(length)
+            if total != probe.total:
+                # object changed since the probe; still fine if small
+                if total > max_bytes or total <= 0:
+                    return None
+            wrote = 0
+            with open(part_path, "wb") as sink:
+                while wrote < total:
+                    if token.cancelled():
+                        raise Cancelled()
+                    try:
+                        chunk = response.read(min(_CHUNK, total - wrote))
+                    except (
+                        http.client.HTTPException, OSError, TimeoutError,
+                        ValueError,  # cancel hook closed the fd mid-read
+                    ):
+                        token.raise_if_cancelled()
+                        return 0  # retry from scratch
+                    if not chunk:
+                        return 0  # short read; retry from scratch
+                    sink.write(chunk)
+                    wrote += len(chunk)
+                    fetch_hb.beat(len(chunk))
+            return wrote
 
     # -- workers -----------------------------------------------------------
 

@@ -86,6 +86,12 @@ class AmqpChannel:
         self._confirm_lock = threading.Lock()
         self._confirms: dict[int, "_ConfirmSlot"] = {}
         self.confirm_timeout = 30.0
+        # consumer-side delivery tags not yet settled on this channel:
+        # what a coalesced multiple-ack consults so it never reaches
+        # past a delivery another worker still owns. Reader thread adds
+        # (deliveries), worker threads remove (ack/nack) — locked.
+        self._unacked_lock = threading.Lock()
+        self._unacked: set[int] = set()  # guarded-by: _unacked_lock
 
     # -- RPC plumbing ----------------------------------------------------
 
@@ -277,6 +283,90 @@ class AmqpChannel:
         if not slot.ok:
             raise AmqpError("publish was not confirmed (nacked or connection lost)")
 
+    def publish_many(
+        self, entries: list, persistent: bool = True
+    ) -> "list[Exception | None]":
+        """Publish a batch of (exchange, routing_key, body, headers)
+        with ONE confirm wait covering all of it: every body goes onto
+        the socket back-to-back under the write lock, then the caller
+        blocks once for the broker's acks (RabbitMQ typically answers
+        a burst with a single ``multiple=True`` basic.ack). Returns a
+        per-entry outcome (None = confirmed; an exception = that
+        publish failed), so one failure fails exactly the affected
+        publishes. Without confirm mode the sends alone are the
+        outcome, as with ``publish``."""
+        self._check()
+        outcomes: "list[Exception | None]" = [None] * len(entries)
+        if not self._confirm_mode:
+            for i, (exchange, routing_key, body, headers) in enumerate(entries):
+                try:
+                    self.publish(
+                        exchange, routing_key, body,
+                        headers=headers, persistent=persistent,
+                    )
+                except (AmqpError, OSError) as exc:
+                    outcomes[i] = exc
+            return outcomes
+        slots: "dict[int, _ConfirmSlot]" = {}
+        with self._connection._write_lock:
+            for i, (exchange, routing_key, body, headers) in enumerate(entries):
+                args = (
+                    wire.Writer()
+                    .short(0)
+                    .shortstr(exchange)
+                    .shortstr(routing_key)
+                    .bit(False)  # mandatory
+                    .bit(False)  # immediate
+                    .done()
+                )
+                header = wire.encode_content_header(
+                    len(body), headers=headers,
+                    delivery_mode=2 if persistent else 1,
+                )
+                with self._confirm_lock:
+                    self._publish_seq += 1
+                    seq = self._publish_seq
+                    slot = _ConfirmSlot()
+                    self._confirms[seq] = slot
+                try:
+                    self._connection._send_content_locked(
+                        self._number, args, header, body
+                    )
+                except Exception as exc:
+                    with self._confirm_lock:
+                        self._confirms.pop(seq, None)
+                    # the connection is torn down mid-batch: this entry
+                    # and every unsent one fail with the send error;
+                    # already-sent entries keep their slots (teardown
+                    # resolves them as unconfirmed below)
+                    for j in range(i, len(entries)):
+                        outcomes[j] = exc
+                    break
+                slots[i] = slot
+        deadline = time.monotonic() + self.confirm_timeout
+        for i, slot in slots.items():
+            remaining = deadline - time.monotonic()
+            if remaining > 0:
+                slot.event.wait(remaining)
+            if slot.event.is_set():
+                if not slot.ok:
+                    outcomes[i] = AmqpError(
+                        "publish was not confirmed "
+                        "(nacked or connection lost)"
+                    )
+                continue
+            with self._confirm_lock:
+                # drop the slot so a late confirm can't resolve into
+                # a dict entry nobody reads
+                for seq, live in list(self._confirms.items()):
+                    if live is slot:
+                        self._confirms.pop(seq, None)
+                        break
+            outcomes[i] = AmqpError(
+                f"publish confirm timed out after {self.confirm_timeout:g}s"
+            )
+        return outcomes
+
     def consume(self, queue: str, on_message: Callable[[Message], None]) -> str:
         self._check()
         # client-chosen consumer tag, registered BEFORE the RPC: the server
@@ -304,10 +394,26 @@ class AmqpChannel:
             raise
         return tag
 
-    def ack(self, delivery_tag: int) -> None:
+    def ack(self, delivery_tag: int, multiple: bool = False) -> None:
+        """``multiple=True`` acks every delivery up to ``delivery_tag``
+        in one basic.ack frame (AMQP 0-9-1 §basic.ack) — one frame for
+        a whole batch instead of one per message."""
         self._check()
-        args = wire.Writer().longlong(delivery_tag).bit(False).done()
+        args = wire.Writer().longlong(delivery_tag).bit(multiple).done()
         self._connection._send_method(self._number, wire.BASIC_ACK, args)
+        with self._unacked_lock:
+            if multiple:
+                self._unacked = {
+                    t for t in self._unacked if t > delivery_tag
+                }
+            else:
+                self._unacked.discard(delivery_tag)
+
+    def unacked_tags(self) -> list[int]:
+        """Delivery tags outstanding on this channel (see the batch
+        settle in queue/delivery.py)."""
+        with self._unacked_lock:
+            return list(self._unacked)
 
     def nack(self, delivery_tag: int, requeue: bool) -> None:
         self._check()
@@ -315,6 +421,8 @@ class AmqpChannel:
             wire.Writer().longlong(delivery_tag).bit(False).bit(requeue).done()
         )
         self._connection._send_method(self._number, wire.BASIC_NACK, args)
+        with self._unacked_lock:
+            self._unacked.discard(delivery_tag)
 
     def close(self) -> None:
         if self.closed or self._connection.is_closed():
@@ -417,6 +525,8 @@ class AmqpChannel:
         )
         callback = self._consumers.get(consumer_tag)
         if callback is not None:
+            with self._unacked_lock:
+                self._unacked.add(delivery_tag)
             self._connection._dispatch(callback, message)
 
     def _fail(self, exc: Exception) -> None:

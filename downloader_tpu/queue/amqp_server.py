@@ -373,7 +373,8 @@ class _ClientSession:
                 pending_publish = [channel_num, exchange, routing_key, 0, {}, []]
             elif method == wire.BASIC_ACK:
                 tag = reader.longlong()
-                channel.ack(tag)
+                multiple = reader.bit()
+                channel.ack(tag, multiple=multiple)
             elif method == wire.BASIC_NACK:
                 tag = reader.longlong()
                 reader.bit()  # multiple

@@ -69,7 +69,23 @@ class Channel(Protocol):
 
     def consume(self, queue: str, on_message: Callable[[Message], None]) -> str: ...
 
-    def ack(self, delivery_tag: int) -> None: ...
+    def ack(self, delivery_tag: int, multiple: bool = False) -> None:
+        """``multiple=True`` settles every unacked delivery on this
+        channel up to ``delivery_tag`` in one frame (AMQP basic.ack
+        semantics) — the batched fast path's coalesced settle.
+
+        Channels that support coalescing also expose two optional
+        extensions the batch settle feature-detects (see
+        queue/delivery.py ``ack_batch``):
+
+        - ``unacked_tags() -> list[int]`` — outstanding delivery tags,
+          so a multiple-ack provably never reaches past a delivery a
+          different worker still owns;
+        - ``publish_many(entries, persistent=True) -> list[Exception | None]``
+          — publish a batch under ONE confirm wait, with per-entry
+          outcomes so a confirm failure fails exactly the affected
+          publishes."""
+        ...
 
     def nack(self, delivery_tag: int, requeue: bool) -> None: ...
 

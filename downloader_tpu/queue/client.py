@@ -37,7 +37,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
-from ..utils import get_logger
+from ..utils import get_logger, metrics
 from ..utils import incident, watchdog
 from ..utils.cancel import CancelToken
 from .broker import BrokerError, Channel, Connection, ConnectionFactory, Message
@@ -48,6 +48,10 @@ log = get_logger("queue")
 DEFAULT_CONSUMER_QUEUES = 2  # reference client.go:108
 SUPERVISOR_INTERVAL = 1.0  # reference client.go:113
 DEFAULT_PREFETCH = 10  # reference client.go:107
+# back-to-back publishes already sitting in the buffer are flushed as
+# ONE channel batch (one confirm wait) up to this many at a time —
+# bounds worst-case rework when a flush fails mid-batch
+PUBLISH_FLUSH_MAX = 64
 
 
 @dataclass
@@ -265,6 +269,24 @@ class QueueClient:
         key instead of the shard round-robin — required for the default
         exchange (``topic=""``), which routes directly to the queue named
         by the key and has no shards to round-robin over."""
+        pending = self.publish_async(
+            topic, body, headers=headers, routing_key=routing_key
+        )
+        if wait is None:
+            return True
+        return self.flush([pending], wait, cancel=cancel)[0]
+
+    def publish_async(
+        self,
+        topic: str,
+        body: bytes,
+        headers: dict | None = None,
+        routing_key: str | None = None,
+    ) -> _PendingPublish:
+        """Buffer a publish and return its handle WITHOUT waiting for
+        the broker — the batched fast path enqueues a whole batch of
+        Convert messages this way and then pays ONE ``flush`` covering
+        all of them, instead of one confirm round trip per message."""
         if topic == "" and routing_key is None:
             raise ValueError(
                 "publishing to the default exchange requires routing_key"
@@ -275,19 +297,41 @@ class QueueClient:
         with self._lock:
             self._publishes_pending += 1
         self._publish_buffer.put(pending)
-        if wait is None:
-            return True
-        if cancel is None:
-            return pending.flushed.wait(wait)
+        return pending
+
+    def flush(
+        self,
+        pendings: "list[_PendingPublish]",
+        wait: float,
+        cancel: CancelToken | None = None,
+    ) -> list[bool]:
+        """Block until each handle's message is confirmed on the broker
+        (or the shared deadline passes); returns per-handle confirm
+        state in order. One deadline covers the whole batch — the
+        coalesced confirm wait. ``cancel`` has ``publish``'s semantics:
+        a JOB-level cancel stops the waiting early and reports current
+        state; a client-wide shutdown keeps waiting (the publisher
+        drains through shutdown, and the confirms usually arrive)."""
         deadline = time.monotonic() + wait
-        while True:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                return pending.flushed.is_set()
-            if pending.flushed.wait(min(0.2, remaining)):
-                return True
-            if cancel.cancelled() and not self._token.cancelled():
-                return pending.flushed.is_set()
+        # with no cancel to poll, one uninterrupted wait per handle
+        step = wait if cancel is None else 0.2
+        results: list[bool] = []
+        cancelled_early = False
+        for pending in pendings:
+            while not cancelled_early and not pending.flushed.is_set():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                if pending.flushed.wait(min(step, remaining)):
+                    break
+                if (
+                    cancel is not None
+                    and cancel.cancelled()
+                    and not self._token.cancelled()
+                ):
+                    cancelled_early = True
+            results.append(pending.flushed.is_set())
+        return results
 
     def stop_consuming(self) -> None:
         """Close all shard consumers and forget them so the supervisor
@@ -580,67 +624,27 @@ class QueueClient:
                 if time.monotonic() < pending.not_before:
                     self._publish_buffer.put(pending)
                     continue
-            if pending.routing_key is not None:
-                routing_key = pending.routing_key
-            else:
-                routing_key = self._next_rk(pending.topic)
-            try:
-                if pending.topic:  # the default exchange ("") is not declarable
-                    self._ensure_topology(my_channel, pending.topic)
-                my_channel.publish(
-                    pending.topic,
-                    routing_key,
-                    pending.body,
-                    headers=pending.headers,
-                    persistent=True,
-                )
-                with self._lock:
-                    self.stats.published += 1
-                    self._publishes_pending -= 1
-                pending.flushed.set()
-                log.with_fields(topic=pending.topic, rk=routing_key).debug(
-                    "published message"
-                )
-            except Exception as exc:
-                # Broad on purpose (not just BrokerError): an escaped
-                # exception would kill this thread while
-                # ``_publisher_alive`` stays True, so the supervisor
-                # would never recreate the publisher and every later
-                # publish would buffer unsent forever. Either way the
-                # recovery is identical: re-buffer the message, mark the
-                # publisher dead, hand the channel back, let the
-                # supervisor rebuild — at-least-once beats silent loss.
-                #
-                # Real exponential backoff with jitter — the reference's
-                # `backoff ^ 2` XOR bug oscillated 0↔2ms (client.go:226)
-                pending.attempts += 1
-                backoff = min(
-                    self._publish_backoff_base * (2 ** (pending.attempts - 1)),
-                    self._publish_backoff_cap,
-                )
-                pending.not_before = time.monotonic() + backoff * (
-                    1 + random.uniform(0, 0.25)
-                )
-                with self._lock:
-                    self.stats.publish_retries += 1
-                log.warning(
-                    f"publish failed ({exc}); retry {pending.attempts} "
-                    f"in {backoff:.2f}s"
-                )
-                self._publish_buffer.put(pending)
-                with self._lock:
-                    if self._publisher_channel is my_channel:
-                        self._publisher_alive = False
-                        self._publisher_channel = None
-                # close the abandoned channel: with confirms, a publish
-                # failure (confirm timeout) can happen on a HEALTHY
-                # connection, and leaking one open channel per retry
-                # cycle would eventually blow past the negotiated
-                # channel-max on a real broker
-                try:
-                    my_channel.close()
-                except BrokerError:
-                    pass
+            # coalesce: whatever else is ALREADY buffered flushes as one
+            # channel batch — one confirm wait for the lot instead of
+            # one broker round trip per message. Only ripe messages
+            # join; a backoff-delayed one goes back and ends the drain
+            # (taking more behind it would reorder past it forever).
+            batch = [pending]
+            if getattr(my_channel, "publish_many", None) is not None:
+                now = time.monotonic()
+                while len(batch) < PUBLISH_FLUSH_MAX:
+                    try:
+                        extra = self._publish_buffer.get_nowait()
+                    except queue_mod.Empty:
+                        break
+                    if extra.not_before > now:
+                        self._publish_buffer.put(extra)
+                        break
+                    batch.append(extra)
+            if len(batch) > 1:
+                if not self._flush_publish_batch(my_channel, batch):
+                    return  # thread exits; supervisor recreates
+            elif not self._flush_publish_one(my_channel, pending):
                 return  # thread exits; supervisor recreates with a fresh channel
         with self._lock:
             if self._publisher_channel is my_channel:
@@ -650,3 +654,128 @@ class QueueClient:
             my_channel.close()
         except BrokerError:
             pass
+
+    # -- publisher flush helpers ------------------------------------------
+
+    def _note_published(self, pending: _PendingPublish) -> None:
+        with self._lock:
+            self.stats.published += 1
+            self._publishes_pending -= 1
+        pending.flushed.set()
+
+    def _note_publish_failure(
+        self, pending: _PendingPublish, exc: BaseException
+    ) -> None:
+        """Schedule one message's retry: real exponential backoff with
+        jitter — the reference's `backoff ^ 2` XOR bug oscillated
+        0↔2ms (client.go:226) — and back into the buffer it goes
+        (at-least-once beats silent loss)."""
+        pending.attempts += 1
+        backoff = min(
+            self._publish_backoff_base * (2 ** (pending.attempts - 1)),
+            self._publish_backoff_cap,
+        )
+        pending.not_before = time.monotonic() + backoff * (
+            1 + random.uniform(0, 0.25)
+        )
+        with self._lock:
+            self.stats.publish_retries += 1
+        log.warning(
+            f"publish failed ({exc}); retry {pending.attempts} "
+            f"in {backoff:.2f}s"
+        )
+        self._publish_buffer.put(pending)
+
+    def _retire_publisher_channel(self, my_channel: Channel) -> None:
+        """Mark the publisher dead (supervisor rebuilds it) and close
+        the abandoned channel: with confirms, a publish failure
+        (confirm timeout) can happen on a HEALTHY connection, and
+        leaking one open channel per retry cycle would eventually blow
+        past the negotiated channel-max on a real broker."""
+        with self._lock:
+            if self._publisher_channel is my_channel:
+                self._publisher_alive = False
+                self._publisher_channel = None
+        try:
+            my_channel.close()
+        except BrokerError:
+            pass
+
+    def _flush_publish_one(
+        self, my_channel: Channel, pending: _PendingPublish
+    ) -> bool:
+        """Publish one buffered message; False means the channel was
+        retired and the publisher thread must exit. The exception catch
+        is broad on purpose (not just BrokerError): an escaped
+        exception would kill the thread while ``_publisher_alive``
+        stays True, so the supervisor would never recreate the
+        publisher and every later publish would buffer unsent forever."""
+        if pending.routing_key is not None:
+            routing_key = pending.routing_key
+        else:
+            routing_key = self._next_rk(pending.topic)
+        try:
+            if pending.topic:  # the default exchange ("") is not declarable
+                self._ensure_topology(my_channel, pending.topic)
+            my_channel.publish(
+                pending.topic,
+                routing_key,
+                pending.body,
+                headers=pending.headers,
+                persistent=True,
+            )
+        except Exception as exc:
+            self._note_publish_failure(pending, exc)
+            self._retire_publisher_channel(my_channel)
+            return False
+        self._note_published(pending)
+        log.with_fields(topic=pending.topic, rk=routing_key).debug(
+            "published message"
+        )
+        return True
+
+    def _flush_publish_batch(
+        self, my_channel: Channel, batch: "list[_PendingPublish]"
+    ) -> bool:
+        """Publish a drained batch under ONE confirm wait
+        (``channel.publish_many``). Per-entry outcomes keep failure
+        isolation exact: confirmed messages flush, failed ones re-buffer
+        with their own backoff — a confirm failure never takes down its
+        batch-mates' hand-offs. Any failure still retires the channel
+        (False), same as the single path."""
+        entries = []
+        try:
+            for pending in batch:
+                if pending.topic:
+                    self._ensure_topology(my_channel, pending.topic)
+                routing_key = (
+                    pending.routing_key
+                    if pending.routing_key is not None
+                    else self._next_rk(pending.topic)
+                )
+                entries.append(
+                    (pending.topic, routing_key, pending.body, pending.headers)
+                )
+            outcomes = my_channel.publish_many(entries)
+        except Exception as exc:
+            # failed before per-entry outcomes existed (topology declare
+            # or the batch API itself): the first message burns an
+            # attempt with backoff, the rest re-buffer untouched
+            self._note_publish_failure(batch[0], exc)
+            for pending in batch[1:]:
+                self._publish_buffer.put(pending)
+            self._retire_publisher_channel(my_channel)
+            return False
+        metrics.GLOBAL.add("queue_publish_flushes")
+        metrics.GLOBAL.add("queue_publishes_coalesced", len(batch) - 1)
+        failed = False
+        for pending, outcome in zip(batch, outcomes):
+            if outcome is None:
+                self._note_published(pending)
+            else:
+                failed = True
+                self._note_publish_failure(pending, outcome)
+        if failed:
+            self._retire_publisher_channel(my_channel)
+            return False
+        return True

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from collections import deque
 from typing import Callable
 
@@ -330,6 +331,65 @@ class MemoryChannel:
         # non-confirm callers and fast tests keep their behavior)
         self._broker._publish(exchange, routing_key, body, headers or {})
 
+    def publish_many(
+        self, entries: list, persistent: bool = True
+    ) -> "list[Exception | None]":
+        """Publish a batch with ONE confirm wait covering all of it.
+        ``entries`` is (exchange, routing_key, body, headers) tuples;
+        returns a per-entry outcome (None = confirmed on the broker,
+        an exception = that publish failed) so a confirm failure fails
+        exactly the affected publishes, never its batch-mates."""
+        self._check()
+        if not (self._confirm_mode and self._broker.hold_confirms):
+            outcomes: "list[Exception | None]" = []
+            for exchange, routing_key, body, headers in entries:
+                try:
+                    self._broker._publish(
+                        exchange, routing_key, body, headers or {}
+                    )
+                    outcomes.append(None)
+                except BrokerError as exc:
+                    outcomes.append(exc)
+            return outcomes
+        # async-confirm mode: stage the whole batch, then wait once
+        # under a shared deadline — the coalesced round trip
+        held = []
+        with self._broker._lock:
+            for exchange, routing_key, body, headers in entries:
+                entry = _HeldPublish(
+                    self, exchange, routing_key, body, headers or {}
+                )
+                self._broker._held.append(entry)
+                held.append(entry)
+        deadline = time.monotonic() + self.confirm_timeout
+        outcomes = []
+        for entry in held:
+            remaining = deadline - time.monotonic()
+            if remaining > 0:
+                entry.event.wait(remaining)
+            if entry.result is True:
+                outcomes.append(None)
+                continue
+            if not entry.event.is_set():
+                # withdraw the staged copy, as publish() does: a later
+                # release_confirms must not route a message whose
+                # hand-off already reported failure
+                with self._broker._lock:
+                    if entry in self._broker._held:
+                        self._broker._held.remove(entry)
+                        outcomes.append(
+                            BrokerError("publish confirm timed out")
+                        )
+                        continue
+                entry.event.wait(self.confirm_timeout)
+                if entry.result is True:
+                    outcomes.append(None)
+                    continue
+            outcomes.append(
+                BrokerError("connection died before publish confirm")
+            )
+        return outcomes
+
     def consume(self, queue: str, on_message: Callable[[Message], None]) -> str:
         self._check()
         consumer = _Consumer(self, on_message)
@@ -341,10 +401,25 @@ class MemoryChannel:
         self._broker._pump()
         return f"ctag-{id(consumer)}"
 
-    def ack(self, delivery_tag: int) -> None:
+    def ack(self, delivery_tag: int, multiple: bool = False) -> None:
+        """``multiple=True`` acks every unacked delivery on THIS channel
+        up to and including ``delivery_tag`` (AMQP basic.ack semantics) —
+        the coalesced settle the batched fast path uses."""
         self._check()
-        self.unacked.pop(delivery_tag, None)
+        if multiple:
+            with self._broker._lock:
+                for tag in [t for t in self.unacked if t <= delivery_tag]:
+                    self.unacked.pop(tag, None)
+        else:
+            self.unacked.pop(delivery_tag, None)
         self._broker._pump()
+
+    def unacked_tags(self) -> list[int]:
+        """Delivery tags outstanding on this channel — what a batch
+        settle needs to prove a multiple-ack can't reach past a
+        delivery some other worker still owns."""
+        with self._broker._lock:
+            return list(self.unacked)
 
     def nack(self, delivery_tag: int, requeue: bool) -> None:
         self._check()

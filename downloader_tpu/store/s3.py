@@ -16,6 +16,7 @@ since a single PUT tops out at 5 GiB on real S3 and media files don't.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import http.client
 import io
@@ -23,6 +24,7 @@ import os
 import re
 import stat
 import tempfile
+import threading
 import time
 import urllib.parse
 from typing import BinaryIO, Mapping
@@ -102,6 +104,12 @@ class S3Client:
         self._zero_copy = zero_copy
         self._multipart_threshold = multipart_threshold
         self._part_size = part_size  # None = derive per object
+        # per-thread keep-alive scope (connection_scope): while active,
+        # requests issued by THAT thread reuse one connection instead
+        # of paying a TCP (+TLS) handshake per call — the store half of
+        # the batched small-object fast path. Thread-local so batch
+        # workers can't share (and corrupt) one socket.
+        self._reuse = threading.local()
 
     @property
     def multipart_threshold(self) -> int:
@@ -150,6 +158,55 @@ class S3Client:
         return conn_cls(
             self._host, timeout=self._timeout if timeout is None else timeout
         )
+
+    @contextlib.contextmanager
+    def connection_scope(self):
+        """Reuse ONE connection for every request the calling thread
+        issues inside the scope (kept alive between calls, closed on
+        exit). The batched fast path wraps a whole batch of single-PUT
+        uploads in one scope, so N small objects cost one handshake
+        instead of N. A parked connection the server closed while idle
+        is retried once on a fresh one — the caller never sees it.
+        Nesting is a no-op; other threads are unaffected."""
+        if getattr(self._reuse, "active", False):
+            yield
+            return
+        self._reuse.active = True
+        self._reuse.conn = None
+        try:
+            yield
+        finally:
+            conn = getattr(self._reuse, "conn", None)
+            self._reuse.active = False
+            self._reuse.conn = None
+            if conn is not None:
+                conn.close()
+
+    def _checkout_connection(
+        self, timeout: float | None
+    ) -> tuple[http.client.HTTPConnection, bool]:
+        """(connection, reused): the thread's parked scope connection
+        when available, else a fresh connected one. Explicit timeout
+        overrides (abort's short deadline) always get a fresh
+        connection — a parked socket carries the default timeout."""
+        if timeout is None and getattr(self._reuse, "active", False):
+            conn = getattr(self._reuse, "conn", None)
+            if conn is not None:
+                self._reuse.conn = None  # checked out; re-parked on success
+                return conn, True
+        conn = self._connect(timeout)
+        conn.connect()
+        # a cancellation callback closes the socket mid-request;
+        # http.client would silently REOPEN it on the next send and
+        # desync the exchange — make the close terminal instead
+        conn.auto_open = 0
+        return conn, False
+
+    def _park_connection(self, conn: http.client.HTTPConnection, keepalive: bool) -> None:
+        if keepalive and getattr(self._reuse, "active", False):
+            self._reuse.conn = conn
+        else:
+            conn.close()
 
     def _request(
         self,
@@ -202,36 +259,51 @@ class S3Client:
                 f"={urllib.parse.quote(v, safe='-._~')}"
                 for k, v in sorted(query.items())
             )
-        conn = self._connect(timeout)
-        remove_hook = (
-            token.add_callback(conn.close) if token is not None else lambda: None
+        # rewind point for the stale-keep-alive retry: a parked scope
+        # connection the server closed shows up as a send/read failure
+        # on the FIRST exchange after reuse, and the retry must replay
+        # the body from where this call found it
+        body_start = (
+            body.tell() if body is not None and _seekable(body) else None
         )
-        try:
-            conn.connect()
-            # a cancellation callback closes the socket mid-request;
-            # http.client would silently REOPEN it on the next send and
-            # desync the exchange — make the close terminal instead
-            conn.auto_open = 0
-            conn.putrequest(
-                method, encoded_path, skip_host=True, skip_accept_encoding=True
+        while True:
+            conn, reused = self._checkout_connection(timeout)
+            remove_hook = (
+                token.add_callback(conn.close)
+                if token is not None
+                else lambda: None
             )
-            for name, value in headers.items():
-                conn.putheader(name, value)
-            conn.endheaders()
-            if body is not None:
-                self._send_body(conn, body, content_length, token)
-            response = conn.getresponse()
-            response_headers = {k.lower(): v for k, v in response.getheaders()}
-            return response.status, response.read(), response_headers
-        except (OSError, http.client.HTTPException):
-            if token is not None:
-                # the failure may BE the cancellation (closed-under-us
-                # socket); report it as such, not as a transport error
-                token.raise_if_cancelled()
-            raise
-        finally:
-            remove_hook()
-            conn.close()
+            try:
+                conn.putrequest(
+                    method, encoded_path, skip_host=True, skip_accept_encoding=True
+                )
+                for name, value in headers.items():
+                    conn.putheader(name, value)
+                conn.endheaders()
+                if body is not None:
+                    self._send_body(conn, body, content_length, token)
+                response = conn.getresponse()
+                response_headers = {
+                    k.lower(): v for k, v in response.getheaders()
+                }
+                payload = response.read()
+            except (OSError, http.client.HTTPException):
+                conn.close()
+                if token is not None:
+                    # the failure may BE the cancellation (closed-under-us
+                    # socket); report it as such, not as a transport error
+                    token.raise_if_cancelled()
+                if reused and (body is None or body_start is not None):
+                    # stale pool entry, not a request verdict: replay
+                    # once on a fresh connection
+                    if body_start is not None:
+                        body.seek(body_start)
+                    continue
+                raise
+            finally:
+                remove_hook()
+            self._park_connection(conn, keepalive=not response.will_close)
+            return response.status, payload, response_headers
 
     def _send_body(
         self,

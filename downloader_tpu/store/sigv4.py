@@ -10,10 +10,20 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import threading
 import urllib.parse
 from typing import Mapping
 
 EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+# the derived signing key is a pure function of (secret, date, region,
+# service) and the date only changes once a day — deriving it fresh per
+# request is 4 HMAC rounds of per-call fixed cost the batched fast path
+# exists to shave. Tiny bound: one live credential set plus a few
+# stragglers around midnight UTC.
+_KEY_CACHE_MAX = 8
+_key_cache_lock = threading.Lock()
+_key_cache: dict[tuple[str, str, str, str], bytes] = {}  # guarded-by: _key_cache_lock
 
 
 def _uri_encode(value: str, encode_slash: bool) -> str:
@@ -52,13 +62,24 @@ def canonical_request(
 
 
 def signing_key(secret_key: str, date: str, region: str, service: str) -> bytes:
+    cache_key = (secret_key, date, region, service)
+    with _key_cache_lock:
+        cached = _key_cache.get(cache_key)
+    if cached is not None:
+        return cached
+
     def _hmac(key: bytes, msg: str) -> bytes:
         return hmac.new(key, msg.encode(), hashlib.sha256).digest()
 
     k_date = _hmac(b"AWS4" + secret_key.encode(), date)
     k_region = _hmac(k_date, region)
     k_service = _hmac(k_region, service)
-    return _hmac(k_service, "aws4_request")
+    derived = _hmac(k_service, "aws4_request")
+    with _key_cache_lock:
+        if len(_key_cache) >= _KEY_CACHE_MAX:
+            _key_cache.clear()  # day rollover / credential churn
+        _key_cache[cache_key] = derived
+    return derived
 
 
 def sign(

@@ -143,6 +143,15 @@ class Uploader:
             pipeline = self._pipeline
         return pipeline.session(media_id, token)
 
+    def batch_scope(self):
+        """One store connection for every upload the calling thread
+        issues inside the scope (S3Client.connection_scope): the
+        batched small-object fast path wraps a whole batch so N
+        single-PUT uploads pay one handshake. Single-file jobs upload
+        on the calling thread (see upload_files), so the scope covers
+        exactly the batch's PUTs."""
+        return self._client.connection_scope()
+
     def close(self) -> None:
         """Release the streaming pipeline's part pool (daemon shutdown)."""
         with self._pipeline_lock:

@@ -72,6 +72,13 @@ HELP = {
     "pipeline_overlap_ratio": (
         "fraction of streamed bytes uploaded while the fetch still ran"
     ),
+    "batch_fast_jobs": "jobs completed through the batched small-object fast path",
+    "batch_jobs_per_wave": "fast-lane jobs per dequeue wave (batched settles)",
+    "queue_acks_coalesced": "ack frames saved by multiple-ack batch settles",
+    "queue_publish_flushes": "publisher batches flushed under one confirm wait",
+    "queue_publishes_coalesced": "confirm waits saved by publisher flush batching",
+    "http_small_fetches": "small objects fetched whole over one pooled connection",
+    "http_probe_cache_hits": "HEAD probes answered from the probe cache",
     "watchdog_stalls": "stall episodes flagged (no forward progress)",
     "watchdog_cancels": "stalled jobs cancelled (WATCHDOG_ACTION=cancel)",
     "watchdog_stalled_tasks": "watched tasks currently flagged as stalled",

@@ -318,6 +318,22 @@ class Tracer:
         trace.root.meta = {"job_id": job_id} if job_id else None
         return _RootCM(self, trace)  # type: ignore[return-value]
 
+    def open_job(self, job_id: str = "") -> "OpenTrace":
+        """A manually driven job trace for work whose lifecycle cannot
+        be one ``with`` block — the batched fast path records each
+        job's phases inside ``activate()`` blocks on the worker thread,
+        keeps the trace open across the batch's coalesced confirm/ack,
+        then settles it with ``complete()``. Disabled tracing hands out
+        the shared no-op instance."""
+        if not self.enabled:
+            return NOOP_OPEN_TRACE
+        with self._lock:
+            self._seq += 1
+            trace = Trace(job_id, self._seq)
+            self._in_flight[trace.seq] = trace
+        trace.root.meta = {"job_id": job_id} if job_id else None
+        return OpenTrace(self, trace)
+
     def _complete(self, trace: Trace) -> None:
         if trace.status == "in-flight":
             trace.status = "ok"
@@ -455,6 +471,42 @@ class _RootCM:
             # never let such a job read as "ok" on /debug/jobs
             self._trace.status = "error"
         self._tracer._complete(self._trace)
+
+
+class OpenTrace:
+    """See ``Tracer.open_job``. Spans recorded inside ``activate()``
+    blocks nest under the job root exactly as in the context-manager
+    form; ``complete()`` is the ``_RootCM.__exit__`` analogue (root
+    finish + ring hand-off + histogram feed) and is idempotent."""
+
+    __slots__ = ("_tracer", "_trace")
+
+    def __init__(self, tracer: "Tracer | None", trace: Trace | None):
+        self._tracer = tracer
+        self._trace = trace
+
+    @property
+    def root(self) -> Span:
+        return self._trace.root if self._trace is not None else NOOP  # type: ignore[return-value]
+
+    @property
+    def status(self) -> str:
+        return self._trace.status if self._trace is not None else "noop"
+
+    def activate(self) -> "adopt":
+        """Context manager installing the job root as the calling
+        thread's current span, so ``span()`` calls nest under it."""
+        return adopt(self._trace.root if self._trace is not None else None)
+
+    def complete(self) -> None:
+        trace, self._trace = self._trace, None
+        if trace is None:
+            return
+        trace.root.finish()
+        self._tracer._complete(trace)
+
+
+NOOP_OPEN_TRACE = OpenTrace(None, None)
 
 
 TRACER = Tracer()

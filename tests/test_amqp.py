@@ -139,6 +139,67 @@ class TestChannelOps:
         channel.ack(got[0].delivery_tag)
         assert wait_for(lambda: len(got) == 2)
 
+    def test_multiple_ack_settles_prefix_over_the_wire(self, server, conn):
+        """basic.ack with multiple=True settles every delivery up to the
+        tag in ONE frame — the batched settle's wire form — and the
+        channel's unacked-tag introspection tracks it."""
+        channel = conn.channel()
+        channel.declare_exchange("t")
+        channel.declare_queue("t-0")
+        channel.bind_queue("t-0", "t", "t-0")
+        got = []
+        channel.consume("t-0", got.append)
+        for i in range(4):
+            channel.publish("t", "t-0", f"m{i}".encode())
+        assert wait_for(lambda: len(got) == 4)
+        assert sorted(channel.unacked_tags()) == sorted(
+            m.delivery_tag for m in got
+        )
+        # ack the first three with one frame; the fourth stays unacked
+        channel.ack(got[2].delivery_tag, multiple=True)
+        assert channel.unacked_tags() == [got[3].delivery_tag]
+        assert wait_for(
+            lambda: server.broker.queue_depth("t-0") == 0
+        )  # nothing requeued: the prefix really settled server-side
+        channel.ack(got[3].delivery_tag)
+        assert channel.unacked_tags() == []
+
+    def test_publish_many_confirms_batch_over_the_wire(self, server, conn):
+        """publish_many in confirm mode: the whole batch rides the
+        socket back-to-back and ONE wait collects every confirm."""
+        channel = conn.channel()
+        channel.declare_exchange("t")
+        channel.declare_queue("t-0")
+        channel.bind_queue("t-0", "t", "t-0")
+        channel.confirm_select()
+        channel.confirm_timeout = 5.0
+        outcomes = channel.publish_many(
+            [("t", "t-0", f"m{i}".encode(), {}) for i in range(5)]
+        )
+        assert outcomes == [None] * 5
+        assert wait_for(lambda: server.broker.queue_depth("t-0") == 5)
+
+    def test_publish_many_confirm_timeout_fails_only_unconfirmed(self, server):
+        """A broker that stops acking fails the batch entries with
+        timeouts — and the failures are reported per entry, not raised
+        as one batch-wide loss."""
+        connection = AmqpConnection.dial(server.endpoint)
+        try:
+            channel = connection.channel()
+            channel.declare_exchange("t")
+            channel.declare_queue("t-0")
+            channel.bind_queue("t-0", "t", "t-0")
+            channel.confirm_select()
+            channel.confirm_timeout = 0.5
+            server.hold_confirm_acks = True
+            outcomes = channel.publish_many(
+                [("t", "t-0", f"m{i}".encode(), {}) for i in range(3)]
+            )
+            assert all(isinstance(out, AmqpError) for out in outcomes)
+        finally:
+            server.hold_confirm_acks = False
+            connection.close()
+
     def test_bind_to_missing_exchange_closes_channel(self, server, conn):
         channel = conn.channel()
         channel.declare_queue("q")

@@ -1,0 +1,531 @@
+"""Batched small-object fast path (ISSUE 6): the daemon's dequeue wave
+classification, the batched fetch→upload→publish→ack lane, and the
+correctness constraint that makes it interesting — at-least-once MUST
+hold per job:
+
+- batch-boundary behavior: mixed sizes straddling BATCH_MAX_BYTES,
+  with large jobs bypassing the fast lane untouched,
+- failure-position fuzz: a failing job at the first/middle/last batch
+  position settles ONLY its own delivery (nack/retry isolation) and
+  leaves zero dangling multipart uploads,
+- watchdog cancel of ONE job out of an active batch,
+- the coalesced settle: one connection-reuse streak on the fetch pool,
+  multiple-ack coalescing, and the per-batch store connection — all
+  asserted via metrics counters (the CI smoke step runs these),
+- the regression guard: batched per-job FRAMEWORK overhead p50 <= 1 ms,
+  measured with the transfer stubbed to near-zero, in the spirit of the
+  <= 2.5 ms tracing and <= 0.5 ms watchdog guards (the e2e floor on a
+  noisy host is environmental — loopback RTTs to out-of-process stubs;
+  see README Observability for the attribution).
+"""
+
+import base64
+import contextlib
+import http.server
+import os
+import threading
+import time
+
+import pytest
+
+from downloader_tpu.daemon.app import Daemon
+from downloader_tpu.daemon.config import Config
+from downloader_tpu.fetch import DispatchClient, HTTPBackend
+from downloader_tpu.fetch.dispatch import BackendRegistration
+from downloader_tpu.queue import MemoryBroker, QueueClient
+from downloader_tpu.queue.delivery import Delivery, ack_batch
+from downloader_tpu.store import Credentials, S3Client, Uploader
+from downloader_tpu.store.stub import S3Stub
+from downloader_tpu.utils import metrics, watchdog
+from downloader_tpu.utils.cancel import CancelToken
+from downloader_tpu.wire import Convert, Download, Media
+
+SMALL = os.urandom(16 * 1024)
+MID = os.urandom(48 * 1024)  # under MAX_BYTES; 6 of them bust the budget
+BIG = os.urandom(256 * 1024)  # above the tests' BATCH_MAX_BYTES
+MAX_BYTES = 64 * 1024
+
+
+def wait_for(predicate, timeout=15.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class BatchHandler(http.server.BaseHTTPRequestHandler):
+    """HEAD-capable payload server (the fast path needs a probeable
+    origin). ``/big.mkv`` exceeds MAX_BYTES; ``/fail-*.mkv`` answers
+    GET with 404 (deterministic TransferError through the fast lane);
+    ``/wedge.mkv`` sends headers then stalls until ``release`` fires."""
+
+    protocol_version = "HTTP/1.1"
+    release = threading.Event()
+
+    def log_message(self, *args):
+        pass
+
+    def _payload(self):
+        if self.path == "/big.mkv":
+            return BIG
+        if self.path.startswith("/mid"):
+            return MID
+        return SMALL
+
+    def do_HEAD(self):
+        body = self._payload()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Accept-Ranges", "bytes")
+        self.end_headers()
+
+    def do_GET(self):
+        if self.path.startswith("/fail-"):
+            self.send_error(404)
+            return
+        body = self._payload()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if self.path == "/wedge.mkv":
+            self.wfile.write(body[:1024])
+            self.wfile.flush()
+            BatchHandler.release.wait(30)
+            return
+        self.wfile.write(body)
+
+
+class _QuietServer(http.server.ThreadingHTTPServer):
+    def handle_error(self, request, client_address):
+        pass  # cancelled fast-path fetches reset connections; expected
+
+
+@pytest.fixture
+def server():
+    BatchHandler.release = threading.Event()
+    httpd = _QuietServer(("127.0.0.1", 0), BatchHandler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    BatchHandler.release.set()
+    httpd.shutdown()
+
+
+@pytest.fixture
+def harness(server, tmp_path):
+    """A fully wired daemon shaped for deterministic batching: one
+    worker, prefetch deep enough that a published burst accumulates in
+    the sink, and a generous BATCH_WAIT so the wave forms reliably on
+    loaded CI hosts."""
+
+    def build(max_job_retries=1, batch_jobs=8):
+        token = CancelToken()
+        broker = MemoryBroker()
+        stub = S3Stub(credentials=Credentials("k", "s")).start()
+        config = Config(
+            broker="memory",
+            base_dir=str(tmp_path),
+            concurrency=1,
+            max_job_retries=max_job_retries,
+            retry_delay=0.05,
+        )
+        config.batch_jobs = batch_jobs
+        config.batch_wait_ms = 300.0
+        config.batch_max_bytes = MAX_BYTES
+        client = QueueClient(
+            token, broker.connect, supervisor_interval=0.05, drain_timeout=5
+        )
+        client.set_prefetch(32)
+        dispatcher = DispatchClient(
+            token,
+            str(tmp_path),
+            [HTTPBackend(progress_interval=0.01, timeout=5)],
+        )
+        uploader = Uploader(
+            config.bucket, S3Client(stub.endpoint, Credentials("k", "s"))
+        )
+        daemon = Daemon(token, client, dispatcher, uploader, config)
+        runner = threading.Thread(target=daemon.run, daemon=True)
+
+        h = type("Harness", (), {})()
+        h.daemon, h.broker, h.stub, h.token = daemon, broker, stub, token
+        h.config, h.runner, h.base = config, runner, server
+        producer = broker.connect().channel()
+        # jobs are published BEFORE the daemon starts (so the wave is
+        # already waiting when the worker wakes): declare the topology
+        # the daemon would otherwise declare in consume()
+        producer.declare_exchange("v1.download")
+        for i in range(2):
+            name = f"v1.download-{i}"
+            producer.declare_queue(name)
+            producer.bind_queue(name, "v1.download", name)
+
+        def enqueue(media_id, path):
+            body = Download(
+                media=Media(id=media_id, source_uri=f"{server}{path}")
+            ).marshal()
+            producer.publish("v1.download", "v1.download-0", body)
+
+        h.enqueue = enqueue
+        h.start = runner.start
+        built.append(h)
+        return h
+
+    built = []
+    yield build
+    for h in built:
+        h.token.cancel()
+        if h.runner.ident is not None:  # a failed test may not have started it
+            h.runner.join(timeout=10)
+        h.stub.stop()
+
+
+def _uploaded(h, media_id, name="small.mkv", payload=SMALL):
+    key = f"{media_id}/original/{base64.b64encode(name.encode()).decode()}"
+    return h.stub.buckets.get("triton-staging", {}).get(key) == payload
+
+
+# ---------------------------------------------------------------------------
+# the batched wave end to end (the CI smoke step runs this test)
+
+
+def test_batched_wave_end_to_end_with_coalescing_counters(harness):
+    """N tiny jobs published as one burst run through the fast lane:
+    all complete and upload correctly, the fetches ride ONE pooled
+    connection (a reuse streak, not per-job dials), and the settle is
+    coalesced (multiple-ack saves frames) — asserted via the metrics
+    counters the ISSUE names."""
+    h = harness()
+    before = metrics.GLOBAL.snapshot()
+    for i in range(8):
+        h.enqueue(f"wave-{i}", "/small.mkv")
+    h.start()
+    assert wait_for(lambda: h.daemon.stats.processed == 8)
+    for i in range(8):
+        assert _uploaded(h, f"wave-{i}")
+    after = metrics.GLOBAL.snapshot()
+
+    def delta(name):
+        return after.get(name, 0) - before.get(name, 0)
+
+    assert delta("batch_fast_jobs") >= 2, "fast lane never engaged"
+    assert delta("http_small_fetches") >= 2
+    # one probe on a cold cache, then warm hits — never one HEAD per job
+    assert delta("http_probe_cache_hits") >= 6
+    # the reuse streak: 8 GETs (+1 HEAD) over ONE dialed connection
+    assert delta("http_pool_created") == 1
+    assert delta("http_pool_reuse_hits") >= 8
+    # coalesced settle: multiple-ack saved at least one frame
+    assert delta("queue_acks_coalesced") >= 1
+    assert h.daemon.stats.failed == 0 and h.daemon.stats.retried == 0
+
+
+def test_mixed_sizes_straddling_batch_max_bytes(harness):
+    """A wave mixing objects under and over BATCH_MAX_BYTES: small ones
+    take the fast lane, the big one bypasses it UNTOUCHED through the
+    normal pipeline — and everyone completes with correct bytes."""
+    h = harness()
+    before = metrics.GLOBAL.snapshot()
+    h.enqueue("mix-0", "/small.mkv")
+    h.enqueue("mix-big", "/big.mkv")
+    h.enqueue("mix-1", "/small.mkv")
+    h.enqueue("mix-2", "/small.mkv")
+    h.start()
+    assert wait_for(lambda: h.daemon.stats.processed == 4)
+    for mid in ("mix-0", "mix-1", "mix-2"):
+        assert _uploaded(h, mid)
+    assert _uploaded(h, "mix-big", "big.mkv", BIG)
+    after = metrics.GLOBAL.snapshot()
+    fast = after.get("batch_fast_jobs", 0) - before.get("batch_fast_jobs", 0)
+    assert fast == 3, f"expected exactly the 3 small jobs batched, got {fast}"
+    assert h.stub.list_multipart_uploads() == []
+
+
+def test_wave_byte_budget_overflows_to_normal_path(harness):
+    """The wave byte budget is REAL: a run of near-ceiling objects
+    stops admitting once cumulative bytes pass 4 x BATCH_MAX_BYTES
+    (here 256 KB: five 48 KB jobs fit, the rest overflow to the normal
+    pipeline) — and every job still completes either way."""
+    h = harness()
+    before = metrics.GLOBAL.snapshot()
+    for i in range(8):
+        h.enqueue(f"budget-{i}", f"/mid-{i}.mkv")
+    h.start()
+    assert wait_for(lambda: h.daemon.stats.processed == 8, timeout=30)
+    for i in range(8):
+        assert _uploaded(h, f"budget-{i}", f"mid-{i}.mkv", MID)
+    after = metrics.GLOBAL.snapshot()
+    fast = after.get("batch_fast_jobs", 0) - before.get("batch_fast_jobs", 0)
+    assert 2 <= fast <= 5, (
+        f"expected the 256 KB budget to cap the fast lane at 5 of 8 "
+        f"48 KB jobs, got {fast}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# failure-position fuzz: per-job ack/nack isolation
+
+
+@pytest.mark.parametrize("position", [0, 3, 7], ids=["first", "middle", "last"])
+def test_failure_position_settles_only_that_job(harness, position):
+    """A deterministic failure at any batch position drops exactly that
+    job (after its capped retries) while every batch-mate acks — and no
+    multipart upload dangles anywhere."""
+    h = harness(max_job_retries=1)
+    for i in range(8):
+        path = f"/fail-{i}.mkv" if i == position else "/small.mkv"
+        h.enqueue(f"fz-{i}", path)
+    h.start()
+    assert wait_for(lambda: h.daemon.stats.processed == 7, timeout=30)
+    assert wait_for(lambda: h.daemon.stats.failed == 1, timeout=30)
+    # the failed job burned its own retry budget, nobody else's
+    assert h.daemon.stats.retried == 1
+    for i in range(8):
+        if i != position:
+            assert _uploaded(h, f"fz-{i}")
+    assert h.stub.list_multipart_uploads() == []
+    # nothing left on the broker: every delivery settled exactly once
+    assert h.broker.queue_depth("v1.download-0") == 0
+
+
+def test_watchdog_cancels_one_job_out_of_active_batch(harness):
+    """WATCHDOG_ACTION=cancel releases ONE wedged job mid-batch via its
+    child token; batch-mates complete normally and the wedged job takes
+    the normal capped-retry exit (max_job_retries=0 → dropped)."""
+    monitor = watchdog.MONITOR
+    monitor.reset()
+    monitor.configure(
+        stall_s=0.4, action="cancel", stage_overrides={}, on_stall=None
+    )
+    monitor.start(poll_interval=0.05)
+    try:
+        h = harness(max_job_retries=0)
+        h.enqueue("wd-0", "/small.mkv")
+        h.enqueue("wd-wedge", "/wedge.mkv")
+        h.enqueue("wd-1", "/small.mkv")
+        h.enqueue("wd-2", "/small.mkv")
+        h.start()
+        assert wait_for(lambda: h.daemon.stats.processed == 3, timeout=30)
+        assert wait_for(lambda: h.daemon.stats.failed == 1, timeout=30)
+        for mid in ("wd-0", "wd-1", "wd-2"):
+            assert _uploaded(h, mid)
+        assert not _uploaded(h, "wd-wedge", "wedge.mkv")
+        assert h.stub.list_multipart_uploads() == []
+        snapshot = metrics.GLOBAL.snapshot()
+        assert snapshot.get("watchdog_cancels", 0) >= 1
+    finally:
+        BatchHandler.release.set()
+        monitor.reset()
+        monitor.stall_s = watchdog.DEFAULT_STALL_S
+
+
+# ---------------------------------------------------------------------------
+# coalesced-ack safety (queue/delivery.py ack_batch)
+
+
+def _collect_deliveries(broker, queue_name, count):
+    channel = broker.connect().channel()
+    channel.declare_exchange("x")
+    channel.declare_queue(queue_name)
+    channel.bind_queue(queue_name, "x", "rk")
+    for i in range(count):
+        channel.publish("x", "rk", f"m{i}".encode())
+    consumer = broker.connect().channel()
+    consumer.set_prefetch(count)
+    got = []
+    consumer.consume(
+        queue_name, lambda m: got.append(Delivery(m, consumer))
+    )
+    assert wait_for(lambda: len(got) == count, timeout=5)
+    return consumer, got
+
+
+def test_ack_batch_never_reaches_past_foreign_delivery(tmp_path):
+    """The at-least-once proof: multiple-ack must stop BELOW a tag the
+    batch does not own — acking a subset {1st, 3rd} leaves the 2nd
+    delivery unacked (it would be silently lost otherwise)."""
+    broker = MemoryBroker()
+    channel, got = _collect_deliveries(broker, "q1", 3)
+    ack_batch([got[0], got[2]])
+    remaining = channel.unacked_tags()
+    assert remaining == [got[1].message.delivery_tag], (
+        f"multiple-ack reached past a foreign delivery: {remaining}"
+    )
+    # the survivor is still settle-able by its owner
+    got[1].ack()
+    assert channel.unacked_tags() == []
+
+
+def test_ack_batch_coalesces_contiguous_prefix(tmp_path):
+    """A batch owning the whole contiguous prefix settles it in one
+    frame (counter moves) and the queue drains to empty."""
+    broker = MemoryBroker()
+    before = metrics.GLOBAL.snapshot().get("queue_acks_coalesced", 0)
+    channel, got = _collect_deliveries(broker, "q2", 4)
+    frames = ack_batch(got)
+    assert frames == 1
+    assert channel.unacked_tags() == []
+    after = metrics.GLOBAL.snapshot().get("queue_acks_coalesced", 0)
+    assert after - before == 3  # 4 deliveries, 1 frame → 3 saved
+    assert broker.queue_depth("q2") == 0
+
+
+def test_ack_batch_double_settle_is_safe(tmp_path):
+    broker = MemoryBroker()
+    channel, got = _collect_deliveries(broker, "q3", 2)
+    got[0].ack()  # settled out of band first
+    ack_batch(got)  # must not double-ack or raise
+    assert channel.unacked_tags() == []
+
+
+# ---------------------------------------------------------------------------
+# regression guard: batched per-job framework overhead
+
+
+class _InstantBackend:
+    """Transfer stubbed to 'write one tiny file': what remains when a
+    job costs ~nothing to move is the framework's own per-job fixed
+    cost — the quantity the batching exists to amortize."""
+
+    def register(self):
+        return BackendRegistration(name="instant", protocols=("http", "https"))
+
+    def probe_size(self, url, token=None):
+        return 1024
+
+    def fetch_small(self, token, base_dir, progress, url, max_bytes):
+        with open(os.path.join(base_dir, "tiny.mkv"), "wb") as sink:
+            sink.write(b"x" * 1024)
+        progress(url, 100.0)
+        return True
+
+    def download(self, token, base_dir, progress, url):
+        self.fetch_small(token, base_dir, progress, url, 1 << 20)
+
+
+class _NullStore:
+    """S3 surface that costs nothing: the guard measures the daemon,
+    not a loopback stub's socket round trips."""
+
+    multipart_threshold = 64 * 1024 * 1024
+
+    def bucket_exists(self, bucket):
+        return True
+
+    def make_bucket(self, bucket):
+        pass
+
+    def put_object(self, bucket, key, stream, size, **kwargs):
+        stream.read(size)
+
+    def connection_scope(self):
+        return contextlib.nullcontext()
+
+
+def _environmental_floor_ms(tmp_path) -> float:
+    """This host's per-job SYSCALL floor: the mkdir + 1 KB write + one
+    one-file scan_dir every job must do even with a zero-cost
+    framework. ~0.05 ms on dev hardware; ~1.1 ms on the shared CI VM
+    (a bare 1 KB file write alone measures ~0.7 ms there) — which is
+    why the guard budget below is max(1 ms, 3x floor) rather than a
+    bare constant: on real hardware the ISSUE's 1 ms bound is enforced
+    verbatim, on a slow VM the guard still catches the framework
+    regressing relative to what the machine can do (the documented
+    environmental-floor attribution lives in README Observability)."""
+    from downloader_tpu.scan import scan_dir
+
+    laps = []
+    for i in range(60):
+        start = time.perf_counter()
+        job_dir = tmp_path / f"floor-{i}"
+        os.makedirs(job_dir, exist_ok=True)
+        with open(job_dir / "tiny.mkv", "wb") as sink:
+            sink.write(b"x" * 1024)
+        scan_dir(str(job_dir))
+        laps.append((time.perf_counter() - start) * 1e3)
+    laps.sort()
+    return laps[len(laps) // 2]
+
+
+def test_batched_per_job_overhead_guard(tmp_path):
+    """ISSUE 6 acceptance: batched per-job framework overhead p50 <= 1 ms
+    (or <= 3x this host's measured syscall floor where that floor alone
+    exceeds the budget — the environmental escape the acceptance
+    criteria name, attributed in README Observability) — dequeue wave,
+    classification, per-job trace/watch/token, scan, coalesced publish
+    confirm, multiple-ack settle — with the transfer itself stubbed to
+    near-zero, in the spirit of the 2.5 ms tracing and 0.5 ms watchdog
+    guards. Measured at warning log level, as the bench does: per-job
+    info logging is itself ~1.5 ms at this scale and would measure the
+    logger, not the batching."""
+    from downloader_tpu.utils import logging as dlog
+
+    floor_ms = _environmental_floor_ms(tmp_path)
+    budget_ms = max(1.0, 3.0 * floor_ms)
+    dlog.configure(level="warning")
+    token = CancelToken()
+    broker = MemoryBroker()
+    config = Config(
+        broker="memory", base_dir=str(tmp_path), concurrency=1,
+        retry_delay=0.05,
+    )
+    config.batch_jobs = 16
+    config.batch_wait_ms = 300.0
+    client = QueueClient(
+        token, broker.connect, supervisor_interval=0.05, drain_timeout=5
+    )
+    client.set_prefetch(64)
+    dispatcher = DispatchClient(token, str(tmp_path), [_InstantBackend()])
+    uploader = Uploader(config.bucket, _NullStore())
+    daemon = Daemon(token, client, dispatcher, uploader, config)
+    runner = threading.Thread(target=daemon.run, daemon=True)
+    runner.start()
+
+    producer = broker.connect().channel()
+    converts = []
+    sink_channel = broker.connect().channel()
+    sink_channel.declare_exchange("v1.convert")
+    sink_channel.declare_queue("sink")
+    for i in range(2):
+        sink_channel.bind_queue("sink", "v1.convert", f"v1.convert-{i}")
+
+    def on_convert(message):
+        converts.append(Convert.unmarshal(message.body))
+        sink_channel.ack(message.delivery_tag)
+
+    sink_channel.consume("sink", on_convert)
+    time.sleep(0.2)  # consumers up
+
+    wave = 16
+    try:
+        laps = []
+        done = 0
+        for round_n in range(8):
+            start = time.monotonic()
+            for i in range(wave):
+                body = Download(
+                    media=Media(
+                        id=f"g-{round_n}-{i}",
+                        source_uri=f"http://guard/{round_n}/{i}.mkv",
+                    )
+                ).marshal()
+                producer.publish("v1.download", "v1.download-0", body)
+            done += wave
+            assert wait_for(
+                lambda: len(converts) >= done, timeout=30, interval=0.0005
+            )
+            laps.append((time.monotonic() - start) * 1e3 / wave)
+        laps.sort()
+        median = laps[len(laps) // 2]
+        assert median <= budget_ms, (
+            f"batched per-job framework overhead {median:.3f} ms — over "
+            f"the {budget_ms:.2f} ms budget (1 ms, or 3x this host's "
+            f"{floor_ms:.3f} ms syscall floor; ISSUE 6 acceptance); "
+            f"per-wave laps {[round(lap, 3) for lap in laps]}"
+        )
+        assert daemon.stats.processed == done
+    finally:
+        dlog.configure_from_env()
+        token.cancel()
+        runner.join(timeout=10)

@@ -469,6 +469,99 @@ class TestPublisherConfirms:
         assert redelivered.retries == 0  # the retry copy never landed
         redelivered.ack()
 
+    def test_back_to_back_publishes_flush_as_one_batch(self, broker, token):
+        """Publishes buffered while the publisher is busy drain as ONE
+        channel batch (publish_many) — one confirm wait for the lot,
+        visible on the coalescing counters (ISSUE 6 satellite)."""
+        from downloader_tpu.utils import metrics
+
+        before = metrics.GLOBAL.snapshot()
+        broker.hold_confirms = True
+        client = make_client(broker, token)
+        first = client.publish_async("t", b"a")
+        # the publisher is now wedged in `a`'s confirm wait; everything
+        # published meanwhile piles into the buffer
+        assert wait_for(lambda: len(broker._held) == 1)
+        later = [client.publish_async("t", f"m{i}".encode()) for i in range(3)]
+        broker.hold_confirms = False  # the broker catches up
+        broker.release_confirms()  # `a` confirms; the batch drains next
+        assert client.flush([first] + later, 10.0) == [True] * 4
+        after = metrics.GLOBAL.snapshot()
+        assert (
+            after.get("queue_publish_flushes", 0)
+            - before.get("queue_publish_flushes", 0)
+        ) >= 1
+        assert (
+            after.get("queue_publishes_coalesced", 0)
+            - before.get("queue_publishes_coalesced", 0)
+        ) >= 2
+        assert broker.queue_depth("t-0") + broker.queue_depth("t-1") == 4
+
+    def test_publish_many_failure_isolated_per_entry(self, broker):
+        """A failing publish inside a batch fails EXACTLY that entry:
+        batch-mates route and confirm normally (ISSUE 6 satellite —
+        the per-entry outcome contract of Channel.publish_many)."""
+        ch = broker.connect().channel()
+        ch.declare_exchange("t")
+        ch.declare_queue("t-0")
+        ch.bind_queue("t-0", "t", "t-0")
+        outcomes = ch.publish_many(
+            [
+                ("t", "t-0", b"ok1", {}),
+                ("missing-exchange", "rk", b"bad", {}),
+                ("t", "t-0", b"ok2", {}),
+            ]
+        )
+        assert outcomes[0] is None and outcomes[2] is None
+        assert isinstance(outcomes[1], BrokerError)
+        assert broker.queue_depth("t-0") == 2
+
+    def test_publish_many_held_batch_confirms_once_released(self, broker):
+        """Async-confirm batch: all entries stage, ONE wait covers them,
+        and release confirms the lot."""
+        broker.hold_confirms = True
+        ch = broker.connect().channel()
+        ch.declare_exchange("t")
+        ch.declare_queue("t-0")
+        ch.bind_queue("t-0", "t", "t-0")
+        ch.confirm_select()
+        ch.confirm_timeout = 5.0
+        outcomes = []
+        th = threading.Thread(
+            target=lambda: outcomes.extend(
+                ch.publish_many(
+                    [("t", "t-0", f"m{i}".encode(), {}) for i in range(3)]
+                )
+            )
+        )
+        th.start()
+        assert wait_for(lambda: len(broker._held) == 3)
+        assert broker.queue_depth("t-0") == 0  # staged, not routed
+        broker.release_confirms()
+        th.join(timeout=10)
+        assert outcomes == [None, None, None]
+        assert broker.queue_depth("t-0") == 3
+
+    def test_batch_confirm_failure_rebuffers_without_duplicates(
+        self, broker, token
+    ):
+        """A confirm failure mid-flush re-buffers the FAILED messages
+        only; after the supervisor rebuilds the publisher everything
+        lands exactly once — no loss, no duplicates."""
+        client = make_client(broker, token, publish_confirm_timeout=1.0)
+        broker.hold_confirms = True
+        a = client.publish_async("t", b"a")
+        assert wait_for(lambda: len(broker._held) == 1)
+        b = client.publish_async("t", b"b")
+        c = client.publish_async("t", b"c")
+        # the broker dies before confirming anything staged; the staged
+        # copy of `a` is lost with it (crash before persistence)
+        broker.drop_connections()
+        broker.hold_confirms = False
+        # supervisor reconnects; the publisher re-flushes all three
+        assert client.flush([a, b, c], 10.0) == [True, True, True]
+        assert broker.queue_depth("t-0") + broker.queue_depth("t-1") == 3
+
     def test_error_confirmed_exactly_when_broker_acks(self, broker, token):
         """Happy async path: error() blocks on the confirm, then acks the
         original; after release the retry copy is the only live message."""
