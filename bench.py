@@ -38,7 +38,9 @@ to skip the streaming-pipeline ablation, BENCH_PIPELINE_REPEATS
 (interleaved pipelined/store-and-forward pairs, default 3),
 BENCH_WATCHDOG=0 to skip the stall-watchdog heartbeat ablation,
 BENCH_SMALL=0 to skip the small-object batched/unbatched arm
-(BENCH_SMALL_WAVE jobs per wave, BENCH_SMALL_WAVES rounds).
+(BENCH_SMALL_WAVE jobs per wave, BENCH_SMALL_WAVES rounds),
+BENCH_OVERLOAD=0 to skip the overload-shedding arm (BENCH_OVERLOAD_JOBS
+interactive probes, BENCH_OVERLOAD_BULK bulk flood jobs).
 
 On the measurement noise: this box's absolute throughput swings ~3x on
 multi-second timescales (the same configuration has measured 85 and 580
@@ -248,6 +250,7 @@ class _Pipeline:
         segment_min_bytes: int | None = None,
         batch_jobs: int | None = None,
         batch_wait_ms: float | None = None,
+        quota_jobs: int | None = None,
     ):
         self.token = CancelToken()
         self.payload = payload
@@ -274,6 +277,8 @@ class _Pipeline:
                 self.config.batch_jobs = batch_jobs
             if batch_wait_ms is not None:
                 self.config.batch_wait_ms = batch_wait_ms
+            if quota_jobs is not None:
+                self.config.quota_tenant_jobs = quota_jobs
             connect = build_connection_factory(self.config)
             self.client = QueueClient(self.token, connect, drain_timeout=10.0)
             self.client.set_prefetch(self.config.prefetch)
@@ -343,11 +348,17 @@ class _Pipeline:
             self.close()
             raise
 
-    def publish_job(self, index: int) -> None:
+    def publish_job(
+        self,
+        index: int,
+        payload: "str | None" = None,
+        headers: "dict | None" = None,
+        media_id: "str | None" = None,
+    ) -> None:
         body = Download(
             media=Media(
-                id=f"bench-{index}",
-                source_uri=f"{self.base_url}/{self.payload}",
+                id=media_id or f"bench-{index}",
+                source_uri=f"{self.base_url}/{payload or self.payload}",
             )
         ).marshal()
         self.producer.publish(
@@ -356,6 +367,7 @@ class _Pipeline:
                 self.config.consume_topic, index % self.client._num_queues
             ),
             body,
+            headers=headers or {},
         )
 
     def wait_converts(self, n: int, timeout: float = 600.0) -> None:
@@ -731,6 +743,11 @@ def run_latency(
         pipeline.close()
 
 
+def _pct(values: "list[float]", q: float) -> float:
+    ordered = sorted(values)
+    return round(ordered[min(len(ordered) - 1, int(len(ordered) * q))], 2)
+
+
 def run_small_object_arm(
     site: str, wave: int = 16, waves: int = 3
 ) -> dict:
@@ -752,10 +769,6 @@ def run_small_object_arm(
         if not os.path.exists(path):
             with open(path, "wb") as sink:
                 sink.write(os.urandom(size))
-
-    def pct(values: list[float], q: float) -> float:
-        ordered = sorted(values)
-        return round(ordered[min(len(ordered) - 1, int(len(ordered) * q))], 2)
 
     out_sizes: dict = {}
     for label, size in sizes:
@@ -790,10 +803,10 @@ def run_small_object_arm(
             finally:
                 pipeline.close()
         entry = {
-            "unbatched_p50_ms": pct(laps["unbatched"], 0.5),
-            "unbatched_p99_ms": pct(laps["unbatched"], 0.99),
-            "batched_p50_ms": pct(laps["batched"], 0.5),
-            "batched_p99_ms": pct(laps["batched"], 0.99),
+            "unbatched_p50_ms": _pct(laps["unbatched"], 0.5),
+            "unbatched_p99_ms": _pct(laps["unbatched"], 0.99),
+            "batched_p50_ms": _pct(laps["batched"], 0.5),
+            "batched_p99_ms": _pct(laps["batched"], 0.99),
         }
         entry["batched_vs_unbatched"] = round(
             entry["unbatched_p50_ms"] / max(entry["batched_p50_ms"], 1e-9), 2
@@ -813,6 +826,111 @@ def run_small_object_arm(
         "wave": wave,
         "waves": waves,
         "sizes": out_sizes,
+    }
+
+
+def run_overload_arm(
+    site: str,
+    interactive_jobs: int = 6,
+    bulk_jobs: int = 4,
+    throttle_mbps: float = 2.0,
+) -> dict:
+    """Overload shedding ablation (ISSUE 7): one bulk tenant floods the
+    worker with large objects from a throttled origin while an
+    interactive tenant submits small jobs one at a time. Two arms over
+    identical load:
+
+    - **unprotected** (no per-tenant quota): bulk occupies every
+      worker; interactive latency absorbs the bulk transfer times.
+    - **protected** (``QUOTA_TENANT_JOBS=1``): one bulk job is
+      admitted, the rest are shed to the DLQ with Retry-After, and
+      interactive jobs ride the free worker.
+
+    Reported: interactive p50/p99 per arm, the protection ratio, and
+    how many jobs the protected arm shed."""
+    from downloader_tpu.queue.delivery import CLASS_HEADER, TENANT_HEADER
+    from downloader_tpu.utils import metrics as metrics_mod
+
+    bulk_payload = os.path.join(site, "overload_bulk.mkv")
+    tiny_payload = os.path.join(site, "overload_tiny.mkv")
+    if not os.path.exists(bulk_payload):
+        with open(bulk_payload, "wb") as sink:
+            sink.write(os.urandom(6 * 1024 * 1024))
+    if not os.path.exists(tiny_payload):
+        with open(tiny_payload, "wb") as sink:
+            sink.write(os.urandom(16 * 1024))
+    server = (_RANGE_SERVER, (str(throttle_mbps),))
+
+    def run_arm(quota_jobs: "int | None") -> dict:
+        shed_before = metrics_mod.GLOBAL.snapshot().get(
+            "admission_shed_jobs", 0
+        )
+        pipeline = _Pipeline(
+            2, 32, site, payload="overload_tiny.mkv",
+            server=server, batch_jobs=1, quota_jobs=quota_jobs,
+        )
+        try:
+            for i in range(bulk_jobs):
+                pipeline.publish_job(
+                    i, payload="overload_bulk.mkv",
+                    media_id=f"bulk-{i}",
+                    headers={TENANT_HEADER: "bulk-co", CLASS_HEADER: "bulk"},
+                )
+            time.sleep(0.5)  # let the bulk wave occupy what it can
+            laps: list[float] = []
+            for i in range(interactive_jobs):
+                media_id = f"int-{i}"
+                start = time.monotonic()
+                pipeline.publish_job(
+                    1000 + i, media_id=media_id,
+                    headers={
+                        TENANT_HEADER: "vip", CLASS_HEADER: "interactive",
+                    },
+                )
+                deadline = time.monotonic() + 120.0
+                while not any(
+                    c.media.id == media_id for c in pipeline.converts
+                ):
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"overload arm: {media_id} never converted"
+                        )
+                    time.sleep(0.002)
+                laps.append((time.monotonic() - start) * 1e3)
+        finally:
+            pipeline.close()
+        shed = (
+            metrics_mod.GLOBAL.snapshot().get("admission_shed_jobs", 0)
+            - shed_before
+        )
+        return {
+            "interactive_p50_ms": _pct(laps, 0.5),
+            "interactive_p99_ms": _pct(laps, 0.99),
+            "shed_jobs": shed,
+        }
+
+    unprotected = run_arm(None)
+    protected = run_arm(1)
+    ratio = round(
+        unprotected["interactive_p99_ms"]
+        / max(protected["interactive_p99_ms"], 1e-9),
+        2,
+    )
+    _log(
+        f"bench: overload shedding: interactive p99 "
+        f"{unprotected['interactive_p99_ms']:.0f} ms unprotected vs "
+        f"{protected['interactive_p99_ms']:.0f} ms protected "
+        f"({ratio:.1f}x), {protected['shed_jobs']} bulk jobs shed"
+    )
+    return {
+        "metric": "overload_shedding",
+        "unit": "ms",
+        "interactive_jobs": interactive_jobs,
+        "bulk_jobs": bulk_jobs,
+        "throttle_MBps_per_conn": throttle_mbps,
+        "unprotected": unprotected,
+        "protected": protected,
+        "protection_ratio": ratio,
     }
 
 
@@ -1059,6 +1177,22 @@ def main() -> None:
                 site, wave=small_wave, waves=small_waves
             )
 
+        overload = None
+        if os.environ.get("BENCH_OVERLOAD", "1") != "0":
+            _log(
+                "bench: overload-shedding arm, quota-protected vs "
+                "unprotected interactive latency under a bulk flood"
+            )
+            overload = run_overload_arm(
+                site,
+                interactive_jobs=max(
+                    2, int(os.environ.get("BENCH_OVERLOAD_JOBS", 6))
+                ),
+                bulk_jobs=max(
+                    1, int(os.environ.get("BENCH_OVERLOAD_BULK", 4))
+                ),
+            )
+
         watchdog_ablation = None
         if os.environ.get("BENCH_WATCHDOG", "1") != "0":
             _log(
@@ -1106,6 +1240,8 @@ def main() -> None:
             extra_metrics.append(segmented_ablation)
         if small_object is not None:
             extra_metrics.append(small_object)
+        if overload is not None:
+            extra_metrics.append(overload)
         if watchdog_ablation is not None:
             extra_metrics.append(watchdog_ablation)
         if os.environ.get("BENCH_DIGEST", "1") != "0":

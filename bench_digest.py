@@ -65,6 +65,17 @@ def digest_line(report: dict) -> dict:
                     "batched_p50_ms"
                 )
                 out[f"small_{label}_x"] = entry.get("batched_vs_unbatched")
+        elif metric == "overload_shedding":
+            protected = extra.get("protected") or {}
+            unprotected = extra.get("unprotected") or {}
+            out["overload_protected_p99_ms"] = protected.get(
+                "interactive_p99_ms"
+            )
+            out["overload_unprotected_p99_ms"] = unprotected.get(
+                "interactive_p99_ms"
+            )
+            out["overload_shed_jobs"] = protected.get("shed_jobs")
+            out["overload_protection_x"] = extra.get("protection_ratio")
         elif metric == "digest_kernel":
             out["hashlib_GBps"] = extra.get("hashlib_GBps")
             out["pallas_GBps"] = extra.get("pallas_GBps")

@@ -37,7 +37,7 @@ from ..queue.delivery import Delivery, ack_batch
 from ..scan import scan_dir
 from ..store import Uploader, UploadError
 from ..utils import metrics, configure_from_env, get_logger, tracing
-from ..utils import incident, watchdog
+from ..utils import admission, incident, watchdog
 from ..utils.cancel import Cancelled, CancelToken
 from ..wire import Convert, Download, WireError
 from .config import Config
@@ -51,6 +51,7 @@ class DaemonStats:
     failed: int = 0
     retried: int = 0
     dropped: int = 0
+    shed: int = 0  # explicitly load-shed to the DLQ (admission layer)
     lock: threading.Lock = field(default_factory=threading.Lock)
 
     def bump(self, **deltas: int) -> None:
@@ -113,6 +114,32 @@ class Daemon:
         self._config = config
         self.stats = DaemonStats()
         self._workers: list[threading.Thread] = []
+        # SLO-aware admission (utils/admission.py): the process-wide
+        # controller is configured from THIS daemon's config — budgets,
+        # per-tenant quotas, class weights, and the degradation-ladder
+        # thresholds all come from the same env contract
+        admission.CONTROLLER.configure(
+            budgets=config.admission_budgets or None,
+            quota_jobs=config.quota_tenant_jobs,
+            quota_bytes=config.quota_tenant_bytes,
+            weights=config.admission_weights or None,
+            shrink_at=config.admission_shrink_at,
+            pause_at=config.admission_pause_at,
+            shed_at=config.admission_shed_at,
+        )
+        # the prefetch to restore when the ladder steps back to normal
+        # (serve()/tests set the client's window before building us)
+        self._normal_prefetch = getattr(client, "prefetch", None)
+        self._ladder_lock = threading.Lock()
+        self._ladder_level = admission.LEVEL_NORMAL  # guarded-by: _ladder_lock
+        # serializes qos applies end to end (compute → wire → record):
+        # concurrent rung transitions must land their windows in order
+        # or a stale one sticks; leaf lock, nothing nests inside it but
+        # the client's own channel lock
+        self._prefetch_apply_lock = threading.Lock()
+        self._applied_prefetch = self._normal_prefetch  # guarded-by: _prefetch_apply_lock
+        # set by run(); sheds re-try the declare while it stays False
+        self._dlq_ready = False
 
     @property
     def worker_count(self) -> int:
@@ -157,8 +184,10 @@ class Daemon:
             return
 
         media = job.media
+        job_class = delivery.job_class or self._config.admission_default_class
         trace.annotate(
-            job_id=media.id, url=tracing.redact_url(media.source_uri)
+            job_id=media.id, url=tracing.redact_url(media.source_uri),
+            tenant=delivery.tenant, job_class=job_class,
         )
         job_log = log.with_fields(id=media.id, url=media.source_uri)
         job_log.info("got message")
@@ -166,9 +195,20 @@ class Daemon:
         if delivery.retries > 0:
             # pace retried jobs (the reference slept 10 s on the worker
             # before republishing, delivery.go:75; we delay on consume so
-            # the broker, not a timer, owns the in-flight message)
-            with tracing.span("retry-delay", retries=delivery.retries):
-                cancelled = self._token.wait(self._config.retry_delay)
+            # the broker, not a timer, owns the in-flight message).
+            # FULL-jitter capped exponential backoff: a shed-then-retry
+            # wave failed in sync, and a deterministic delay would
+            # re-arrive as the same thundering herd it came from
+            delay = admission.full_jitter(
+                delivery.retries - 1,
+                self._config.retry_delay,
+                self._config.retry_delay_cap,
+            )
+            with tracing.span(
+                "retry-delay", retries=delivery.retries,
+                jitter_s=round(delay, 3),
+            ):
+                cancelled = self._token.wait(delay)
             if cancelled:
                 delivery.nack(requeue=True)  # shutting down; give it back
                 trace.set_status("requeued")
@@ -182,6 +222,11 @@ class Daemon:
         # heartbeats as bytes actually flush.
         job_token = self._token.child()
         watch = watchdog.MONITOR.job(media.id, cancel=job_token.cancel)
+        if watch.kind == "job":
+            # the watchdog learns the job's lane: a stall incident tags
+            # the offending tenant, and /debug/watchdog shows which
+            # tenant's traffic is wedged
+            watch.meta.update(tenant=delivery.tenant, job_class=job_class)
         try:
             with watchdog.install(watch):
                 self._process_watched(
@@ -291,9 +336,17 @@ class Daemon:
         # the confirm-gated Convert hand-off); failed/retried attempts
         # are deliberately not mixed in — they would bimodalize the
         # distribution an operator alerts on
-        metrics.GLOBAL.observe(
-            "job_duration_seconds", time.monotonic() - started
-        )
+        elapsed = time.monotonic() - started
+        metrics.GLOBAL.observe("job_duration_seconds", elapsed)
+        self._observe_slo(delivery, elapsed)
+
+    def _observe_slo(self, delivery: Delivery, elapsed: float) -> None:
+        """Per-class SLO latency histogram: the series an operator
+        actually alerts on — interactive p99 must hold while bulk is
+        allowed to degrade, so the two classes must never share one
+        distribution."""
+        job_class = delivery.job_class or self._config.admission_default_class
+        metrics.GLOBAL.observe(f"slo_job_duration_seconds_{job_class}", elapsed)
 
     def _settle_transient(self, delivery, job_log, trace, exc) -> None:
         """One retry-or-drop policy for every transient job failure —
@@ -390,6 +443,11 @@ class Daemon:
     # ones overflows to the normal per-job path
     WAVE_BYTE_BUDGET_FACTOR = 4
 
+    # total seconds one admission wave may spend on byte-quota size
+    # probes (one stalling probe can still run to its own HTTP timeout;
+    # the budget stops the NEXT ones from stacking on top of it)
+    WAVE_PROBE_BUDGET_S = 2.0
+
     def process_batch(self, batch: "list[Delivery]") -> None:
         """Process one dequeue wave. Singleton waves take the unbatched
         path bit-for-bit. Larger waves are classified by (cached-)
@@ -458,6 +516,22 @@ class Daemon:
                 if self._token.cancelled():
                     delivery.nack(requeue=True)  # shutting down
                     continue
+                # the batch lane is itself a budgeted resource: when
+                # ADMISSION_BATCH_SLOTS is exhausted the job runs the
+                # normal per-job path instead — slower, but it doesn't
+                # widen the deferred-ack settle window. The slot is
+                # refunded when the delivery settles, whatever settles
+                # it (ack, retry, shed, crash backstop).
+                slot_key = admission.batch_slot_key()
+                if not admission.LEDGER.try_charge(
+                    "batch_slots", slot_key, 1
+                ):
+                    metrics.GLOBAL.add("admission_batch_slot_denials")
+                    self._process_safely(delivery)
+                    continue
+                delivery.add_settle_hook(
+                    lambda key=slot_key: admission.LEDGER.refund(key)
+                )
                 try:
                     outcome = self._run_fast_job(delivery, media)
                 except Exception as exc:  # never kill the batch
@@ -510,9 +584,9 @@ class Daemon:
             state.trace.root.set_status("ok")
             self._finish_fast_job(state)
             self.stats.bump(processed=1)
-            metrics.GLOBAL.observe(
-                "job_duration_seconds", time.monotonic() - state.started
-            )
+            elapsed = time.monotonic() - state.started
+            metrics.GLOBAL.observe("job_duration_seconds", elapsed)
+            self._observe_slo(state.delivery, elapsed)
 
     def _finish_fast_job(self, state: "_FastJob") -> None:
         state.trace.complete()
@@ -531,6 +605,9 @@ class Daemon:
         trace = tracing.TRACER.open_job(media.id)
         job_token = self._token.child()
         watch = watchdog.MONITOR.job(media.id, cancel=job_token.cancel)
+        job_class = delivery.job_class or self._config.admission_default_class
+        if watch.kind == "job":
+            watch.meta.update(tenant=delivery.tenant, job_class=job_class)
         job_log = log.with_fields(id=media.id, url=media.source_uri)
         keep = False
         try:
@@ -540,6 +617,8 @@ class Daemon:
                     job_id=media.id,
                     url=tracing.redact_url(media.source_uri),
                     batched=True,
+                    tenant=delivery.tenant,
+                    job_class=job_class,
                 )
                 root.record(
                     "dequeue", delivery.received_at, started,
@@ -628,6 +707,278 @@ class Daemon:
                 watchdog.MONITOR.unregister(watch)
                 job_token.detach()
 
+    # -- admission: weighted-fair waves, quotas, the shed path -------------
+
+    def _quota_size(self, delivery: Delivery) -> "int | None":
+        """Probed object size for the tenant byte quota — consulted
+        only when a byte quota is configured (the probe cache makes
+        repeats free; an unprobeable job charges zero bytes rather
+        than letting classification decide its fate)."""
+        media = self._peek_media(delivery)
+        if media is None:
+            return None
+        try:
+            return self._dispatcher.probe_size(
+                media.source_uri, token=self._token
+            )
+        except Exception as exc:
+            log.debug(f"quota size probe failed: {exc}")
+            return None
+
+    def _park_cap(self) -> int:
+        """How many paused-bulk deliveries may sit parked in lanes —
+        one wave's worth. Parked deliveries stay unacked, so the cap
+        also bounds how far the qos window must stretch to keep
+        interactive deliveries flowing past them."""
+        return max(1, self._config.batch_jobs)
+
+    def _ladder_prefetch(self, level: int) -> "int | None":
+        """The qos window the current rung wants. Below shrink: the
+        normal window. At shrink and above: the configured floor PLUS
+        the parked-bulk population — parked deliveries hold unacked
+        slots inside the window, and a window smaller than the parked
+        count wedges delivery entirely (the broker would never hand
+        the worker another interactive job: the head-of-line blocking
+        this layer exists to prevent)."""
+        if self._normal_prefetch is None:
+            return None
+        if level < admission.LEVEL_SHRINK:
+            return self._normal_prefetch
+        floor = max(1, self._config.admission_min_prefetch)
+        # the parked term applies at EVERY engaged rung, not just
+        # pause: bulk parked during a pause episode stays unacked
+        # after pressure eases to the shrink rung, and a window
+        # without the parked term would wedge behind it until the
+        # idle-tick waves drained every parked transfer
+        parked = admission.CONTROLLER.scheduler.pending({"bulk"})
+        return floor + min(parked, self._park_cap())
+
+    def _apply_ladder(self, level: int) -> None:
+        """Walk the degradation ladder's first rung: shrink the
+        prefetch window under pressure (an overloaded worker must stop
+        amplifying its own backlog), restore it when pressure clears.
+        The later rungs (pause bulk, shed) act per job in the wave
+        builder."""
+        with self._ladder_lock:
+            previous = self._ladder_level
+            self._ladder_level = level
+        shrink = admission.LEVEL_SHRINK
+        if level >= shrink and previous < shrink:
+            log.with_fields(
+                level=level, pressure=round(admission.LEDGER.pressure(), 3)
+            ).warning("admission ladder engaged: shrinking prefetch")
+        elif level < shrink and previous >= shrink:
+            log.info("admission pressure cleared: prefetch restored")
+        if self._normal_prefetch is None:
+            return
+        with self._prefetch_apply_lock:
+            # compute INSIDE the serialization, from the freshest
+            # recorded rung: a desired window computed outside could
+            # be applied after a racing transition's, sticking a stale
+            # window on the wire
+            with self._ladder_lock:
+                current = self._ladder_level
+            desired = self._ladder_prefetch(current)
+            if desired is not None and desired != self._applied_prefetch:
+                self._client.apply_prefetch(desired)
+                self._applied_prefetch = desired
+
+    def _admit_wave(self, batch: "list[Delivery]") -> "list[Delivery]":
+        """Order the dequeue wave with deficit round-robin across
+        (class, tenant) lanes, then run every candidate through the
+        admission verdict: admitted jobs form the processing wave
+        (quota release wired to settlement), deferred bulk re-parks in
+        its lane, rejected jobs shed to the DLQ right here."""
+        controller = admission.CONTROLLER
+        rung = controller.level()  # the whole wave sees ONE ladder rung
+        shed_any = False
+        park_cap = self._park_cap()
+        direct: "list[Delivery]" = []  # in no lane; must ride this wave
+        for delivery in batch:
+            try:
+                if delivery.job_class is None:
+                    delivery.job_class = self._config.admission_default_class
+                if (
+                    rung == admission.LEVEL_PAUSE_BULK
+                    and delivery.job_class == "bulk"
+                    and controller.scheduler.pending({"bulk"}) >= park_cap
+                ):
+                    # the paused lane is full: parking more would wedge
+                    # the shrunk qos window (parked unacked >= window)
+                    # AND grow worker memory unboundedly — overflow
+                    # walks the ladder's next rung instead
+                    shed_any = True
+                    self._shed_delivery(delivery, "bulk-paused-overflow")
+                    continue
+                controller.scheduler.offer(
+                    delivery, delivery.job_class, delivery.tenant
+                )
+            except Exception as exc:
+                # a delivery that reached neither a lane nor the DLQ
+                # would sit unacked forever; fail OPEN into the wave
+                log.with_fields(tenant=delivery.tenant).warning(
+                    f"admission intake failed; admitting job: {exc}"
+                )
+                if not delivery.settled:
+                    direct.append(delivery)
+        try:
+            # the window must reflect this wave's parked population
+            # before the broker decides whether to hand us more; a
+            # failed qos frame degrades the window, not the wave
+            self._apply_ladder(rung)
+        except Exception as exc:
+            log.warning(f"admission ladder apply failed: {exc}")
+        # pause parks bulk ONLY at its own rung: at the shed rung bulk
+        # candidates must still flow through decide() so the explicit
+        # shed-to-DLQ verdict (not an ever-growing parked lane) is what
+        # answers exhaustion
+        paused = (
+            frozenset(("bulk",))
+            if rung == admission.LEVEL_PAUSE_BULK
+            else frozenset()
+        )
+        candidates = controller.scheduler.take(
+            max(1, self._config.batch_jobs), paused
+        )
+        wave: "list[Delivery]" = []
+        # the byte-quota size probe is a synchronous HEAD against the
+        # job's own (possibly hostile, possibly slow) origin: bound the
+        # wave's total probe spend so one tenant's stalling origin
+        # cannot hold the whole wave — interactive probes first (DRR
+        # order); past the budget, candidates charge zero bytes (the
+        # job-count quota still binds), mirroring the unprobeable case
+        probe_deadline = time.monotonic() + self.WAVE_PROBE_BUDGET_S
+        for delivery in candidates:
+            try:
+                # cheap verdicts first: a candidate the job-count quota
+                # or the ladder rejects anyway must not spend a HEAD
+                # probe against its (possibly hostile) origin out of
+                # the wave's budget
+                decision = controller.precheck(
+                    delivery.job_class, delivery.tenant, rung
+                )
+                if decision is None:
+                    size = (
+                        self._quota_size(delivery)
+                        if controller.quota_bytes > 0
+                        and time.monotonic() < probe_deadline
+                        else None
+                    )
+                    decision = controller.decide(
+                        delivery.job_class, delivery.tenant, size, rung=rung
+                    )
+                if decision.action == "admit":
+                    delivery.add_settle_hook(decision.release)
+                    wave.append(delivery)
+                elif decision.action == "defer":
+                    # unreachable with a frozen wave rung (paused bulk
+                    # lanes are never taken at the defer-producing
+                    # rung); kept so a defer verdict from a future
+                    # live-rung decide parks instead of falling into
+                    # the shed arm
+                    controller.scheduler.offer(
+                        delivery, delivery.job_class, delivery.tenant
+                    )
+                else:
+                    shed_any = True
+                    self._shed_delivery(delivery, decision.reason)
+            except Exception as exc:
+                # a broken verdict must never strand a taken delivery
+                # unacked (it is in no lane now); fail OPEN into the
+                # wave — over-admitting degrades, stranding deadlocks
+                log.with_fields(tenant=delivery.tenant).warning(
+                    f"admission decision failed; admitting job: {exc}"
+                )
+                if not delivery.settled and delivery not in wave:
+                    wave.append(delivery)
+        if not shed_any:
+            controller.note_calm()
+        return wave + direct
+
+    def _shed_delivery(self, delivery: Delivery, reason: str) -> None:
+        """Execute one shed verdict: DLQ with Retry-After + capped
+        redelivery. The first shed of an overload episode captures an
+        incident bundle (on its own thread — the wave may still carry
+        interactive jobs that must not wait on a flight recorder)."""
+        config = self._config
+        if not self._dlq_ready:
+            # startup raced a down broker and the declare never
+            # happened: re-try it now, and if the DLQ still does not
+            # exist, DO NOT shed — an unroutable default-exchange
+            # publish still CONFIRMS (the broker drops it), so the
+            # "unconfirmable hand-off requeues" safety never engages
+            # and the job would be silently lost
+            self._dlq_ready = self._client.ensure_queue(
+                config.dead_letter_queue
+            )
+        if not self._dlq_ready:
+            log.with_fields(tenant=delivery.tenant, reason=reason).warning(
+                "DLQ not declared; requeueing instead of shedding"
+            )
+            delivery.nack(requeue=True)
+            return
+        retry_after = admission.retry_after_for(
+            delivery.shed_count,
+            config.dlq_retry_after_base,
+            config.dlq_retry_after_cap,
+        )
+        outcome = delivery.shed(
+            config.dead_letter_queue,
+            reason,
+            retry_after,
+            max_sheds=config.dlq_max_redeliver,
+        )
+        if outcome == "already-settled":
+            # a watchdog cancel or crash backstop settled the delivery
+            # between the lane take and this verdict: nothing was shed,
+            # nothing bounced — not an event
+            return
+        if outcome == "requeued":
+            # the DLQ hand-off never confirmed: the job went back to
+            # the broker, so nothing was actually shed — counting it
+            # would let jobs_shed outrun dlq_published and burn the
+            # episode's one incident capture on a non-event
+            log.with_fields(
+                tenant=delivery.tenant, reason=reason,
+            ).warning("shed hand-off unconfirmed; job requeued instead")
+            return
+        if admission.CONTROLLER.note_shed(delivery.tenant, reason):
+            extra = {
+                "tenant": delivery.tenant,
+                "job_class": delivery.job_class,
+                "shed_reason": reason,
+                "tripped_budget": admission.LEDGER.tripped(),
+                "pressure": round(admission.LEDGER.pressure(), 4),
+            }
+
+            def _capture():
+                bundle = incident.RECORDER.capture(
+                    f"admission shed ({reason})",
+                    trigger="admission",
+                    extra=extra,
+                )
+                if bundle is None:
+                    # suppressed by the recorder's shared auto rate
+                    # limit: don't burn the episode's one capture on it
+                    admission.CONTROLLER.rearm_episode()
+
+            try:
+                threading.Thread(
+                    target=_capture, name="admission-capture", daemon=True
+                ).start()
+            except RuntimeError:
+                # thread exhaustion IS the overload regime; capture
+                # inline rather than losing the episode's one bundle
+                try:
+                    _capture()
+                except Exception as exc:
+                    log.warning(f"admission incident capture failed: {exc}")
+        self.stats.bump(shed=1)
+        log.with_fields(
+            tenant=delivery.tenant, job_class=delivery.job_class or "",
+            reason=reason, outcome=outcome, retry_after_s=retry_after,
+        ).warning("admission shed job to the dead-letter queue")
+
     # -- worker loop -----------------------------------------------------
 
     def _worker(self, deliveries: "queue_mod.Queue[Delivery]") -> None:
@@ -643,13 +994,43 @@ class Daemon:
                 try:
                     delivery = deliveries.get(timeout=0.2)
                 except queue_mod.Empty:
-                    continue
+                    delivery = None
+                    if admission.CONTROLLER.scheduler.pending() == 0:
+                        # an idle tick also closes any open overload
+                        # episode (pressure permitting) — _admit_wave
+                        # never runs again on a drained queue, and the
+                        # NEXT overload's first shed must capture a
+                        # fresh incident
+                        admission.CONTROLLER.note_calm()
+                        continue
+                    # parked lane work (deferred bulk, a deeper wave
+                    # than one take could admit): build a wave from
+                    # the lanes alone
                 with watch.suspend():
-                    batch = self._collect_batch(delivery, deliveries)
+                    batch = (
+                        self._collect_batch(delivery, deliveries)
+                        if delivery is not None
+                        else []
+                    )
                     try:
-                        self.process_batch(batch)
+                        wave = self._admit_wave(batch)
                     except Exception as exc:  # never kill the worker thread
-                        for stranded in batch:
+                        # last-resort backstop: intake, ladder, and
+                        # verdicts all fail open INSIDE _admit_wave, so
+                        # reaching here means the lane take itself blew
+                        # up — the batch is already offered into the
+                        # shared lanes, where the next tick (any
+                        # worker's) picks it up; re-processing it here
+                        # would double-run deliveries other workers can
+                        # also take
+                        log.warning(f"admission wave failed: {exc}")
+                        wave = []
+                    if not wave:
+                        continue
+                    try:
+                        self.process_batch(wave)
+                    except Exception as exc:  # never kill the worker thread
+                        for stranded in wave:
                             if not stranded.settled:
                                 self._settle_crashed(stranded, exc)
         finally:
@@ -658,6 +1039,11 @@ class Daemon:
     def run(self) -> None:
         """Start consuming; returns once cancellation completes drain."""
         deliveries = self._client.consume(self._config.consume_topic)
+        # the DLQ must exist before the first shed: the default
+        # exchange silently drops messages routed to undeclared queues
+        self._dlq_ready = self._client.ensure_queue(
+            self._config.dead_letter_queue
+        )
         for index in range(max(1, self._config.concurrency)):
             worker = threading.Thread(
                 target=self._worker,
@@ -678,6 +1064,10 @@ class Daemon:
         # a consumer is still live would bounce each message straight
         # back into the sink in a hot loop until the drain timeout.
         self._client.stop_consuming()
+        # deliveries parked in admission lanes (paused bulk, deferred
+        # quota waiters) go back to the broker like the sink leftovers
+        for parked in admission.CONTROLLER.scheduler.drain():
+            parked.nack(requeue=True)
         while True:
             try:
                 leftover = deliveries.get_nowait()
@@ -696,7 +1086,15 @@ def capture_stall_incident(watch, stage: str, idle: float) -> None:
     """The watchdog→flight-recorder hand-off: a stall episode captures
     one bounded incident bundle (utils/incident.py rate-limits mass
     stalls) carrying the job's trace, thread stacks, and subsystem
-    internals."""
+    internals — tagged with the stalled job's lane (tenant + class),
+    so a wedged tenant is identifiable from the bundle alone."""
+    meta = dict(getattr(watch, "meta", None) or {})
+    tenant = meta.get("tenant")
+    if tenant:
+        # lane bookkeeping: /debug/admission shows which tenants have
+        # stalled jobs (the quota itself refunds on settlement, so a
+        # cancelled stall frees its slot instead of leaking it)
+        admission.CONTROLLER.note_stall(tenant)
     incident.RECORDER.capture(
         reason=(
             f"watchdog: no forward progress in stage '{stage}' "
@@ -704,7 +1102,10 @@ def capture_stall_incident(watch, stage: str, idle: float) -> None:
         ),
         job_id=watch.name if watch.kind == "job" else None,
         trigger="watchdog",
-        extra={"watch": watch.name, "kind": watch.kind, "stage": stage},
+        extra={
+            "watch": watch.name, "kind": watch.kind, "stage": stage,
+            **meta,
+        },
     )
 
 

@@ -44,6 +44,9 @@ class Config:
     concurrency: int = 1
     max_job_retries: int = 3
     retry_delay: float = 10.0  # reference delivery.go:75
+    # cap on the full-jitter retry backoff window: attempt n of a
+    # transient settle waits uniform[0, min(cap, retry_delay * 2^(n-1)))
+    retry_delay_cap: float = 60.0
     publish_confirm_timeout: float = 30.0  # Convert hand-off confirmation
     health_port: int = 0  # 0 = disabled
     health_host: str = "127.0.0.1"  # bind loopback unless told otherwise
@@ -74,6 +77,28 @@ class Config:
     watchdog_stages: "dict[str, float]" = field(default_factory=dict)
     incident_dir: str = ""
     incident_keep: int = 16
+    # SLO-aware admission (utils/admission.py): class/tenant headers,
+    # weighted-fair dequeue, per-tenant quotas, resource budgets, the
+    # degradation ladder, and the DLQ shed contract
+    admission_default_class: str = "bulk"
+    admission_budgets: "dict[str, int]" = field(default_factory=dict)
+    admission_weights: "dict[str, int]" = field(default_factory=dict)
+    admission_shrink_at: float = 0.75
+    admission_pause_at: float = 0.90
+    admission_shed_at: float = 1.0
+    admission_min_prefetch: int = 1
+    quota_tenant_jobs: int = 0  # 0 = unlimited
+    quota_tenant_bytes: int = 0  # 0 = unlimited
+    dlq_queue: str = ""  # empty: <consume_topic>.dlq
+    dlq_max_redeliver: int = 3
+    dlq_retry_after_base: float = 5.0
+    dlq_retry_after_cap: float = 300.0
+
+    @property
+    def dead_letter_queue(self) -> str:
+        from ..queue.delivery import dlq_name
+
+        return self.dlq_queue or dlq_name(self.consume_topic)
 
     @classmethod
     def from_env(cls, environ: Mapping[str, str] | None = None) -> "Config":
@@ -100,6 +125,9 @@ class Config:
             env.get("MAX_JOB_RETRIES", config.max_job_retries)
         )
         config.retry_delay = float(env.get("RETRY_DELAY", config.retry_delay))
+        config.retry_delay_cap = float(
+            env.get("RETRY_DELAY_CAP", config.retry_delay_cap)
+        )
         config.publish_confirm_timeout = float(
             env.get("PUBLISH_CONFIRM_TIMEOUT", config.publish_confirm_timeout)
         )
@@ -135,4 +163,28 @@ class Config:
         config.watchdog_stages = watchdog.stage_overrides_from_env(env)
         config.incident_dir = incident.dir_from_env(env)
         config.incident_keep = incident.keep_from_env(env)
+        from ..utils import admission
+
+        config.admission_default_class = admission.default_class_from_env(env)
+        config.admission_budgets = admission.budgets_from_env(env)
+        config.admission_weights = admission.class_weights_from_env(env)
+        (
+            config.admission_shrink_at,
+            config.admission_pause_at,
+            config.admission_shed_at,
+        ) = admission.ladder_from_env(env)
+        config.admission_min_prefetch = admission.min_prefetch_from_env(env)
+        config.quota_tenant_jobs, config.quota_tenant_bytes = (
+            admission.quotas_from_env(env)
+        )
+        config.dlq_queue = env.get("DLQ_QUEUE", config.dlq_queue).strip()
+        config.dlq_max_redeliver = int(
+            env.get("DLQ_MAX_REDELIVER", config.dlq_max_redeliver)
+        )
+        config.dlq_retry_after_base = float(
+            env.get("DLQ_RETRY_AFTER_BASE", config.dlq_retry_after_base)
+        )
+        config.dlq_retry_after_cap = float(
+            env.get("DLQ_RETRY_AFTER_CAP", config.dlq_retry_after_cap)
+        )
         return config

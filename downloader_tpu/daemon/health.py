@@ -41,7 +41,7 @@ import http.server
 import json
 import threading
 
-from ..utils import get_logger, incident, metrics, tracing, watchdog
+from ..utils import admission, get_logger, incident, metrics, tracing, watchdog
 from ..utils.logging import ring_tail
 
 log = get_logger("daemon.health")
@@ -69,6 +69,8 @@ class HealthServer:
                         code, body, ctype = health._debug_trace()
                     elif self.path == "/debug/watchdog":
                         code, body, ctype = health._debug_watchdog()
+                    elif self.path == "/debug/admission":
+                        code, body, ctype = health._debug_admission()
                     elif self.path == "/debug/logs":
                         code, body, ctype = health._debug_logs()
                     elif self.path == "/debug/incidents":
@@ -137,6 +139,7 @@ class HealthServer:
             "jobs_failed": stats.failed,
             "jobs_retried": stats.retried,
             "jobs_dropped": stats.dropped,
+            "jobs_shed": stats.shed,
             "queue_published": queue_stats.published,
             "queue_delivered": queue_stats.delivered,
             "queue_publish_retries": queue_stats.publish_retries,
@@ -179,6 +182,17 @@ class HealthServer:
 
     def _debug_watchdog(self) -> tuple[int, bytes, str]:
         payload = watchdog.MONITOR.snapshot()
+        return (
+            200,
+            (json.dumps(payload, indent=1) + "\n").encode(),
+            "application/json",
+        )
+
+    def _debug_admission(self) -> tuple[int, bytes, str]:
+        """The admission layer's live state: ladder rung, ledger
+        budgets and usage, per-tenant in-flight, lane depths — the
+        overload-triage view (which tenant, which budget, which rung)."""
+        payload = admission.CONTROLLER.snapshot()
         return (
             200,
             (json.dumps(payload, indent=1) + "\n").encode(),
@@ -270,6 +284,11 @@ class HealthServer:
                 for name in (
                     "job_duration_seconds", "fetch_seconds",
                     "scan_seconds", "upload_seconds", "publish_seconds",
+                    # per-class SLO series: present from the first
+                    # scrape so an interactive-p99 alert can use
+                    # absent()-free expressions before any traffic
+                    "slo_job_duration_seconds_interactive",
+                    "slo_job_duration_seconds_bulk",
                 )
             },
             "overhead_seconds": (

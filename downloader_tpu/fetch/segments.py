@@ -48,7 +48,7 @@ import time
 import urllib.parse
 import urllib.request
 
-from ..utils import get_logger, incident, metrics, tracing, watchdog
+from ..utils import admission, get_logger, incident, metrics, tracing, watchdog
 from ..utils.cancel import Cancelled, CancelToken
 from . import progress as transfer_progress
 from .connpool import ConnectionPool
@@ -743,6 +743,16 @@ class SegmentedFetcher:
 
         journal = SpanJournal.open(journal_path, probe.total, probe.validator)
         part_file = open(part_path, "r+b" if os.path.exists(part_path) else "w+b")
+        # scratch-disk budget (utils/admission.py): the preallocation
+        # below commits `total` bytes of scratch, so the global ledger
+        # is charged here and refunded when this fetch stops being the
+        # one holding the scratch (success, failure, or cancel — a
+        # kept-on-disk resume file is idle capacity, not active
+        # pressure). `charge`, not `try_charge`: the job was already
+        # admitted, so the allocation proceeds and the admission ladder
+        # reacts to the recorded pressure at the next dequeue wave.
+        scratch = admission.scratch_key(part_path)
+        admission.LEDGER.charge("disk", scratch, probe.total)
         try:
             os.truncate(part_file.fileno(), probe.total)
 
@@ -818,6 +828,8 @@ class SegmentedFetcher:
             part_file.close()
             journal.close()
             raise
+        finally:
+            admission.LEDGER.refund(scratch)
         part_file.close()
 
         os.replace(part_path, final_path)

@@ -174,6 +174,57 @@ class QueueClient:
     def set_prefetch(self, prefetch: int) -> None:
         self._prefetch = prefetch
 
+    @property
+    def prefetch(self) -> int:
+        return self._prefetch
+
+    def apply_prefetch(self, prefetch: int) -> None:
+        """Change the unacked window NOW, on the live shard channels,
+        not just for channels created later — the admission ladder's
+        first degradation rung shrinks prefetch so an overloaded worker
+        stops amplifying its own backlog. A channel that refuses the
+        qos update keeps its old window until the supervisor rebuilds
+        it; new channels always pick up the latest value."""
+        self._prefetch = prefetch
+        with self._lock:
+            channels = [
+                shard.channel
+                for shard in self._shards.values()
+                if shard.channel is not None
+            ]
+        for channel in channels:
+            try:
+                channel.set_prefetch(prefetch)
+            except BrokerError as exc:
+                log.debug(f"live prefetch update failed on a shard: {exc}")
+        metrics.GLOBAL.gauge_set("admission_prefetch", prefetch)
+
+    def ensure_queue(self, name: str) -> bool:
+        """Declare a bare queue (no exchange binding) — the DLQ the
+        shed path publishes to via the default exchange. Must exist
+        BEFORE the first shed: the default exchange silently drops
+        messages routed to a queue nobody declared. Returns whether
+        the declare succeeded (a down broker is not fatal here; the
+        shed path falls back to requeue when its publish can't
+        confirm)."""
+        try:
+            channel = self._channel()
+        except BrokerError as exc:
+            log.warning(f"failed to declare queue '{name}': {exc}")
+            return False
+        try:
+            channel.declare_queue(name)
+            return True
+        except BrokerError as exc:
+            log.warning(f"failed to declare queue '{name}': {exc}")
+            return False
+        finally:
+            try:
+                channel.close()
+            except BrokerError:
+                log.debug(f"channel close after declaring '{name}' failed")
+
+
     def connected(self) -> bool:
         """Whether the broker connection is currently up (health checks)."""
         with self._lock:

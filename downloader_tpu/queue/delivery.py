@@ -22,12 +22,29 @@ import threading
 import time
 from typing import Callable
 
-from ..utils import get_logger, metrics
+from ..utils import admission, get_logger, metrics
 from .broker import BrokerError, Channel, Message
 
 log = get_logger("queue")
 
 RETRY_HEADER = "X-Retries"
+# admission/QoS headers (utils/admission.py consumes them): producers
+# stamp a job class and tenant id; absent/garbage values fall back to
+# the worker's configured defaults
+CLASS_HEADER = "X-Job-Class"
+TENANT_HEADER = "X-Tenant"
+# the DLQ contract for shed jobs: how many times this message has been
+# shed, when a re-injector may retry it, why it was shed, and — past
+# the redelivery cap — a terminal marker re-injectors must honor
+SHED_HEADER = "X-Shed-Count"
+RETRY_AFTER_HEADER = "X-Retry-After"
+SHED_REASON_HEADER = "X-Shed-Reason"
+DEAD_HEADER = "X-Dead"
+
+
+def dlq_name(topic: str) -> str:
+    """The dead-letter queue paired with a consume topic."""
+    return f"{topic}.dlq"
 
 
 def ack_batch(deliveries: "list[Delivery]") -> int:
@@ -115,19 +132,57 @@ class Delivery:
         self.queue_name = ""
         retries = message.headers.get(RETRY_HEADER, 0)
         self.retries = retries if isinstance(retries, int) else 0
+        sheds = message.headers.get(SHED_HEADER, 0)
+        self.shed_count = sheds if isinstance(sheds, int) else 0
+        # admission identity from headers; job_class stays None when
+        # the producer didn't classify (the admission layer applies
+        # the configured default), tenant always resolves
+        raw_class = message.headers.get(CLASS_HEADER)
+        self.job_class: "str | None" = (
+            admission.normalize_class(raw_class, default="")
+            or None
+        )
+        self.tenant = admission.normalize_tenant(
+            message.headers.get(TENANT_HEADER)
+        )
         self._channel = channel
         self._on_settled = on_settled
         self._publisher = publisher
         self._publish_confirm_timeout = publish_confirm_timeout
         self._settled = False
         self._lock = threading.Lock()
+        self._settle_hooks: "list[Callable[[], None]]" = []  # guarded-by: _lock
+
+    def add_settle_hook(self, hook: "Callable[[], None]") -> None:
+        """Run ``hook`` exactly once when this delivery settles (ack,
+        nack, error, or shed — whichever happens first). The admission
+        layer hangs quota releases here so a slot is refunded on EVERY
+        outcome, including a watchdog-cancelled stall, without the
+        daemon enumerating settle sites. A hook added after settlement
+        runs immediately (the release must not be lost to the race)."""
+        with self._lock:
+            if not self._settled:
+                self._settle_hooks.append(hook)
+                return
+        self._run_hook(hook)
+
+    @staticmethod
+    def _run_hook(hook) -> None:
+        try:
+            hook()
+        except Exception as exc:
+            # a broken release hook must not poison the settle path
+            log.warning(f"delivery settle hook raised: {exc}")
 
     def _settle(self) -> bool:
         with self._lock:
             if self._settled:
                 return False
             self._settled = True
+            hooks, self._settle_hooks = self._settle_hooks, []
         self._on_settled(self)
+        for hook in hooks:
+            self._run_hook(hook)
         return True
 
     @property
@@ -208,3 +263,74 @@ class Delivery:
             # ack lost -> original redelivers -> duplicate retry; that is
             # at-least-once, not loss
             log.warning(f"failed to ack message post-retry: {exc}")
+
+    def shed(
+        self,
+        dlq_queue: str,
+        reason: str,
+        retry_after: int,
+        max_sheds: int = 3,
+    ) -> str:
+        """Explicitly shed this job to the dead-letter queue instead of
+        silently requeueing it forever: publish the body to
+        ``dlq_queue`` (default exchange, so the queue name IS the
+        routing key) with ``X-Shed-Count`` incremented,
+        ``X-Retry-After`` seconds a re-injector must wait, and
+        ``X-Shed-Reason``; then ack the original. Past ``max_sheds``
+        the message is additionally stamped ``X-Dead`` — it stays in
+        the DLQ for operators, and re-injectors must not replay it
+        (the capped-redelivery half of the contract).
+
+        The DLQ hand-off is CONFIRMED before the ack, exactly like
+        ``error()``: an unconfirmable hand-off requeue-nacks the
+        original instead (at-least-once, never loss). Returns the
+        outcome: ``"dlq"``, ``"dead"``, ``"requeued"``, or
+        ``"already-settled"`` (another path — a watchdog cancel, a
+        crash backstop — settled the delivery first; nothing was shed
+        and nothing went back to the broker)."""
+        if not self._settle():
+            return "already-settled"
+        headers = dict(self.message.headers)
+        new_count = self.shed_count + 1
+        headers[SHED_HEADER] = new_count
+        headers[RETRY_AFTER_HEADER] = max(0, int(retry_after))
+        headers[SHED_REASON_HEADER] = str(reason)[:200]
+        dead = new_count > max_sheds
+        if dead:
+            headers[DEAD_HEADER] = (
+                f"shed {new_count} times (cap {max_sheds})"
+            )
+        try:
+            if self._publisher is not None:
+                confirmed = self._publisher(
+                    "",  # default exchange: routing key IS the queue
+                    self.body,
+                    headers,
+                    wait=self._publish_confirm_timeout,
+                    routing_key=dlq_queue,
+                )
+            else:
+                self._channel.publish(
+                    "", dlq_queue, self.body, headers=headers
+                )
+                confirmed = True
+        except BrokerError as exc:
+            log.warning(f"failed to publish shed message to DLQ: {exc}")
+            confirmed = False
+        if not confirmed:
+            log.warning("DLQ hand-off unconfirmed; requeueing original")
+            try:
+                self._channel.nack(self.message.delivery_tag, requeue=True)
+            except BrokerError as nack_exc:
+                log.warning(f"failed to requeue message: {nack_exc}")
+            return "requeued"
+        try:
+            self._channel.ack(self.message.delivery_tag)
+        except BrokerError as exc:
+            # ack lost -> original redelivers -> duplicate shed; the
+            # DLQ may hold two copies, which is at-least-once, not loss
+            log.warning(f"failed to ack message post-shed: {exc}")
+        metrics.GLOBAL.add("dlq_published")
+        if dead:
+            metrics.GLOBAL.add("dlq_dead_jobs")
+        return "dead" if dead else "dlq"

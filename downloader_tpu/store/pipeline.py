@@ -45,7 +45,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 
 from ..fetch.progress import SpanSet  # noqa: F401  (re-export: span math lives with the writers)
 from ..scan import MEDIA_EXTENSIONS
-from ..utils import get_logger, incident, metrics, tracing, watchdog
+from ..utils import admission, get_logger, incident, metrics, tracing, watchdog
 from ..utils.cancel import Cancelled, CancelToken
 from .s3 import S3Client, S3Error
 from .uploader import object_key
@@ -183,6 +183,23 @@ class _FileStream:
         lo, hi = self.plan.part_range(number)
         length = hi - lo
         session = self._session
+        # part-pool memory budget (utils/admission.py): each in-flight
+        # part charges its window against the global memory ledger and
+        # refunds it when the upload settles. An exhausted budget fails
+        # THIS stream (→ store-and-forward fallback) instead of queueing
+        # more buffered parts behind an already-full pool — streaming is
+        # an optimization, and under memory pressure it is the first
+        # thing the degradation ladder gives back.
+        budget_key = admission.part_key(self.upload_id, number)
+        if not admission.LEDGER.try_charge("memory", budget_key, length):
+            metrics.GLOBAL.add("admission_memory_denials")
+            with session._lock:
+                if not self.failed:
+                    self.failed = f"part {number}: memory budget exhausted"
+            log.with_fields(key=self.key, part=number).info(
+                "part-pool memory budget exhausted; will fall back"
+            )
+            return
         metrics.GLOBAL.gauge_add("pipeline_parts_in_flight", 1)
         metrics.GLOBAL.gauge_add("pipeline_bytes_in_flight", length)
         try:
@@ -210,6 +227,7 @@ class _FileStream:
                 f"streamed part failed; will fall back ({exc})"
             )
         finally:
+            admission.LEDGER.refund(budget_key)
             metrics.GLOBAL.gauge_add("pipeline_parts_in_flight", -1)
             metrics.GLOBAL.gauge_add("pipeline_bytes_in_flight", -length)
 

@@ -216,12 +216,15 @@ class IncidentRecorder:
         persisted and retained)."""
         now = time.time()
         with self._lock:
+            # every automatic trigger shares one rate limit: a mass
+            # stall (watchdog) or a shed storm (admission) must not
+            # turn the flight recorder into its own incident
+            auto = trigger != "manual"
             suppressed = (
-                trigger == "watchdog"
-                and now - self._last_auto < self.min_auto_interval
+                auto and now - self._last_auto < self.min_auto_interval
             )
             if not suppressed:
-                if trigger == "watchdog":
+                if auto:
                     self._last_auto = now
                 self._seq += 1
                 seq = self._seq

@@ -79,6 +79,31 @@ HELP = {
     "queue_publishes_coalesced": "confirm waits saved by publisher flush batching",
     "http_small_fetches": "small objects fetched whole over one pooled connection",
     "http_probe_cache_hits": "HEAD probes answered from the probe cache",
+    "jobs_shed": "jobs explicitly load-shed to the dead-letter queue",
+    "admission_shed_jobs": "jobs shed by the admission layer (overload or quota)",
+    "admission_quota_rejects": "jobs rejected by per-tenant in-flight quotas",
+    "admission_batch_slot_denials": (
+        "fast-lane jobs diverted to the per-job path by the batch-slot budget"
+    ),
+    "admission_memory_denials": (
+        "streamed parts refused by the part-pool memory budget (fallback)"
+    ),
+    "admission_inflight_jobs": "jobs currently admitted and in flight",
+    "admission_lane_depth": "deliveries parked in admission lanes",
+    "admission_pressure": "utilization of the tightest ledger budget (0..1+)",
+    "admission_level": (
+        "degradation ladder rung: 0 normal, 1 shrink-prefetch, "
+        "2 pause-bulk, 3 shed"
+    ),
+    "admission_prefetch": "the prefetch window currently applied to consumers",
+    "dlq_published": "shed jobs handed to the dead-letter queue",
+    "dlq_dead_jobs": "shed jobs past the redelivery cap (terminal, X-Dead)",
+    "slo_job_duration_seconds_interactive": (
+        "completed interactive-class job latency, consume to ack"
+    ),
+    "slo_job_duration_seconds_bulk": (
+        "completed bulk-class job latency, consume to ack"
+    ),
     "watchdog_stalls": "stall episodes flagged (no forward progress)",
     "watchdog_cancels": "stalled jobs cancelled (WATCHDOG_ACTION=cancel)",
     "watchdog_stalled_tasks": "watched tasks currently flagged as stalled",

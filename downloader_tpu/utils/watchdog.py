@@ -583,6 +583,10 @@ class Watchdog:
                 "stall_count": watch.stall_count,
                 "counts": watch.counts(),
             }
+            if watch.meta:
+                # lane identity (tenant / job class, set by the daemon):
+                # a stalled entry names whose traffic is wedged
+                entry["meta"] = dict(watch.meta)
             if active and seen and seen[0] == active[0]:
                 entry["idle_s"] = round(now - seen[2], 3)
                 entry["deadline_s"] = self.deadline_for(watch, active[0])

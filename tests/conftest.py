@@ -49,6 +49,24 @@ _LOCK_ORDER_MODULES = {
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _admission_ledger_balances():
+    """The admission ledger must balance to ZERO after every test:
+    charge/refund are idempotent per key (double-settle safe), so any
+    outstanding charge at teardown is a real leak — a slot or byte
+    budget that production would never get back. The check runs after
+    the test's own fixtures tore down (daemons joined, pools drained),
+    then resets the process-wide admission state for isolation."""
+    from downloader_tpu.utils import admission
+
+    yield
+    outstanding = admission.LEDGER.outstanding()
+    admission.CONTROLLER.reset()  # also resets the shared LEDGER
+    assert not outstanding, (
+        f"admission ledger leaked charges: {outstanding}"
+    )
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _runtime_lock_order_guard(request):
     module = request.module.__name__

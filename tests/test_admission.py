@@ -1,0 +1,491 @@
+"""SLO-aware admission (ISSUE 7): the resource ledger's idempotent
+charge/refund discipline, deficit-round-robin fairness across classes
+and tenants, per-tenant in-flight quotas, the degradation ladder, the
+DLQ shed contract (Retry-After + capped redelivery), and the
+full-jitter retry backoff bounds."""
+
+import random
+import threading
+
+import pytest
+
+from downloader_tpu.daemon.health import HealthServer
+from downloader_tpu.queue.delivery import (
+    CLASS_HEADER,
+    DEAD_HEADER,
+    RETRY_AFTER_HEADER,
+    SHED_HEADER,
+    SHED_REASON_HEADER,
+    TENANT_HEADER,
+    Delivery,
+    dlq_name,
+)
+from downloader_tpu.queue.memory import MemoryBroker
+from downloader_tpu.utils import admission, metrics
+from downloader_tpu.utils.admission import (
+    AdmissionController,
+    DeficitScheduler,
+    Ledger,
+    full_jitter,
+    retry_after_for,
+)
+
+
+# ---------------------------------------------------------------------------
+# full-jitter backoff (satellite: pinned bounds)
+
+
+def test_full_jitter_bounds_pinned():
+    """Every sample must land in [0, min(cap, base * 2**attempt)) —
+    the capped-exponential full-jitter window, never outside it."""
+    rng = random.Random(42)
+    base, cap = 10.0, 60.0
+    for attempt in range(7):
+        ceiling = min(cap, base * (2 ** attempt))
+        samples = [full_jitter(attempt, base, cap, rng) for _ in range(500)]
+        assert all(0.0 <= s < ceiling + 1e-9 for s in samples), (
+            f"attempt {attempt}: sample escaped [0, {ceiling})"
+        )
+        # FULL jitter: the whole window is used, not a band near the
+        # ceiling (that would re-synchronize the herd)
+        assert min(samples) < ceiling * 0.2
+        assert max(samples) > ceiling * 0.8
+
+
+def test_full_jitter_degenerate_inputs():
+    assert full_jitter(0, 0.0, 60.0) == 0.0
+    assert full_jitter(-5, 10.0, 60.0) <= 10.0
+    # absurd attempt counts must not overflow past the cap
+    assert full_jitter(10_000, 10.0, 60.0) <= 60.0
+
+
+def test_retry_after_hint_is_capped_exponential():
+    assert retry_after_for(0, 5.0, 300.0) == 5
+    assert retry_after_for(2, 5.0, 300.0) == 20
+    assert retry_after_for(10, 5.0, 300.0) == 300
+    assert retry_after_for(0, 0.25, 300.0) == 1  # never zero
+
+
+# ---------------------------------------------------------------------------
+# the ledger: idempotent per-key charges, double-refund safe
+
+
+def test_ledger_charge_is_idempotent_per_key():
+    ledger = Ledger({"disk": 100})
+    assert ledger.charge("disk", "job-1", 40)
+    assert ledger.charge("disk", "job-1", 40)  # double charge: no-op
+    assert ledger.outstanding() == {"disk": 40}
+    ledger.refund("job-1")
+    assert ledger.outstanding() == {}
+    ledger.refund("job-1")  # double refund: no-op, never negative
+    assert ledger.outstanding() == {}
+
+
+def test_ledger_try_charge_records_nothing_on_refusal():
+    ledger = Ledger({"memory": 100})
+    assert ledger.try_charge("memory", "a", 80)
+    assert not ledger.try_charge("memory", "b", 30)
+    assert ledger.outstanding() == {"memory": 80}  # refusal left no trace
+    ledger.refund("a")
+    assert ledger.try_charge("memory", "b", 30)  # retry succeeds later
+    ledger.refund("b")
+
+
+def test_ledger_charge_reports_over_limit_but_records():
+    """Allocation sites that already committed (preallocated scratch)
+    use charge(): the books stay honest past the limit and the verdict
+    flags the trip."""
+    ledger = Ledger({"disk": 100})
+    assert ledger.charge("disk", "a", 90)
+    assert not ledger.charge("disk", "b", 50)  # over limit, still recorded
+    assert ledger.outstanding() == {"disk": 140}
+    assert ledger.pressure() == pytest.approx(1.4)
+    assert ledger.tripped() == "disk"
+    ledger.refund("a")
+    ledger.refund("b")
+    assert ledger.pressure() == 0.0
+    assert ledger.tripped() is None
+
+
+def test_ledger_unlimited_budget_never_trips():
+    ledger = Ledger()  # no limits configured
+    assert ledger.charge("disk", "a", 10**12)
+    assert ledger.try_charge("memory", "b", 10**12)
+    assert ledger.pressure() == 0.0
+    ledger.refund("a")
+    ledger.refund("b")
+
+
+def test_ledger_one_key_spanning_budgets_refunds_together():
+    ledger = Ledger({"disk": 100, "memory": 100})
+    ledger.charge("disk", "job", 10)
+    ledger.charge("memory", "job", 20)
+    assert ledger.outstanding() == {"disk": 10, "memory": 20}
+    ledger.refund("job")
+    assert ledger.outstanding() == {}
+
+
+def test_ledger_concurrent_charge_refund_balances():
+    ledger = Ledger({"slots": 10_000})
+
+    def worker(base):
+        for i in range(200):
+            key = f"k-{base}-{i}"
+            ledger.charge("slots", key, 3)
+            ledger.refund(key)
+            ledger.refund(key)  # racing double release
+
+    threads = [
+        threading.Thread(target=worker, args=(n,)) for n in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert ledger.outstanding() == {}
+
+
+# ---------------------------------------------------------------------------
+# deficit round-robin: weighted priority without starvation
+
+
+def test_drr_interactive_gets_weighted_share_but_bulk_never_starves():
+    sched = DeficitScheduler({"interactive": 4, "bulk": 1})
+    for i in range(20):
+        sched.offer(("int", i), "interactive", "tenant-a")
+        sched.offer(("bulk", i), "bulk", "tenant-b")
+    wave = sched.take(10)
+    kinds = [kind for kind, _ in wave]
+    assert kinds.count("int") > kinds.count("bulk")
+    assert kinds.count("bulk") >= 1  # bulk is demoted, never starved
+    sched.drain()
+
+
+def test_drr_fifo_within_a_lane():
+    sched = DeficitScheduler()
+    for i in range(6):
+        sched.offer(i, "bulk", "t")
+    assert sched.take(6) == [0, 1, 2, 3, 4, 5]
+
+
+def test_drr_round_robins_tenants_within_a_class():
+    sched = DeficitScheduler({"interactive": 1, "bulk": 1})
+    for i in range(4):
+        sched.offer(("a", i), "bulk", "tenant-a")
+    for i in range(4):
+        sched.offer(("b", i), "bulk", "tenant-b")
+    wave = sched.take(4)
+    # one hungry tenant cannot monopolize the wave: both appear
+    tenants = {t for t, _ in wave}
+    assert tenants == {"a", "b"}
+    sched.drain()
+
+
+def test_drr_paused_class_parks_and_resumes():
+    sched = DeficitScheduler()
+    sched.offer("b1", "bulk", "t")
+    sched.offer("i1", "interactive", "t")
+    wave = sched.take(5, paused_classes=frozenset(("bulk",)))
+    assert wave == ["i1"]
+    assert sched.pending() == 1  # bulk parked, not lost
+    assert sched.take(5) == ["b1"]  # resumed
+    assert sched.pending() == 0
+
+
+def test_drr_drain_hands_back_everything():
+    sched = DeficitScheduler()
+    for i in range(3):
+        sched.offer(i, "bulk", f"t{i}")
+    assert sorted(sched.drain()) == [0, 1, 2]
+    assert sched.pending() == 0
+
+
+def test_drr_tenant_cardinality_is_bounded():
+    sched = DeficitScheduler()
+    for i in range(admission.MAX_LANES + 50):
+        sched.offer(i, "bulk", f"tenant-{i}")
+    assert len(sched.snapshot()) <= admission.MAX_LANES + 1
+    assert sched.pending() == admission.MAX_LANES + 50  # nothing dropped
+    sched.drain()
+
+
+# ---------------------------------------------------------------------------
+# the controller: quotas, the ladder, overload episodes
+
+
+def test_tenant_job_quota_rejects_the_n_plus_first():
+    controller = AdmissionController()
+    controller.configure(quota_jobs=2)
+    before = metrics.GLOBAL.snapshot().get("admission_quota_rejects", 0)
+    first = controller.decide("bulk", "t1")
+    second = controller.decide("bulk", "t1")
+    assert first.action == "admit" and second.action == "admit"
+    third = controller.decide("bulk", "t1")
+    assert third.action == "shed"
+    assert third.reason == "tenant-job-quota"
+    assert (
+        metrics.GLOBAL.snapshot()["admission_quota_rejects"] == before + 1
+    )
+    # an unrelated tenant is untouched by t1's quota
+    other = controller.decide("bulk", "t2")
+    assert other.action == "admit"
+    # release frees the slot; the next job admits again
+    first.release()
+    again = controller.decide("bulk", "t1")
+    assert again.action == "admit"
+    for decision in (second, other, again):
+        decision.release()
+    controller.reset()
+
+
+def test_tenant_quota_release_is_idempotent():
+    controller = AdmissionController()
+    controller.configure(quota_jobs=1)
+    first = controller.decide("bulk", "t")
+    first.release()
+    first.release()  # double settle must not free a second phantom slot
+    second = controller.decide("bulk", "t")
+    assert second.action == "admit"
+    third = controller.decide("bulk", "t")
+    assert third.action == "shed"
+    second.release()
+    controller.reset()
+
+
+def test_tenant_byte_quota():
+    controller = AdmissionController()
+    controller.configure(quota_bytes=100)
+    big = controller.decide("interactive", "t", size=80)
+    assert big.action == "admit"
+    over = controller.decide("interactive", "t", size=40)
+    assert over.action == "shed" and over.reason == "tenant-byte-quota"
+    unknown = controller.decide("interactive", "t")  # unprobeable: 0 bytes
+    assert unknown.action == "admit"
+    big.release()
+    unknown.release()
+    controller.reset()
+
+
+def test_degradation_ladder_walks_in_order():
+    controller = AdmissionController()
+    controller.configure(
+        budgets={"disk": 100}, shrink_at=0.5, pause_at=0.8, shed_at=1.0
+    )
+    ledger = controller.ledger
+    assert controller.level() == admission.LEVEL_NORMAL
+    ledger.charge("disk", "a", 60)
+    assert controller.level() == admission.LEVEL_SHRINK
+    ledger.charge("disk", "b", 25)
+    assert controller.level() == admission.LEVEL_PAUSE_BULK
+    assert controller.bulk_paused()
+    # paused: bulk defers, interactive still admits
+    bulk = controller.decide("bulk", "t")
+    assert bulk.action == "defer" and bulk.reason == "bulk-paused"
+    interactive = controller.decide("interactive", "t")
+    assert interactive.action == "admit"
+    interactive.release()
+    ledger.charge("disk", "c", 20)
+    assert controller.level() == admission.LEVEL_SHED
+    shed = controller.decide("bulk", "t")
+    assert shed.action == "shed" and shed.reason == "overload"
+    # interactive survives even at the shed rung (bulk absorbs the hit)
+    vip = controller.decide("interactive", "t")
+    assert vip.action == "admit"
+    vip.release()
+    for key in ("a", "b", "c"):
+        ledger.refund(key)
+    assert controller.level() == admission.LEVEL_NORMAL
+    controller.reset()
+
+
+def test_overload_episode_opens_once_until_calm():
+    controller = AdmissionController()
+    assert controller.note_shed("t", "overload") is True  # opens episode
+    assert controller.note_shed("t", "overload") is False  # same episode
+    controller.note_calm()
+    assert controller.note_shed("t", "overload") is True  # fresh episode
+    controller.reset()
+
+
+def test_controller_snapshot_shape():
+    controller = AdmissionController()
+    controller.configure(budgets={"disk": 100}, quota_jobs=4)
+    decision = controller.decide("interactive", "tenant-x", size=10)
+    controller.note_stall("tenant-x")
+    snap = controller.snapshot()
+    assert snap["level_name"] == "normal"
+    assert snap["quota_tenant_jobs"] == 4
+    assert snap["tenants"]["tenant-x"]["inflight_jobs"] == 1
+    assert snap["ledger"]["budgets"]["disk"]["limit"] == 100
+    assert snap["stalled_tenants"] == {"tenant-x": 1}
+    decision.release()
+    controller.reset()
+
+
+# ---------------------------------------------------------------------------
+# class/tenant headers on deliveries
+
+
+def _delivered(broker, queue, publish_headers):
+    """Publish one message with headers and consume it as a Delivery."""
+    channel = broker.connect().channel()
+    channel.declare_queue(queue)
+    got = []
+    consumer = broker.connect().channel()
+    consumer.consume(queue, lambda m: got.append(m))
+    channel.publish("", queue, b"body", headers=publish_headers)
+    assert got, "message never delivered"
+    return Delivery(got[0], consumer)
+
+
+def test_delivery_parses_class_and_tenant_headers():
+    broker = MemoryBroker()
+    delivery = _delivered(
+        broker, "q", {CLASS_HEADER: "interactive", TENANT_HEADER: "acme"}
+    )
+    assert delivery.job_class == "interactive"
+    assert delivery.tenant == "acme"
+    delivery.ack()
+
+
+def test_delivery_defaults_unclassified_traffic():
+    broker = MemoryBroker()
+    delivery = _delivered(broker, "q", {})
+    assert delivery.job_class is None  # admission applies the default
+    assert delivery.tenant == "default"
+    delivery.ack()
+
+
+def test_delivery_rejects_garbage_class_values():
+    broker = MemoryBroker()
+    delivery = _delivered(
+        broker, "q", {CLASS_HEADER: "root", TENANT_HEADER: "  "}
+    )
+    assert delivery.job_class is None
+    assert delivery.tenant == "default"
+    delivery.ack()
+
+
+def test_settle_hooks_run_exactly_once_and_late_adds_fire():
+    broker = MemoryBroker()
+    delivery = _delivered(broker, "q", {})
+    ran = []
+    delivery.add_settle_hook(lambda: ran.append("a"))
+    delivery.ack()
+    delivery.ack()  # double settle
+    delivery.nack()
+    assert ran == ["a"]
+    delivery.add_settle_hook(lambda: ran.append("late"))
+    assert ran == ["a", "late"]  # post-settle adds run immediately
+
+
+# ---------------------------------------------------------------------------
+# the DLQ shed contract
+
+
+def test_shed_lands_in_dlq_with_retry_after_and_count():
+    broker = MemoryBroker()
+    dlq = dlq_name("v1.download")
+    setup = broker.connect().channel()
+    setup.declare_queue(dlq)
+    delivery = _delivered(broker, "v1.download-0", {TENANT_HEADER: "noisy"})
+    outcome = delivery.shed(dlq, "overload", retry_after=20, max_sheds=3)
+    assert outcome == "dlq"
+    assert delivery.settled
+    assert broker.queue_depth(dlq) == 1
+    body, headers, _, _, _ = broker._queues[dlq][0]
+    assert body == b"body"
+    assert headers[SHED_HEADER] == 1
+    assert headers[RETRY_AFTER_HEADER] == 20
+    assert headers[SHED_REASON_HEADER] == "overload"
+    assert DEAD_HEADER not in headers
+    assert headers[TENANT_HEADER] == "noisy"  # identity survives the DLQ
+
+
+def test_shed_past_the_cap_marks_dead():
+    broker = MemoryBroker()
+    dlq = dlq_name("v1.download")
+    setup = broker.connect().channel()
+    setup.declare_queue(dlq)
+    delivery = _delivered(broker, "v1.download-0", {SHED_HEADER: 3})
+    assert delivery.shed_count == 3
+    outcome = delivery.shed(dlq, "overload", retry_after=300, max_sheds=3)
+    assert outcome == "dead"
+    _, headers, _, _, _ = broker._queues[dlq][0]
+    assert headers[SHED_HEADER] == 4
+    assert DEAD_HEADER in headers
+
+
+def test_shed_is_double_settle_safe():
+    broker = MemoryBroker()
+    dlq = dlq_name("v1.download")
+    setup = broker.connect().channel()
+    setup.declare_queue(dlq)
+    delivery = _delivered(broker, "v1.download-0", {})
+    delivery.ack()
+    outcome = delivery.shed(dlq, "overload", retry_after=5)
+    assert outcome == "already-settled"  # shed is a no-op, nothing bounced
+    assert broker.queue_depth(dlq) == 0
+
+
+def test_shed_unconfirmed_handoff_requeues_original():
+    """A DLQ hand-off that cannot confirm must NOT lose the job: the
+    original requeue-nacks back to its queue (at-least-once)."""
+    broker = MemoryBroker()
+    dlq = dlq_name("v1.download")
+    setup = broker.connect().channel()
+    setup.declare_queue(dlq)
+    setup.declare_queue("v1.download-0")
+    got = []
+    consumer = broker.connect().channel()
+    consumer.consume("v1.download-0", lambda m: got.append(m))
+    setup.publish("", "v1.download-0", b"body")
+    delivery = Delivery(got[0], consumer)
+    delivery._publisher = lambda *a, **k: False  # never confirms
+    outcome = delivery.shed(dlq, "overload", retry_after=5)
+    assert outcome == "requeued"
+    assert broker.queue_depth(dlq) == 0
+    # the requeue-nack went back to the broker, which redelivered to
+    # the still-live consumer: the job is IN FLIGHT again, not lost
+    assert len(got) == 2 and got[1].redelivered
+
+
+# ---------------------------------------------------------------------------
+# /debug/admission
+
+
+class _FakeStats:
+    processed = failed = retried = dropped = shed = 0
+    published = delivered = publish_retries = 0
+    reconnects = consumer_errors = 0
+
+
+class _Fake:
+    stats = _FakeStats()
+    worker_count = 1
+
+    def connected(self):
+        return True
+
+
+def test_debug_admission_endpoint():
+    import json
+    import urllib.request
+
+    controller = admission.CONTROLLER
+    controller.configure(budgets={"disk": 100}, quota_jobs=8)
+    decision = controller.decide("interactive", "acme", size=10)
+    server = HealthServer(_Fake(), _Fake(), 0)
+    server.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/debug/admission", timeout=5
+        ) as response:
+            payload = json.loads(response.read())
+        assert payload["level_name"] == "normal"
+        assert payload["tenants"]["acme"]["inflight_jobs"] == 1
+        assert payload["ledger"]["budgets"]["disk"]["limit"] == 100
+    finally:
+        server.stop()
+        decision.release()
+        controller.reset()

@@ -1,0 +1,409 @@
+"""Chaos scenario for the admission layer (ISSUE 7 acceptance): one
+bulk tenant saturating a slow origin must not take an interactive
+tenant's latency with it.
+
+Through the in-tree broker, with a per-tenant in-flight quota of 1 and
+two workers:
+
+- a burst of bulk jobs against a dribbling origin is cut down to ONE
+  admitted job (which wedges at most one worker); the rest are
+  explicitly shed to the DLQ with Retry-After set and the shed count
+  stamped,
+- an interactive tenant's jobs keep flowing through the free worker:
+  the mixed-phase p99 holds within 2x the solo baseline (with a small
+  floor for host noise),
+- the first shed of the episode captures an incident bundle tagging
+  the offending tenant,
+- nothing leaks: no dangling multipart uploads, and the admission
+  ledger balances to zero (asserted by the conftest fixture).
+"""
+
+import base64
+import http.server
+import threading
+import time
+
+import pytest
+
+from downloader_tpu.daemon.app import Daemon, capture_stall_incident
+from downloader_tpu.daemon.config import Config
+from downloader_tpu.fetch import DispatchClient, HTTPBackend
+from downloader_tpu.queue import MemoryBroker, QueueClient
+from downloader_tpu.queue.delivery import (
+    CLASS_HEADER,
+    RETRY_AFTER_HEADER,
+    SHED_HEADER,
+    TENANT_HEADER,
+    dlq_name,
+)
+from downloader_tpu.store import Credentials, S3Client, Uploader
+from downloader_tpu.store.stub import S3Stub
+from downloader_tpu.utils import admission, incident, metrics, watchdog
+from downloader_tpu.utils.cancel import CancelToken
+from downloader_tpu.wire import Download, Media
+
+INTERACTIVE = b"i" * (16 * 1024)
+BULK = b"b" * (256 * 1024)  # above BATCH_MAX_BYTES: takes the slow lane
+MAX_BYTES = 64 * 1024
+
+
+def wait_for(predicate, timeout=20.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class ChaosHandler(http.server.BaseHTTPRequestHandler):
+    """``/quick-*.mkv`` answers instantly; ``/slow-*.mkv`` advertises
+    its full size then dribbles bytes until ``release`` fires — the
+    slow origin a hostile bulk tenant points the worker at."""
+
+    protocol_version = "HTTP/1.1"
+    release = threading.Event()
+
+    def log_message(self, *args):
+        pass
+
+    def _payload(self):
+        return BULK if self.path.startswith("/slow") else INTERACTIVE
+
+    def do_HEAD(self):
+        body = self._payload()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Accept-Ranges", "bytes")
+        self.end_headers()
+
+    def do_GET(self):
+        body = self._payload()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if not self.path.startswith("/slow"):
+            self.wfile.write(body)
+            return
+        # dribble: steady sub-timeout progress, never finishing until
+        # released — slow, and deliberately not "stalled"
+        sent = 0
+        while sent < len(body):
+            if ChaosHandler.release.wait(0.05):
+                break
+            try:
+                self.wfile.write(body[sent:sent + 1024])
+                self.wfile.flush()
+            except OSError:
+                return  # cancelled fetch reset the connection
+            sent += 1024
+
+
+class _QuietServer(http.server.ThreadingHTTPServer):
+    def handle_error(self, request, client_address):
+        pass  # cancelled fetches reset connections; expected
+
+
+@pytest.fixture
+def chaos():
+    ChaosHandler.release = threading.Event()
+    httpd = _QuietServer(("127.0.0.1", 0), ChaosHandler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    token = CancelToken()
+    broker = MemoryBroker()
+    stub = S3Stub(credentials=Credentials("k", "s")).start()
+    import tempfile
+
+    workdir = tempfile.mkdtemp(prefix="chaos-")
+    config = Config(
+        broker="memory",
+        base_dir=workdir,
+        concurrency=2,
+        max_job_retries=1,
+        retry_delay=0.05,
+    )
+    config.batch_jobs = 8
+    config.batch_wait_ms = 150.0
+    config.batch_max_bytes = MAX_BYTES
+    # the admission shape under test: per-tenant in-flight quota of 1
+    # (the N+1st job is rejected), bulk demoted behind interactive
+    config.quota_tenant_jobs = 1
+    config.dlq_max_redeliver = 3
+    config.dlq_retry_after_base = 5.0
+    client = QueueClient(
+        token, broker.connect, supervisor_interval=0.05, drain_timeout=5
+    )
+    client.set_prefetch(32)
+    dispatcher = DispatchClient(
+        token, workdir, [HTTPBackend(progress_interval=0.01, timeout=5)]
+    )
+    uploader = Uploader(
+        config.bucket, S3Client(stub.endpoint, Credentials("k", "s"))
+    )
+    daemon = Daemon(token, client, dispatcher, uploader, config)
+    runner = threading.Thread(target=daemon.run, daemon=True)
+
+    producer = broker.connect().channel()
+    producer.declare_exchange("v1.download")
+    for i in range(2):
+        name = f"v1.download-{i}"
+        producer.declare_queue(name)
+        producer.bind_queue(name, "v1.download", name)
+
+    h = type("Chaos", (), {})()
+    h.daemon, h.broker, h.stub, h.token = daemon, broker, stub, token
+    h.config, h.base = config, base
+
+    def enqueue(media_id, path, tenant, job_class):
+        body = Download(
+            media=Media(id=media_id, source_uri=f"{base}{path}")
+        ).marshal()
+        producer.publish(
+            "v1.download", "v1.download-0", body,
+            headers={TENANT_HEADER: tenant, CLASS_HEADER: job_class},
+        )
+
+    h.enqueue = enqueue
+    runner.start()
+    yield h
+    ChaosHandler.release.set()
+    token.cancel()
+    runner.join(timeout=15)
+    stub.stop()
+    httpd.shutdown()
+
+
+def _uploaded(h, media_id, name, payload):
+    key = f"{media_id}/original/{base64.b64encode(name.encode()).decode()}"
+    return h.stub.buckets.get("triton-staging", {}).get(key) == payload
+
+
+def _run_interactive_round(h, prefix, count):
+    """Publish ``count`` interactive jobs one at a time (per-tenant
+    quota is 1) and return each one's publish→uploaded latency."""
+    latencies = []
+    for i in range(count):
+        media_id, name = f"{prefix}-{i}", f"quick-{prefix}-{i}.mkv"
+        started = time.monotonic()
+        h.enqueue(media_id, f"/{name}", tenant="vip", job_class="interactive")
+        assert wait_for(
+            lambda: _uploaded(h, media_id, name, INTERACTIVE)
+        ), f"interactive job {media_id} never completed"
+        latencies.append(time.monotonic() - started)
+        # the quota slot frees at settlement (ms after the upload);
+        # wait it out so the NEXT job is admitted, not quota-shed
+        assert wait_for(
+            lambda: admission.CONTROLLER.tenants()
+            .get("vip", {})
+            .get("inflight_jobs", 0)
+            == 0
+        )
+    return latencies
+
+
+def _p99(latencies):
+    ordered = sorted(latencies)
+    return ordered[min(len(ordered) - 1, int(len(ordered) * 0.99))]
+
+
+def test_interactive_p99_holds_while_bulk_tenant_saturates_slow_origin(chaos):
+    h = chaos
+    before = metrics.GLOBAL.snapshot()
+    incident.RECORDER.min_auto_interval = 0.0  # isolate from other tests
+    try:
+        assert wait_for(lambda: h.daemon.worker_count == 2)
+
+        # phase 1 — solo baseline: the interactive tenant alone
+        solo = _run_interactive_round(h, "solo", 8)
+        solo_p99 = _p99(solo)
+
+        # phase 2 — the bulk tenant floods: a burst against the
+        # dribbling origin. Quota admits ONE (wedging at most one
+        # worker); the rest are explicitly shed to the DLQ.
+        for i in range(6):
+            h.enqueue(
+                f"bulk-{i}", f"/slow-{i}.mkv",
+                tenant="batch-co", job_class="bulk",
+            )
+        dlq = dlq_name("v1.download")
+        assert wait_for(lambda: h.broker.queue_depth(dlq) >= 5), (
+            "shed jobs never reached the DLQ"
+        )
+        # the admitted bulk job is actually occupying a worker
+        assert wait_for(
+            lambda: admission.CONTROLLER.tenants()
+            .get("batch-co", {})
+            .get("inflight_jobs", 0)
+            == 1
+        )
+
+        # phase 3 — interactive under contention: p99 holds within 2x
+        # the solo baseline (floored against host-noise on tiny
+        # absolute latencies; without admission this measures the
+        # dribbling origin's SECONDS, so the bar discriminates)
+        mixed = _run_interactive_round(h, "mixed", 8)
+        mixed_p99 = _p99(mixed)
+        assert mixed_p99 <= max(2 * solo_p99, 0.75), (
+            f"interactive p99 degraded: solo {solo_p99:.3f}s "
+            f"vs mixed {mixed_p99:.3f}s"
+        )
+
+        # the DLQ contract: Retry-After + shed count on every message
+        for body, headers, _, _, _ in list(h.broker._queues[dlq]):
+            assert headers[SHED_HEADER] == 1
+            assert headers[RETRY_AFTER_HEADER] >= 1
+            assert headers[TENANT_HEADER] == "batch-co"
+            job = Download.unmarshal(body)
+            assert job.media.source_uri.startswith(h.base)
+
+        # shed accounting: quota rejects recorded, stats agree
+        after = metrics.GLOBAL.snapshot()
+        shed_count = after.get("admission_shed_jobs", 0) - before.get(
+            "admission_shed_jobs", 0
+        )
+        assert shed_count >= 5
+        assert after.get("admission_quota_rejects", 0) > before.get(
+            "admission_quota_rejects", 0
+        )
+        assert h.daemon.stats.shed >= 5
+
+        # first shed of the episode captured an incident bundle
+        # tagging the offending tenant (async capture thread)
+        def _admission_bundle():
+            for summary in incident.RECORDER.list_incidents():
+                if summary.get("trigger") == "admission":
+                    return True
+            return False
+
+        assert wait_for(_admission_bundle, timeout=10), (
+            "no admission incident bundle captured"
+        )
+
+        # per-class SLO series populated: interactive completions
+        # landed in their own histogram
+        hists = metrics.GLOBAL.histograms()
+        assert "slo_job_duration_seconds_interactive" in hists
+        assert hists["slo_job_duration_seconds_interactive"][3] >= 16
+    finally:
+        incident.RECORDER.min_auto_interval = (
+            incident.DEFAULT_MIN_AUTO_INTERVAL_S
+        )
+        # stop the dribble and drain BEFORE asserting cleanliness
+        ChaosHandler.release.set()
+        h.token.cancel()
+
+    # no dangling multipart uploads, whatever the bulk job was doing
+    assert wait_for(
+        lambda: not h.stub.list_multipart_uploads("triton-staging")
+    )
+
+
+def test_shed_rung_sheds_bulk_at_admission_while_interactive_flows(chaos):
+    """The ladder's LAST rung must be reachable from the daemon: with a
+    ledger budget tripped (pressure >= shed_at), a bulk job is shed to
+    the DLQ with reason ``overload`` by the wave builder itself — not
+    parked in a paused lane forever — while interactive still admits."""
+    h = chaos
+    assert wait_for(lambda: h.daemon.worker_count == 2)
+    admission.LEDGER.configure({"disk": 100})
+    admission.LEDGER.charge("disk", "pressure-test", 100)
+    try:
+        h.enqueue("bulk-hot", "/quick-hot.mkv", tenant="batch-co", job_class="bulk")
+        dlq = dlq_name("v1.download")
+        assert wait_for(lambda: h.broker.queue_depth(dlq) >= 1), (
+            "bulk job was not shed at the shed rung"
+        )
+        _, headers, _, _, _ = list(h.broker._queues[dlq])[0]
+        assert headers["X-Shed-Reason"] == "overload"
+        assert headers[RETRY_AFTER_HEADER] >= 1
+        # interactive admits straight through the same rung
+        h.enqueue("vip-hot", "/quick-hot.mkv", tenant="vip", job_class="interactive")
+        assert wait_for(
+            lambda: _uploaded(h, "vip-hot", "quick-hot.mkv", INTERACTIVE)
+        ), "interactive starved at the shed rung"
+    finally:
+        admission.LEDGER.refund("pressure-test")
+
+
+def test_pause_rung_parks_bulk_bounded_while_interactive_flows(chaos):
+    """The pause rung must not wedge the dequeue window: parked bulk
+    deliveries stay unacked, so the shrunk qos window stretches by the
+    parked count (interactive keeps flowing past them), parking is
+    bounded to one wave (overflow sheds with ``bulk-paused-overflow``),
+    and parked jobs resume when pressure clears."""
+    h = chaos
+    assert wait_for(lambda: h.daemon.worker_count == 2)
+    # pressure in [pause_at, shed_at): bulk parks, nothing pressure-sheds
+    admission.LEDGER.configure({"disk": 100})
+    admission.LEDGER.charge("disk", "pause-test", 95)
+    try:
+        flood = h.config.batch_jobs + 3  # past the one-wave park bound
+        for i in range(flood):
+            h.enqueue(
+                f"parked-{i}", f"/quick-parked-{i}.mkv",
+                tenant="batch-co", job_class="bulk",
+            )
+        dlq = dlq_name("v1.download")
+        # overflow past the park cap walks the next rung: shed to DLQ
+        assert wait_for(lambda: h.broker.queue_depth(dlq) >= 1), (
+            "parked overflow was never shed"
+        )
+        assert any(
+            headers[SHED_HEADER] == 1
+            for _, headers, _, _, _ in list(h.broker._queues[dlq])
+        )
+        parked = admission.CONTROLLER.scheduler.pending({"bulk"})
+        assert 1 <= parked <= h.config.batch_jobs, parked
+        # interactive flows THROUGH the parked population: the window
+        # stretched past the unacked parked bulk
+        h.enqueue("vip-pause", "/quick-vip-pause.mkv", tenant="vip", job_class="interactive")
+        assert wait_for(
+            lambda: _uploaded(h, "vip-pause", "quick-vip-pause.mkv", INTERACTIVE)
+        ), "interactive wedged behind parked bulk"
+        # none of the parked bulk ran while paused
+        assert not any(
+            _uploaded(h, f"parked-{i}", f"quick-parked-{i}.mkv", INTERACTIVE)
+            for i in range(flood)
+        )
+    finally:
+        admission.LEDGER.refund("pause-test")
+    # pressure cleared: parked bulk resumes and completes
+    assert wait_for(
+        lambda: sum(
+            _uploaded(h, f"parked-{i}", f"quick-parked-{i}.mkv", INTERACTIVE)
+            for i in range(flood)
+        )
+        >= 1
+    ), "parked bulk never resumed after pressure cleared"
+
+
+def test_stalled_tenant_is_tagged_and_quota_refunds_on_cancel():
+    """The watchdog→admission hand-off: a stalled job's incident is
+    tagged with its tenant lane, note_stall records the tenant, and
+    (the quota half) the release hook fires on settlement even when
+    settlement is a watchdog cancel path."""
+    incident.RECORDER.min_auto_interval = 0.0
+    monitor = watchdog.Watchdog(stall_s=10.0)
+    watch = monitor.job("wedged-job")
+    watch.meta.update(tenant="batch-co", job_class="bulk")
+    try:
+        capture_stall_incident(watch, "fetch", 42.0)
+        snap = admission.CONTROLLER.snapshot()
+        assert snap["stalled_tenants"].get("batch-co") == 1
+        bundles = [
+            b for b in incident.RECORDER.list_incidents()
+            if b.get("trigger") == "watchdog"
+        ]
+        assert bundles, "stall incident not captured"
+        bundle = incident.RECORDER.get(bundles[-1]["id"])
+        assert bundle["extra"]["tenant"] == "batch-co"
+        assert bundle["extra"]["job_class"] == "bulk"
+    finally:
+        incident.RECORDER.min_auto_interval = (
+            incident.DEFAULT_MIN_AUTO_INTERVAL_S
+        )
+        monitor.unregister(watch)
+        monitor.reset()
+        admission.CONTROLLER.reset()

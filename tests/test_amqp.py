@@ -478,9 +478,13 @@ class TestPublisherConfirmsWire:
                 th.join(timeout=10)
             elapsed = time.monotonic() - start
             assert results == [True, True]
-            # serialized by design: ~2x the ack delay, but bounded —
-            # no timeout spiral, no lost messages
-            assert elapsed >= 0.55, "expected the serialized confirm cost"
+            # one publisher thread, confirm-gated: both messages pay at
+            # least one full ack delay. Whether they pay one window
+            # (both already buffered -> coalesced into one publish_many
+            # flush) or two (serialized) is a scheduling race the
+            # flush batching deliberately introduced — either way the
+            # cost is bounded, in order, and both are confirmed.
+            assert elapsed >= 0.25, "expected at least one confirm window"
             assert elapsed < 3.0, f"degradation not graceful: {elapsed:.2f}s"
         finally:
             token.cancel()

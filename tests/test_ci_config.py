@@ -189,3 +189,47 @@ def test_bench_digest_picks_up_segmented_ablation():
     assert digest["segmented_small_x"] == 1.0
     assert digest["segmented_overlap_ratio"] == 0.7
     assert digest["segmented_pool_reuse_hits"] == 9
+
+
+def test_bench_digest_picks_up_overload_shedding_arm():
+    """The overload_shedding ablation must survive into the digest
+    line: the interactive-p99 protection contract would otherwise
+    regress invisibly."""
+    import sys
+
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench_digest
+    finally:
+        sys.path.remove(str(REPO))
+
+    report = {
+        "value": 100.0,
+        "extra_metrics": [
+            {
+                "metric": "overload_shedding",
+                "protected": {
+                    "interactive_p99_ms": 40.0,
+                    "shed_jobs": 3,
+                },
+                "unprotected": {"interactive_p99_ms": 900.0},
+                "protection_ratio": 22.5,
+            }
+        ],
+    }
+    digest = bench_digest.digest_line(report)
+    assert digest["overload_protected_p99_ms"] == 40.0
+    assert digest["overload_unprotected_p99_ms"] == 900.0
+    assert digest["overload_shed_jobs"] == 3
+    assert digest["overload_protection_x"] == 22.5
+
+
+def test_circleci_runs_overload_smoke():
+    yaml = pytest.importorskip("yaml")
+    ci = yaml.safe_load(CONFIG.read_text())
+    commands = " ".join(
+        s["run"]["command"]
+        for s in ci["jobs"]["tests"]["steps"]
+        if isinstance(s, dict) and "run" in s
+    )
+    assert "test_admission_chaos.py" in commands

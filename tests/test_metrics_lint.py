@@ -27,6 +27,7 @@ class _FakeDaemonStats:
     failed = 1
     retried = 0
     dropped = 0
+    shed = 0
 
 
 class _FakeDaemon:
