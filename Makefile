@@ -8,7 +8,7 @@ APP         := downloader
 BINDIR      := bin
 DOCKER_IMAGE ?= downloader-tpu
 
-.PHONY: all dep build native wheel docker-build fmt fmt-fix analyze test bench clean
+.PHONY: all dep build native wheel docker-build fmt fmt-fix analyze analyze-full test bench clean
 
 all: dep native build
 
@@ -73,12 +73,18 @@ fmt-fix:
 	$(PYTHON) hack/fmt.py --fix downloader_tpu tests bench.py __graft_entry__.py
 
 # Concurrency & resource-safety static analysis (go vet analogue):
-# guarded-by, no-blocking-under-lock, resource-finalization,
-# lock-order, exception-hygiene over the whole package. Also enforced
-# inside the test suite (tests/test_static_analysis.py); this target
-# is the standalone CI/pre-commit entry point.
+# the CFG/dataflow rule set — guarded-by, no-blocking-under-lock,
+# resource-finalization, lock-order, exception-hygiene, protocol
+# typestate, blocking-deadline, env-knob-documented — over the whole
+# package. Also enforced inside the test suite
+# (tests/test_static_analysis.py); this target is the standalone
+# pre-commit entry point. Re-runs are cheap: unchanged files adopt
+# their mtime-keyed cached scans (CI uses --no-cache).
 analyze:
 	$(PYTHON) -m downloader_tpu.analysis
+
+analyze-full:
+	$(PYTHON) -m downloader_tpu.analysis --no-cache
 
 test:
 	$(PYTHON) -m pytest tests/ -q

@@ -2,10 +2,12 @@
 
 Every recent regression class in this codebase — dangling multipart
 uploads, leaked sockets on cancel, stale journal reuse, a worker
-thread killed by an escaped exception — was a cross-thread or
-cross-path invariant no single test enumerated. This package turns
-those invariants into AST-level checkers (stdlib ``ast`` only) that
-run over the whole ``downloader_tpu`` package on every tier-1
+thread killed by an escaped exception, a settle hook skipped on one
+exception arm — was a cross-thread or cross-path invariant no single
+test enumerated. This package turns those invariants into
+path-sensitive checkers (stdlib ``ast`` only, over a per-function CFG
+with a generic dataflow solver — see ``engine``/``cfg``/``dataflow``)
+that run over the whole ``downloader_tpu`` package on every tier-1
 invocation (tests/test_static_analysis.py) and standalone via
 ``python -m downloader_tpu.analysis``.
 
@@ -13,25 +15,39 @@ Shipped rules (see README "Static analysis" for the operator-facing
 catalog):
 
 - ``guarded-by`` — attributes annotated ``# guarded-by: _lock`` may
-  only be touched while that lock is held (lexically inside
-  ``with self._lock:`` or in a function annotated ``# holds: _lock``).
+  only be touched while that lock is held (per the CFG lock-state
+  analysis, or in a function annotated ``# holds: _lock``).
 - ``no-blocking-under-lock`` — no sleeps, joins, socket I/O, or
   future/event waits while any lock is held.
 - ``resource-finalization`` — sockets/files/tempfiles created in a
-  function must reach close/unlink on ALL paths (``with``,
-  ``try/finally``, or a re-raising handler), unless ownership escapes.
+  function must reach close/unlink on EVERY CFG path, exception edges
+  included, unless ownership escapes.
 - ``lock-order`` — the static lock-acquisition graph (nested ``with``
-  blocks plus ``# holds:`` annotations) must be cycle-free.
+  blocks plus ``# holds:`` annotations) must be cycle-free; the
+  runtime ``LockOrderRecorder`` covers orders closed through calls.
 - ``exception-hygiene`` — no bare ``except:``, no silent broad
   ``except Exception: pass``, and ``threading.Thread`` targets must
   not let exceptions escape (they kill the worker silently).
+- ``protocol`` — lifecycle typestate: every acquisition of a declared
+  protocol (``# protocol: <name> acquire`` / ``release`` on the
+  defining methods; six seeded — delivery-settle, ledger-charge,
+  cancel-token, watchdog-watch, tracer-trace, multipart-upload) must
+  reach a release on every path or explicitly escape ownership;
+  proven double releases are violations too. The runtime
+  ``ProtocolRecorder`` is the dynamic half.
+- ``blocking-deadline`` — every blocking call reachable from
+  daemon/worker code must carry a finite timeout, a cancel hook, or a
+  reasoned ``# deadline:`` annotation naming what bounds the wait.
+- ``env-knob-documented`` — every env knob read by the package has a
+  row in the README configuration table.
 
 Suppression syntax, inline on the offending line::
 
     something_flagged()  # analysis: ignore[rule-id] why it is safe
 
 A suppression without a written reason is itself a violation
-(``suppression``): the reason IS the review artifact.
+(``suppression``), and so is a stale one that matches no finding:
+the reason IS the review artifact.
 """
 
 from .core import (  # noqa: F401

@@ -2,7 +2,11 @@
 
 With no paths, analyzes the installed ``downloader_tpu`` package —
 the same scope tier-1 enforces — so CI and pre-commit can run the
-gate standalone. Exit status: 0 clean, 1 violations, 2 usage error.
+gate standalone, with an mtime-keyed scan cache making re-runs cheap
+(``--no-cache`` forces the full scan, as CI does).
+``--list-suppressions`` inventories every ``analysis: ignore`` in
+scope with its reason for review. Exit status: 0 clean, 1 violations,
+2 usage error.
 """
 
 from __future__ import annotations
@@ -10,8 +14,39 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+from pathlib import Path
 
-from .core import Analyzer, iter_package_files
+from .cache import ScanCache, default_cache_path
+from .core import Analyzer, Module, iter_package_files
+
+
+def _list_suppressions(files: list[Path], as_json: bool) -> int:
+    entries = []
+    for path in files:
+        try:
+            module = Module.load(path)
+        except SyntaxError:
+            continue
+        for line, declared in sorted(module.suppressions.items()):
+            for rule, reason in declared:
+                entries.append(
+                    {
+                        "path": module.path,
+                        "line": line,
+                        "rule": rule,
+                        "reason": reason,
+                    }
+                )
+    if as_json:
+        print(json.dumps({"suppressions": entries, "count": len(entries)}, indent=2))
+    else:
+        for entry in entries:
+            print(
+                f"{entry['path']}:{entry['line']}: ignore[{entry['rule']}] "
+                f"{entry['reason'] or '(no reason!)'}"
+            )
+        print(f"\n{len(entries)} suppression(s)")
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -29,7 +64,31 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="machine-readable output (one object, 'violations' list)",
     )
+    parser.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="ignore the scan cache and re-analyze everything (CI mode)",
+    )
+    parser.add_argument(
+        "--cache-file",
+        default=None,
+        help=f"scan cache location (default: {default_cache_path()})",
+    )
+    parser.add_argument(
+        "--list-suppressions",
+        action="store_true",
+        help="list every `analysis: ignore` with file:line and reason, then exit",
+    )
     args = parser.parse_args(argv)
+
+    if args.list_suppressions:
+        if args.paths:
+            files: list[Path] = []
+            for path in (Path(p) for p in args.paths):
+                files.extend(sorted(path.rglob("*.py")) if path.is_dir() else [path])
+        else:
+            files = iter_package_files()
+        return _list_suppressions(files, args.json)
 
     if args.paths:
         from .core import analyze_paths
@@ -37,15 +96,28 @@ def main(argv: list[str] | None = None) -> int:
         violations = analyze_paths(args.paths)
     else:
         # whole-package mode: the full scope is in view, so stale
-        # suppressions of cross-module rules are decidable too
-        violations = Analyzer(full_scope=True).run(iter_package_files())  # type: ignore[arg-type]
+        # suppressions of cross-module rules are decidable too — and
+        # the scan cache applies (its vocabulary fingerprint covers
+        # this exact scope)
+        files = iter_package_files()
+        cache = None
+        if not args.no_cache:
+            cache = ScanCache(args.cache_file or default_cache_path())
+            replayed = cache.replay(files)
+            if replayed is not None:
+                return _emit(replayed, args.json, cached=True)
+        violations = Analyzer(full_scope=True).run(files, scan_cache=cache)  # type: ignore[arg-type]
+    return _emit(violations, args.json)
 
-    if args.json:
+
+def _emit(violations, as_json: bool, cached: bool = False) -> int:
+    if as_json:
         print(
             json.dumps(
                 {
                     "violations": [v.to_dict() for v in violations],
                     "count": len(violations),
+                    "cached": cached,
                 },
                 indent=2,
             )
@@ -56,7 +128,7 @@ def main(argv: list[str] | None = None) -> int:
         if violations:
             print(f"\n{len(violations)} violation(s)")
         else:
-            print("ok: no violations")
+            print("ok: no violations" + (" (cached)" if cached else ""))
     return 1 if violations else 0
 
 

@@ -1,66 +1,116 @@
-"""The shipped rule set. Each checker is grounded in a regression
-class this codebase has actually paid for (see module docs referenced
-per rule): the analyzer exists to make those one-time lessons
-mechanical.
+"""The shipped rule set, all running on the shared CFG/dataflow engine
+(``engine.scan_module``). Each checker is grounded in a regression
+class this codebase has actually paid for: the analyzer exists to make
+those one-time lessons mechanical.
 """
 
 from __future__ import annotations
 
 import ast
+from pathlib import Path
 
-from . import astwalk
+from . import engine, protocols
 from .core import Checker, Module, Violation, find_cycles, register
 
 _BROAD_EXCEPTIONS = {"Exception", "BaseException"}
 
 # resource-creating callables recognized by terminal name; functions
 # annotated `# resource-factory` on their def line join this set
-_RESOURCE_FACTORIES = {
-    "open",
-    "socket",
-    "create_connection",
-    "socketpair",
-    "mkstemp",
-    "mkdtemp",
-    "NamedTemporaryFile",
-    "TemporaryFile",
-    "SpooledTemporaryFile",
-    "makefile",
-    "fdopen",
-}
-
-# calls that settle a resource: close/unlink family, pool hand-backs
-_CLEANUP_NAMES = {
-    "close",
-    "unlink",
-    "remove",
-    "rmtree",
-    "release",
-    "shutdown",
-    "terminate",
-    "detach",
-}
+_RESOURCE_FACTORIES = frozenset(
+    {
+        "open",
+        "socket",
+        "create_connection",
+        "socketpair",
+        "mkstemp",
+        "mkdtemp",
+        "NamedTemporaryFile",
+        "TemporaryFile",
+        "SpooledTemporaryFile",
+        "makefile",
+        "fdopen",
+    }
+)
 
 
-def _scan(module: Module) -> astwalk.ModuleScan:
+def _scan(module: Module) -> engine.ModuleScan:
     # one shared scan per module per Analyzer run; checkers run in
-    # sequence on the same thread, so a plain memo on the module works
-    cached = getattr(module, "_astwalk_scan", None)
+    # sequence on the same thread, so a plain memo on the module works.
+    # The protocol/resource prepare passes run before any check, so the
+    # vocabulary tables are already pinned on the module by scan time.
+    cached = getattr(module, "_engine_scan", None)
     if cached is None:
-        cached = astwalk.scan_module(module)
-        module._astwalk_scan = cached  # type: ignore[attr-defined]
+        cached = engine.scan_module(module)
+        module._engine_scan = cached  # type: ignore[attr-defined]
     return cached
+
+
+@register
+class ProtocolChecker(Checker):
+    """Lifecycle typestate: a method annotated ``# protocol: <name>
+    acquire`` opens an obligation the same function must close through
+    a matching ``release`` method on EVERY control-flow path —
+    branches, early returns, and the exception edges of ``try``
+    blocks — unless ownership explicitly escapes (returned, stored on
+    an object, handed to another callable). The dual runtime half is
+    ``analysis.runtime.ProtocolRecorder``. A release the engine proves
+    already-released on every incoming path is a double release."""
+
+    rule = "protocol"
+    cross_module = True  # the vocabulary is declared in other modules
+
+    def prepare(self, modules: list[Module]) -> None:
+        table = protocols.collect_table(modules)
+        for module in modules:
+            module._protocol_table = table  # type: ignore[attr-defined]
+
+    def check(self, module: Module) -> list[Violation]:
+        out: list[Violation] = []
+        for fa in _scan(module).functions:
+            for leak in fa.leaks:
+                if leak.protocol == "resource":
+                    continue
+                releases = (
+                    "/".join(leak.release_names) or "a release method"
+                )
+                if leak.never_released:
+                    how = f"is never released (release via {releases})"
+                elif leak.on_exception and not leak.on_normal:
+                    how = (
+                        f"is not released on an exception path "
+                        f"(release via {releases} in a finally/handler)"
+                    )
+                else:
+                    how = f"may not be released on every path ({releases})"
+                out.append(
+                    Violation(
+                        self.rule,
+                        module.path,
+                        leak.line,
+                        f"protocol {leak.protocol}: '{leak.var}' acquired "
+                        f"here {how}, and ownership does not escape",
+                    )
+                )
+            for dbl in fa.double_releases:
+                out.append(
+                    Violation(
+                        self.rule,
+                        module.path,
+                        dbl.line,
+                        f"protocol {dbl.protocol}: '{dbl.var}' (acquired at "
+                        f"line {dbl.acquire_line}) is already released on "
+                        "every path reaching this release — double release",
+                    )
+                )
+        return out
 
 
 @register
 class GuardedByChecker(Checker):
     """Attributes annotated ``# guarded-by: <lock>`` may only be
-    touched while that lock is held (lexically, or via a ``# holds:``
-    def annotation). ``__init__`` is exempt: no other thread can hold a
-    reference during construction. This is the static form of the
-    invariants connpool/pipeline/segments already document in prose —
-    the dangling-upload and stale-journal regressions were all
-    unguarded cross-thread state in disguise."""
+    touched while that lock is held (per the CFG lock-state analysis,
+    or via a ``# holds:`` def annotation). ``__init__`` is exempt: no
+    other thread can hold a reference during construction."""
 
     rule = "guarded-by"
 
@@ -102,8 +152,7 @@ class BlockingUnderLockChecker(Checker):
     """No sleeps, joins, socket I/O, or future/event waits while any
     lock is held: a blocked holder turns every other thread that needs
     the lock into a convoy, and a blocked holder that also waits on
-    one of those threads is a deadlock (the pipeline drains part
-    futures OUTSIDE the session lock for exactly this reason)."""
+    one of those threads is a deadlock."""
 
     rule = "no-blocking-under-lock"
 
@@ -127,11 +176,8 @@ class BlockingUnderLockChecker(Checker):
 class LockOrderChecker(Checker):
     """The static lock-acquisition graph must be cycle-free. Nodes are
     class-qualified lock paths; an edge A->B is recorded whenever
-    ``with B:`` executes while A is held (nested ``with`` blocks, or a
-    ``# holds: A`` function acquiring B). Two threads taking the same
-    two locks in opposite orders is the one concurrency bug that no
-    amount of testing reliably reproduces — it is purely a property of
-    the code shape, which is exactly what a static pass can prove."""
+    ``with B:`` executes while the engine proves A held (nested
+    ``with`` blocks, or a ``# holds: A`` function acquiring B)."""
 
     rule = "lock-order"
     cross_module = True  # a cycle can close through another module
@@ -185,222 +231,70 @@ class LockOrderChecker(Checker):
 @register
 class ResourceFinalizationChecker(Checker):
     """A socket/file/tempfile created in a function must reach
-    close/unlink on every path: managed by ``with``, closed in a
-    ``finally``, or closed in an exception handler paired with a
-    normal-path close — unless ownership escapes (returned, stored on
-    an object, handed to another call). Leaked sockets on cancel were
-    a real regression class; this rule makes 'who closes it' a
-    property the suite checks instead of a review question."""
+    close/unlink on every CFG path — including the exception edges of
+    any enclosing ``try`` — unless ownership escapes. This is the
+    protocol typestate machinery applied to the builtin "resource"
+    protocol whose acquire set is the factory vocabulary."""
 
     rule = "resource-finalization"
     cross_module = True  # `# resource-factory` defs extend the rule remotely
 
-    def __init__(self) -> None:
-        self._factories = set(_RESOURCE_FACTORIES)
-
     def prepare(self, modules: list[Module]) -> None:
-        # functions annotated `# resource-factory` contribute their
-        # name: calls to them are resource creations wherever they
-        # appear (terminal-name matching, same as the builtin set)
+        factories = set(_RESOURCE_FACTORIES)
         for module in modules:
             if not module.factory_lines:
                 continue  # nothing annotated: skip the full-tree walk
             for node in ast.walk(module.tree):
                 if isinstance(
                     node, (ast.FunctionDef, ast.AsyncFunctionDef)
-                ) and (
-                    node.lineno in module.factory_lines
-                    or any(
-                        line in module.factory_lines
-                        for line in range(
-                            node.lineno,
-                            (node.body[0].lineno if node.body else node.lineno)
-                            + 1,
-                        )
+                ) and any(
+                    line in module.factory_lines
+                    for line in range(
+                        node.lineno,
+                        (node.body[0].lineno if node.body else node.lineno)
+                        + 1,
                     )
                 ):
-                    self._factories.add(node.name)
-
-    @staticmethod
-    def _terminal_name(func: ast.expr) -> str | None:
-        if isinstance(func, ast.Attribute):
-            return func.attr
-        if isinstance(func, ast.Name):
-            return func.id
-        return None
+                    factories.add(node.name)
+        frozen = frozenset(factories)
+        for module in modules:
+            module._factory_names = frozen  # type: ignore[attr-defined]
 
     def check(self, module: Module) -> list[Violation]:
         out: list[Violation] = []
-        for scan_fn in _scan(module).functions:
-            out.extend(self._check_function(module, scan_fn.node))
-        return out
-
-    def _check_function(
-        self, module: Module, func: ast.FunctionDef
-    ) -> list[Violation]:
-        # creations: `name = factory(...)` / `fd, path = mkstemp()`
-        creations: list[tuple[str, int, str]] = []
-        for node in self._walk_own(func):
-            if not isinstance(node, ast.Assign) or not isinstance(
-                node.value, ast.Call
-            ):
-                continue
-            factory = self._terminal_name(node.value.func)
-            if factory not in self._factories:
-                continue
-            for target in node.targets:
-                if isinstance(target, ast.Name):
-                    creations.append((target.id, node.lineno, factory))
-                elif isinstance(target, (ast.Tuple, ast.List)):
-                    for elt in target.elts:
-                        if isinstance(elt, ast.Name):
-                            creations.append((elt.id, node.lineno, factory))
-        if not creations:
-            return []
-
-        out: list[Violation] = []
-        for name, line, factory in creations:
-            verdict = self._settles(func, name, line)
-            if verdict is None:
-                continue
-            out.append(
-                Violation(
-                    self.rule,
-                    module.path,
-                    line,
-                    f"'{name}' from {factory}() {verdict}",
-                )
-            )
-        return out
-
-    def _walk_own(self, func: ast.FunctionDef):
-        """Walk ``func`` without descending into nested defs/lambdas."""
-        stack: list[ast.AST] = list(func.body)
-        while stack:
-            node = stack.pop()
-            if isinstance(
-                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
-            ):
-                continue
-            yield node
-            stack.extend(ast.iter_child_nodes(node))
-
-    def _settles(
-        self, func: ast.FunctionDef, name: str, created_line: int
-    ) -> str | None:
-        """None when the resource is handled; else the complaint."""
-        escaped = False
-        with_managed = False
-        finally_close = False
-        handler_close = False
-        normal_close = False
-
-        finally_ranges: list[tuple[int, int]] = []
-        handler_ranges: list[tuple[int, int]] = []
-        for node in self._walk_own(func):
-            if isinstance(node, ast.Try) and node.finalbody:
-                lo = node.finalbody[0].lineno
-                hi = max(
-                    getattr(s, "end_lineno", s.lineno) or s.lineno
-                    for s in node.finalbody
-                )
-                finally_ranges.append((lo, hi))
-            if isinstance(node, ast.ExceptHandler):
-                lo = node.body[0].lineno if node.body else node.lineno
-                hi = max(
-                    (
-                        getattr(s, "end_lineno", s.lineno) or s.lineno
-                        for s in node.body
-                    ),
-                    default=node.lineno,
-                )
-                handler_ranges.append((lo, hi))
-
-        def in_ranges(line: int, ranges: list[tuple[int, int]]) -> bool:
-            return any(lo <= line <= hi for lo, hi in ranges)
-
-        for node in self._walk_own(func):
-            if isinstance(node, (ast.With, ast.AsyncWith)):
-                for item in node.items:
-                    expr = item.context_expr
-                    if isinstance(expr, ast.Name) and expr.id == name:
-                        with_managed = True
-                    # contextlib.closing(name) / suppress-style wrappers
-                    if isinstance(expr, ast.Call) and any(
-                        isinstance(arg, ast.Name) and arg.id == name
-                        for arg in expr.args
-                    ):
-                        with_managed = True
-            if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
-                value = getattr(node, "value", None)
-                if value is not None and self._mentions(value, name):
-                    escaped = True
-            if isinstance(node, ast.Assign):
-                stores_elsewhere = any(
-                    not isinstance(t, ast.Name) for t in node.targets
-                )
-                if stores_elsewhere and self._mentions(node.value, name):
-                    escaped = True
-            if isinstance(node, ast.Call):
-                terminal = self._terminal_name(node.func)
-                receiver_is_name = isinstance(
-                    node.func, ast.Attribute
-                ) and self._rooted_at(node.func.value, name)
-                if terminal in _CLEANUP_NAMES and (
-                    receiver_is_name
-                    or any(
-                        self._mentions(arg, name)
-                        for arg in list(node.args)
-                        + [kw.value for kw in node.keywords]
+        for fa in _scan(module).functions:
+            for leak in fa.leaks:
+                if leak.protocol != "resource":
+                    continue
+                if leak.never_released:
+                    what = "never reaches close/unlink in this function"
+                elif leak.on_exception and not leak.on_normal:
+                    what = (
+                        "is not closed on an exception path; close it in "
+                        "a finally (or the handler), or use `with`"
                     )
-                ):
-                    if in_ranges(node.lineno, finally_ranges):
-                        finally_close = True
-                    elif in_ranges(node.lineno, handler_ranges):
-                        handler_close = True
-                    else:
-                        normal_close = True
-                elif not receiver_is_name and any(
-                    isinstance(arg, ast.Name) and arg.id == name
-                    for arg in list(node.args)
-                    + [kw.value for kw in node.keywords]
-                ):
-                    # handed to another callable: ownership may move
-                    # (cls(fd), atexit.register(rmtree, path), ...)
-                    escaped = True
-
-        if escaped or with_managed or finally_close:
-            return None
-        if handler_close and normal_close:
-            return None  # the close-in-handler + close-on-success idiom
-        if normal_close or handler_close:
-            return (
-                "is closed on some paths only; use `with`, try/finally, "
-                "or pair the handler close with a success-path close"
-            )
-        return "never reaches close/unlink in this function"
-
-    @staticmethod
-    def _mentions(node: ast.AST, name: str) -> bool:
-        return any(
-            isinstance(sub, ast.Name) and sub.id == name
-            for sub in ast.walk(node)
-        )
-
-    @staticmethod
-    def _rooted_at(node: ast.AST, name: str) -> bool:
-        while isinstance(node, ast.Attribute):
-            node = node.value
-        return isinstance(node, ast.Name) and node.id == name
+                else:
+                    what = (
+                        "is closed on some paths only; use `with`, "
+                        "try/finally, or close it on every branch"
+                    )
+                out.append(
+                    Violation(
+                        self.rule,
+                        module.path,
+                        leak.line,
+                        f"'{leak.var}' from a resource factory {what}",
+                    )
+                )
+        return out
 
 
 @register
 class ExceptionHygieneChecker(Checker):
     """No bare ``except:``, no silent broad swallows, and thread
     targets must be shielded. An exception escaping a thread target
-    kills the worker with nothing but a stderr traceback — the webseed
-    bug class: the job hangs instead of failing. A silent broad
-    ``except Exception: pass`` is the same bug in slow motion."""
+    kills the worker with nothing but a stderr traceback — the job
+    hangs instead of failing."""
 
     rule = "exception-hygiene"
 
@@ -460,97 +354,57 @@ class ExceptionHygieneChecker(Checker):
         return out
 
     def _check_thread_targets(self, module: Module) -> list[Violation]:
-        # index functions for target resolution
-        methods: dict[tuple[str | None, str], ast.FunctionDef] = {}
-
-        def index(body: list[ast.stmt], cls: str | None) -> None:
-            for node in body:
-                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    methods[(cls, node.name)] = node
-                    index(node.body, cls)
-                elif isinstance(node, ast.ClassDef):
-                    index(node.body, node.name)
-
-        index(module.tree.body, None)
-
-        # walk Call nodes carrying the ENCLOSING class, so a
-        # self.<method> target resolves against exactly that class —
-        # never borrowing a same-named (shielded) method elsewhere
-        def iter_calls(node: ast.AST, cls: str | None):
-            for child in ast.iter_child_nodes(node):
-                child_cls = (
-                    child.name if isinstance(child, ast.ClassDef) else cls
-                )
-                if isinstance(child, ast.Call):
-                    yield child, child_cls
-                yield from iter_calls(child, child_cls)
-
+        scan = _scan(module)
         out = []
-        for node, cls in iter_calls(module.tree, None):
-            terminal = (
-                node.func.attr
-                if isinstance(node.func, ast.Attribute)
-                else node.func.id
-                if isinstance(node.func, ast.Name)
-                else None
-            )
-            if terminal not in ("Thread", "Timer"):
-                continue
-            target = next(
-                (kw.value for kw in node.keywords if kw.arg == "target"),
-                None,
-            )
-            if target is None:
-                continue
-            resolved = self._resolve_target(target, methods, cls)
-            if resolved is None:
-                continue  # lambda/partial/unknown: out of static reach
-            if self._is_shielded(resolved, methods, cls):
-                continue
-            out.append(
-                Violation(
-                    self.rule,
-                    module.path,
-                    node.lineno,
-                    f"thread target '{resolved.name}' has no broad "
-                    "exception handler: an escaped exception kills the "
-                    "worker silently",
+        for fa in scan.functions:
+            for spawn in fa.thread_spawns:
+                resolved = self._resolve_target(
+                    spawn.kind, spawn.target_name, scan.methods,
+                    spawn.class_name,
                 )
-            )
+                if resolved is None:
+                    continue  # lambda/partial/unknown: out of static reach
+                if self._is_shielded(
+                    resolved.node, scan.methods, spawn.class_name
+                ):
+                    continue
+                out.append(
+                    Violation(
+                        self.rule,
+                        module.path,
+                        spawn.line,
+                        f"thread target '{resolved.node.name}' has no broad "
+                        "exception handler: an escaped exception kills the "
+                        "worker silently",
+                    )
+                )
         return out
 
     @staticmethod
-    def _resolve_target(
-        target: ast.expr,
-        methods: dict[tuple[str | None, str], ast.FunctionDef],
-        cls: str | None,
-    ) -> ast.FunctionDef | None:
-        if isinstance(target, ast.Attribute) and isinstance(
-            target.value, ast.Name
-        ) and target.value.id == "self":
+    def _resolve_target(kind, name, methods, cls):
+        if name is None:
+            return None
+        if kind == "self":
             # exact class only — a base-class method defined in another
             # module is out of static reach and skipped, never guessed
-            return methods.get((cls, target.attr))
-        if isinstance(target, ast.Name):
+            return methods.get((cls, name))
+        if kind == "name":
             # module-level function, or a helper def nested in this
             # class's methods (indexed under the class)
-            return methods.get((None, target.id)) or methods.get(
-                (cls, target.id)
-            )
+            return methods.get((None, name)) or methods.get((cls, name))
         return None
 
     def _is_shielded(
         self,
         func: ast.FunctionDef,
-        methods: dict[tuple[str | None, str], ast.FunctionDef],
+        methods,
         cls: str | None = None,
         depth: int = 0,
     ) -> bool:
         """A broad handler (bare counts) somewhere in the function's
         own statement tree. Thin delegating wrappers — a body that is a
-        single call (optionally inside one ``with``, the
-        ``tracing.adopt`` pattern) — are followed up to three hops so
-        the shield can live in the real worker."""
+        single call (optionally inside one ``with``) — are followed up
+        to three hops so the shield can live in the real worker."""
         stack: list[ast.AST] = list(func.body)
         while stack:
             node = stack.pop()
@@ -573,11 +427,19 @@ class ExceptionHygieneChecker(Checker):
             return False
         delegate = self._delegation_call(func)
         if delegate is not None:
-            # delegation stays within the wrapper's own class (the
-            # tracing.adopt wrapper pattern), so resolve with its cls
-            resolved = self._resolve_target(delegate, methods, cls)
-            if resolved is not None and resolved is not func:
-                return self._is_shielded(resolved, methods, cls, depth + 1)
+            kind = None
+            name = None
+            if isinstance(delegate, ast.Attribute) and isinstance(
+                delegate.value, ast.Name
+            ) and delegate.value.id == "self":
+                kind, name = "self", delegate.attr
+            elif isinstance(delegate, ast.Name):
+                kind, name = "name", delegate.id
+            resolved = self._resolve_target(kind, name, methods, cls)
+            if resolved is not None and resolved.node is not func:
+                return self._is_shielded(
+                    resolved.node, methods, cls, depth + 1
+                )
         return False
 
     @staticmethod
@@ -600,3 +462,225 @@ class ExceptionHygieneChecker(Checker):
         ):
             return body[0].value.func
         return None
+
+
+@register
+class BlockingDeadlineChecker(Checker):
+    """Every blocking call reachable from daemon/worker code — socket
+    ops, ``wait()``/``join()``/``get()``/``result()``, explicit lock
+    ``acquire()`` — must carry a finite deadline or a registered
+    cancel hook. Reachability is a name-based call-graph walk rooted
+    at the daemon package and every ``threading.Thread`` target; an
+    un-cancellable wait anywhere on those paths is exactly the wedged-
+    worker class the watchdog PRs spent review rounds hunting.
+
+    What satisfies the audit, per call shape:
+
+    - ``wait``/``join``/``result``/``get``/``select``: a finite
+      timeout argument (``timeout=None`` does not count; ``get()``
+      with positional arguments is assumed to be ``dict.get``);
+      ``wait()`` on a cancel token is the cancel mechanism itself.
+    - explicit ``acquire()`` on a lock-like receiver: a timeout
+      (``with lock:`` is exempt — lock holders cannot block, by the
+      no-blocking-under-lock rule, so the wait is bounded).
+    - socket ops (``recv``/``accept``/``connect``/...): a
+      ``settimeout`` in the same function or class, or a ``timeout=``
+      kwarg at the connection constructor in the same class.
+    - anything else: a ``# deadline: <reason>`` annotation on the call
+      line or the def line, documenting how the wait is bounded (the
+      reason is the review artifact, like suppressions)."""
+
+    rule = "blocking-deadline"
+    cross_module = True  # reachability crosses modules
+
+    _DAEMON_MARKERS = ("/daemon/", "\\daemon\\")
+
+    def __init__(self) -> None:
+        self._reachable: set[int] = set()
+
+    def prepare(self, modules: list[Module]) -> None:
+        by_name: dict[str, list[engine.FunctionAnalysis]] = {}
+        scans = []
+        for module in modules:
+            scan = _scan(module)
+            scans.append((module, scan))
+            for fa in scan.functions:
+                by_name.setdefault(fa.node.name, []).append(fa)
+
+        roots: list[engine.FunctionAnalysis] = []
+        for module, scan in scans:
+            is_daemon = any(
+                marker in module.path for marker in self._DAEMON_MARKERS
+            )
+            for fa in scan.functions:
+                if is_daemon:
+                    roots.append(fa)
+                for spawn in fa.thread_spawns:
+                    if spawn.target_name:
+                        roots.extend(by_name.get(spawn.target_name, ()))
+
+        work = list(roots)
+        while work:
+            fa = work.pop()
+            if id(fa) in self._reachable:
+                continue
+            self._reachable.add(id(fa))
+            for name in fa.calls:
+                for target in by_name.get(name, ()):
+                    if id(target) not in self._reachable:
+                        work.append(target)
+
+    def _class_evidence(self, scan: engine.ModuleScan) -> set[str | None]:
+        """Classes with any deadline discipline in view: a settimeout
+        call or a timeout= kwarg anywhere in their methods."""
+        out: set[str | None] = set()
+        for fa in scan.functions:
+            if fa.has_settimeout or fa.has_timeout_kwarg:
+                out.add(fa.class_name)
+        return out
+
+    @staticmethod
+    def _annotated(module: Module, fa, line: int) -> bool:
+        if module.deadline_reason(line) is not None:
+            return True
+        # the reason is REQUIRED, like suppressions: an empty
+        # `# deadline:` annotates nothing
+        func = fa.node
+        end = func.body[0].lineno if func.body else func.lineno
+        return any(
+            module.deadline_lines.get(ln)
+            for ln in range(func.lineno, end + 1)
+        )
+
+    @staticmethod
+    def _is_cancel_receiver(site: engine.DeadlineSite) -> bool:
+        name = (site.receiver or site.receiver_name or "").rsplit(
+            ".", 1
+        )[-1].lower()
+        return name.endswith("token") or name in ("cancel", "cancelled")
+
+    def check(self, module: Module) -> list[Violation]:
+        scan = _scan(module)
+        evidence = self._class_evidence(scan)
+        out: list[Violation] = []
+        for fa in scan.functions:
+            if id(fa) not in self._reachable:
+                continue
+            for site in fa.deadline_sites:
+                complaint = self._judge(fa, site, evidence)
+                if complaint is None:
+                    continue
+                if self._annotated(module, fa, site.line):
+                    continue
+                out.append(
+                    Violation(self.rule, module.path, site.line, complaint)
+                )
+        return out
+
+    def _judge(self, fa, site: engine.DeadlineSite, evidence) -> str | None:
+        name = site.name
+        if name in engine.SOCKET_OPS:
+            if (
+                fa.has_settimeout
+                or fa.class_name in evidence
+                or None in evidence
+                and fa.class_name is None
+            ):
+                return None
+            return (
+                f"socket op '{name}()' reachable from daemon/worker code "
+                "with no settimeout/timeout evidence in this class; set a "
+                "finite timeout or annotate `# deadline:` with the bound"
+            )
+        if site.timeout == "finite":
+            return None
+        if name == "get":
+            if site.pos_args > 0:
+                return None  # dict.get(key[, default]) shape
+            return (
+                "queue get() with no timeout blocks forever; pass "
+                "timeout= or poll with a cancel check"
+            )
+        if name in ("wait", "join", "result", "select"):
+            if name == "wait" and self._is_cancel_receiver(site):
+                return None  # waiting ON the cancel token IS the hook
+            return (
+                f"'{name}()' with no finite timeout is an un-cancellable "
+                "wait; pass a timeout (and loop on a cancel check) or "
+                "annotate `# deadline:` naming what bounds it"
+            )
+        if name == "acquire":
+            path = site.receiver or site.receiver_name or ""
+            if path and engine.is_lock_path(path):
+                return (
+                    "explicit lock acquire() without a timeout; use "
+                    "`with` for scoped holds or pass timeout="
+                )
+            return None
+        return None
+
+
+@register
+class EnvKnobChecker(Checker):
+    """Every env knob the package reads must have a row in the
+    README's configuration table: an undocumented knob is operator-
+    facing behavior (capacity planning, data paths, feature gates)
+    nobody can plan around. Promoted from the test-suite lint so it
+    anchors violations at the offending read, file:line."""
+
+    rule = "env-knob-documented"
+
+    # standard platform variables the package honors but did not
+    # invent — not operator knobs, no README row expected
+    PLATFORM_ENV_VARS = frozenset({"XDG_CACHE_HOME"})
+
+    def __init__(self) -> None:
+        self._readme_cache: dict[str, str | None] = {}
+
+    def _readme_for(self, path: str) -> str | None:
+        """Contents of the nearest README.md walking up from the
+        analyzed file; None when there is none (fixture trees)."""
+        current = Path(path).resolve().parent
+        for _ in range(6):
+            key = str(current)
+            if key in self._readme_cache:
+                return self._readme_cache[key]
+            candidate = current / "README.md"
+            if candidate.is_file():
+                text = candidate.read_text()
+                self._readme_cache[key] = text
+                return text
+            if current.parent == current:
+                break
+            current = current.parent
+        self._readme_cache[str(Path(path).resolve().parent)] = None
+        return None
+
+    def check(self, module: Module) -> list[Violation]:
+        scan = _scan(module)
+        if not scan.env_reads:
+            return []
+        readme = self._readme_for(module.path)
+        if readme is None:
+            return []
+        out: list[Violation] = []
+        seen: set[tuple[str, int]] = set()
+        for read in scan.env_reads:
+            if read.name in self.PLATFORM_ENV_VARS:
+                continue
+            if f"`{read.name}`" in readme:
+                continue
+            key = (read.name, read.line)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(
+                Violation(
+                    self.rule,
+                    module.path,
+                    read.line,
+                    f"env knob '{read.name}' is read here but has no "
+                    f"`{read.name}` row in the README configuration table",
+                )
+            )
+        return out

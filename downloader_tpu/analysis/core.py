@@ -22,6 +22,17 @@ _SUPPRESS_RE = re.compile(r"analysis:\s*ignore\[([a-z0-9-]+)\]\s*(.*)")
 _GUARDED_RE = re.compile(r"guarded-by:\s*([A-Za-z_][\w.]*)")
 _HOLDS_RE = re.compile(r"holds:\s*([A-Za-z_][\w.]*(?:\s*,\s*[A-Za-z_][\w.]*)*)")
 _FACTORY_RE = re.compile(r"resource-factory\b")
+# `# protocol: <name> acquire|release [bind=<param>] [conditional]
+# [may-raise]` on a def line declares that method part of a lifecycle
+# protocol (see checkers.ProtocolChecker); anchored at the comment
+# start so prose mentioning the word "protocol:" cannot declare one
+_PROTOCOL_RE = re.compile(
+    r"^protocol:\s*([a-z0-9-]+)\s+(acquire|release)\b\s*(.*)$"
+)
+# `# deadline: <reason>` on a blocking call (or its def) documents how
+# the wait is bounded — a cancel hook, a socket timeout set at
+# creation, a supervisor. The reason is REQUIRED, like suppressions.
+_DEADLINE_RE = re.compile(r"^deadline:\s*(.*)$")
 
 SUPPRESSION_RULE = "suppression"
 
@@ -73,6 +84,12 @@ class Module:
         # lines carrying `# resource-factory` (on a def: its calls are
         # treated as resource creations by the finalization checker)
         self.factory_lines: set[int] = set()
+        # line -> (protocol, kind, options) protocol declarations
+        self.protocol_lines: dict[int, list[tuple[str, str, str]]] = {}
+        # line -> reason from a `# deadline:` annotation; a standalone
+        # comment line also covers the following line, like suppressions
+        self.deadline_lines: dict[int, str] = {}
+        self._standalone_deadline_lines: set[int] = set()
         self._scan_comments()
 
     @classmethod
@@ -106,8 +123,28 @@ class Module:
                     )
                 if _FACTORY_RE.search(text):
                     self.factory_lines.add(line)
+                match = _PROTOCOL_RE.match(text)
+                if match:
+                    self.protocol_lines.setdefault(line, []).append(
+                        (match.group(1), match.group(2), match.group(3))
+                    )
+                match = _DEADLINE_RE.match(text)
+                if match:
+                    self.deadline_lines[line] = match.group(1).strip()
+                    if tok.line[: tok.start[1]].strip() == "":
+                        self._standalone_deadline_lines.add(line)
         except (tokenize.TokenError, IndentationError):
             pass  # ast.parse already succeeded; treat as comment-free
+
+    def deadline_reason(self, line: int) -> str | None:
+        """The `# deadline:` reason covering ``line``: on the line
+        itself, or on a standalone comment line directly above it."""
+        reason = self.deadline_lines.get(line)
+        if reason:
+            return reason
+        if line - 1 in self._standalone_deadline_lines:
+            return self.deadline_lines.get(line - 1) or None
+        return None
 
     def holds_for(self, func: ast.AST) -> tuple[str, ...]:
         """Lock paths a `# holds:` annotation declares on the def line
@@ -244,11 +281,15 @@ class Analyzer:
         # it silences may need a module that is not being analyzed.
         self._full_scope = full_scope
 
-    def run(self, paths: list[str | Path]) -> list[Violation]:
+    def run(self, paths: list[str | Path], scan_cache=None) -> list[Violation]:
         """Analyze ``paths``; returns unsuppressed violations, plus a
         ``suppression`` violation per reasonless ignore and per stale
         ignore (one that matched no finding — judged for cross-module
-        rules only under ``full_scope``), sorted by location."""
+        rules only under ``full_scope``), sorted by location.
+
+        ``scan_cache`` (a ``cache.ScanCache``) lets unchanged files
+        adopt their stored engine scans instead of rebuilding CFGs;
+        every checker still runs live, so results are identical."""
         modules: list[Module] = []
         violations: list[Violation] = []
         for path in paths:
@@ -260,6 +301,8 @@ class Analyzer:
                         "syntax-error", str(path), exc.lineno or 0, exc.msg or ""
                     )
                 )
+        if scan_cache is not None:
+            scan_cache.adopt(modules)
         for checker in self._checkers:
             checker.prepare(modules)
         by_path = {m.path: m for m in modules}
@@ -319,6 +362,8 @@ class Analyzer:
                             )
                         )
         kept.sort(key=lambda v: (v.path, v.line, v.rule))
+        if scan_cache is not None:
+            scan_cache.update(modules, kept)
         return kept
 
 

@@ -1,5 +1,16 @@
-"""Runtime lock-order recorder: the dynamic half of the ``lock-order``
-rule.
+"""Runtime recorders: the dynamic halves of the ``lock-order`` and
+``protocol`` rules.
+
+``LockOrderRecorder`` is the lock-order half (below).
+``ProtocolRecorder`` is the protocol typestate half: it patches the
+acquire/release methods of the six declared lifecycle protocols (the
+``protocols.RUNTIME_PROTOCOLS`` table — same vocabulary the static
+rule reads from the ``# protocol:`` annotations) and tracks every
+still-open obligation, so a test suite can assert at teardown that
+nothing acquired during the run leaked. The static rule proves
+release-on-all-paths per function; the recorder catches the residue
+the engine cannot see — obligations handed across threads, stored on
+objects, or released through unresolvable dynamic dispatch.
 
 The static checker proves the LEXICAL acquisition graph acyclic, but
 it cannot see orders established through calls (session lock held in
@@ -26,12 +37,16 @@ implements the private ``_release_save``/``_acquire_restore``/
 
 from __future__ import annotations
 
+import functools
+import importlib
+import inspect
 import queue as _queue_module
 import sys
 import threading
 from collections import defaultdict
 
 from .core import find_cycles
+from .protocols import RUNTIME_PROTOCOLS
 
 _REAL_LOCK = threading.Lock
 _REAL_RLOCK = threading.RLock
@@ -240,3 +255,164 @@ _CURRENT: "LockOrderRecorder | None" = None
 
 def current() -> "LockOrderRecorder | None":
     return _CURRENT
+
+
+# -- protocol recorder --------------------------------------------------------
+
+
+def _acquire_site() -> str:
+    """file:line of the nearest caller outside this module — the
+    acquisition site a leak report points at."""
+    frame = sys._getframe(2)
+    while frame is not None:
+        if frame.f_code.co_filename != __file__:
+            return f"{frame.f_code.co_filename}:{frame.f_lineno}"
+        frame = frame.f_back
+    return "<unknown>"
+
+
+class ProtocolRecorder:
+    """Patch the declared protocol classes so every runtime acquisition
+    is tracked until its matching release; ``leaked()`` lists whatever
+    is still open. Keys are the obligation's identity: the object for
+    ``self``/``result`` obligations (a strong reference is held, so
+    ids stay stable), the value itself for string keys (upload ids,
+    ledger charge keys). Releases are idempotent — popping an absent
+    key is a no-op, mirroring the double-settle-safe design of every
+    seeded protocol — and a release method that raises has NOT
+    released (``complete_multipart``'s failure path must still reach
+    ``abort_multipart``)."""
+
+    def __init__(self, protocols: dict | None = None):
+        self._protocols = RUNTIME_PROTOCOLS if protocols is None else protocols
+        self._lock = _REAL_LOCK()
+        # (protocol, key) -> {"site": file:line, "obj": strong ref}
+        self._open: dict[tuple[str, object], dict] = {}
+        self._patched: list[tuple[type, str, object]] = []
+        self._installed = False
+
+    # -- bookkeeping ------------------------------------------------------
+
+    @staticmethod
+    def _key_of(value) -> object:
+        if isinstance(value, (str, bytes, int)):
+            return value
+        return id(value)
+
+    def _note_acquire(self, protocol: str, value, site: str) -> None:
+        with self._lock:
+            self._open[(protocol, self._key_of(value))] = {
+                "site": site,
+                "obj": value,
+            }
+
+    def _note_release(self, protocol: str, value) -> None:
+        with self._lock:
+            self._open.pop((protocol, self._key_of(value)), None)
+
+    # -- patching ---------------------------------------------------------
+
+    @staticmethod
+    def _resolver(key: str, original):
+        """callable(receiver, args, kwargs, result) -> obligation value
+        for one method spec's key expression."""
+        if key == "self":
+            return lambda receiver, args, kwargs, result: receiver
+        if key == "result":
+            return lambda receiver, args, kwargs, result: result
+        param = key[len("arg:"):]
+        signature = inspect.signature(original)
+
+        def resolve(receiver, args, kwargs, result):
+            try:
+                bound = signature.bind(receiver, *args, **kwargs)
+            except TypeError:
+                return None
+            return bound.arguments.get(param)
+
+        return resolve
+
+    def _wrap(self, protocol: str, spec: dict, original):
+        recorder = self
+        is_acquire = spec["kind"] == "acquire"
+        conditional = spec.get("conditional", False)
+        skip_types = spec.get("skip_types", ())
+        resolve = self._resolver(spec["key"], original)
+
+        @functools.wraps(original)
+        def wrapper(self, *args, **kwargs):
+            result = original(self, *args, **kwargs)
+            value = resolve(self, args, kwargs, result)
+            if value is None:
+                return result
+            if is_acquire:
+                if conditional and not result:
+                    return result
+                if type(value).__name__ in skip_types:
+                    return result
+                recorder._note_acquire(protocol, value, _acquire_site())
+            else:
+                recorder._note_release(protocol, value)
+            return result
+
+        return wrapper
+
+    def install(self) -> "ProtocolRecorder":
+        if self._installed:
+            return self
+        try:
+            for protocol, table in self._protocols.items():
+                module = importlib.import_module(table["module"])
+                for spec in table["methods"]:
+                    cls = getattr(module, spec["class"])
+                    original = cls.__dict__[spec["name"]]
+                    setattr(
+                        cls, spec["name"], self._wrap(protocol, spec, original)
+                    )
+                    self._patched.append((cls, spec["name"], original))
+        except BaseException:
+            # a spec that no longer matches the code (renamed method,
+            # moved to a base class) must not strand the methods
+            # already wrapped: callers hold install() OUTSIDE their
+            # try/finally, so a partial install would outlive the test
+            for cls, name, original in reversed(self._patched):
+                setattr(cls, name, original)
+            self._patched.clear()
+            raise
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for cls, name, original in reversed(self._patched):
+            setattr(cls, name, original)
+        self._patched.clear()
+        self._installed = False
+
+    def __enter__(self) -> "ProtocolRecorder":
+        return self.install()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.uninstall()
+
+    # -- results ----------------------------------------------------------
+
+    def open_count(self) -> int:
+        with self._lock:
+            return len(self._open)
+
+    def leaked(self) -> list[str]:
+        """One line per still-open obligation: the protocol, what was
+        acquired, and where — empty means every runtime acquisition
+        observed during the session reached its release."""
+        with self._lock:
+            items = sorted(
+                ((proto, info) for (proto, _), info in self._open.items()),
+                key=lambda pair: (pair[0], pair[1]["site"]),
+            )
+        return [
+            f"{proto}: {type(info['obj']).__name__!s} acquired at "
+            f"{info['site']} was never released"
+            for proto, info in items
+        ]

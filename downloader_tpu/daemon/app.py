@@ -952,15 +952,18 @@ class Daemon:
             }
 
             def _capture():
-                bundle = incident.RECORDER.capture(
-                    f"admission shed ({reason})",
-                    trigger="admission",
-                    extra=extra,
-                )
-                if bundle is None:
-                    # suppressed by the recorder's shared auto rate
-                    # limit: don't burn the episode's one capture on it
-                    admission.CONTROLLER.rearm_episode()
+                try:
+                    bundle = incident.RECORDER.capture(
+                        f"admission shed ({reason})",
+                        trigger="admission",
+                        extra=extra,
+                    )
+                    if bundle is None:
+                        # suppressed by the recorder's shared auto rate
+                        # limit: don't burn the episode's one capture on it
+                        admission.CONTROLLER.rearm_episode()
+                except Exception as exc:
+                    log.warning(f"admission incident capture failed: {exc}")
 
             try:
                 threading.Thread(
@@ -969,10 +972,7 @@ class Daemon:
             except RuntimeError:
                 # thread exhaustion IS the overload regime; capture
                 # inline rather than losing the episode's one bundle
-                try:
-                    _capture()
-                except Exception as exc:
-                    log.warning(f"admission incident capture failed: {exc}")
+                _capture()
         self.stats.bump(shed=1)
         log.with_fields(
             tenant=delivery.tenant, job_class=delivery.job_class or "",
@@ -1057,6 +1057,7 @@ class Daemon:
 
         self._token.wait()  # block until cancelled
         for worker in self._workers:
+            # deadline: runs after cancellation — every worker blocking op is bounded (dequeue poll, socket timeouts, watchdog cancel) and the loop exits on the cancelled token
             worker.join()
         # stop the shard consumers FIRST: closing their channels requeues
         # everything unacked at the broker and stops redelivery. Only then

@@ -207,6 +207,7 @@ class DHTClient:
                 }
             )
             try:
+                # deadline: pool sockets are non-blocking (setblocking(False) in _SockPool); a full buffer raises instead of parking
                 pool.for_addr(resolved).sendto(payload, resolved)
             except OSError as exc:
                 log.with_fields(node=f"{addr[0]}:{addr[1]}").debug(
@@ -226,6 +227,7 @@ class DHTClient:
                 sock = key.fileobj
                 while True:
                     try:
+                        # deadline: pool sockets are non-blocking; the select(remain) above is the only wait and it is bounded
                         datagram, src = sock.recvfrom(65536)
                     except (BlockingIOError, OSError):
                         break

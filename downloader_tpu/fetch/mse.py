@@ -89,7 +89,7 @@ def _pad() -> bytes:
     return secrets.token_bytes(secrets.randbelow(MAX_PAD + 1))
 
 
-def _recv_exact(sock: socket.socket, count: int) -> bytes:
+def _recv_exact(sock: socket.socket, count: int) -> bytes:  # deadline: handshake sockets carry the caller's settimeout (peerwire dial, inbound listener 120s)
     data = bytearray()
     while len(data) < count:
         chunk = sock.recv(count - len(data))
@@ -99,7 +99,7 @@ def _recv_exact(sock: socket.socket, count: int) -> bytes:
     return bytes(data)
 
 
-def _sync_on(sock: socket.socket, marker: bytes, window: int, prefix: bytes) -> bytes:
+def _sync_on(sock: socket.socket, marker: bytes, window: int, prefix: bytes) -> bytes:  # deadline: handshake sockets carry the caller's settimeout (peerwire dial, inbound listener 120s)
     """Read until ``marker`` is found within ``window`` bytes; returns
     the bytes that FOLLOW the marker (already-read surplus)."""
     buf = bytearray(prefix)
@@ -196,7 +196,7 @@ def initiate(
     marker = rx.crypt(VC)
     surplus = _sync_on(sock, marker, DH_KEY_BYTES + MAX_PAD + len(marker), b"")
 
-    def read_encrypted(count: int) -> bytes:
+    def read_encrypted(count: int) -> bytes:  # deadline: handshake sockets carry the caller's settimeout (peerwire dial, inbound listener 120s)
         nonlocal surplus
         while len(surplus) < count:
             chunk = sock.recv(4096)
@@ -240,12 +240,12 @@ def accept(
         raise MSEError("oversized detection prefix")
     ya = prefix + _recv_exact(sock, DH_KEY_BYTES - len(prefix))
     private, public = _keypair()
-    sock.sendall(public + _pad())
+    sock.sendall(public + _pad())  # deadline: handshake sockets carry the caller's settimeout (peerwire dial, inbound listener 120s)
     s = _secret(private, ya)
 
     surplus = _sync_on(sock, _sha1(b"req1", s), _SYNC_WINDOW, b"")
 
-    def read_raw(count: int) -> bytes:
+    def read_raw(count: int) -> bytes:  # deadline: handshake sockets carry the caller's settimeout (peerwire dial, inbound listener 120s)
         nonlocal surplus
         while len(surplus) < count:
             chunk = sock.recv(4096)
@@ -285,7 +285,7 @@ def accept(
         raise MSEError(f"no acceptable crypto in provide {crypto_provide:#x}")
 
     reply = VC + struct.pack(">I", crypto_select) + struct.pack(">H", 0)
-    sock.sendall(tx.crypt(reply))
+    sock.sendall(tx.crypt(reply))  # deadline: handshake sockets carry the caller's settimeout (peerwire dial, inbound listener 120s)
 
     if crypto_select == CRYPTO_RC4:
         return EncryptedSocket(sock, tx, rx, buffered=rx.crypt(surplus)), ia

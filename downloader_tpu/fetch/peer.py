@@ -287,6 +287,7 @@ class SwarmDownloader:
                 }
                 for future in concurrent.futures.as_completed(futures):
                     try:
+                        # deadline: each announce runs with per-tracker HTTP/UDP timeouts, so the future settles within those bounds
                         found = future.result()
                     except TransferError as exc:
                         errors.append(f"{futures[future]}: {exc}")
@@ -838,9 +839,7 @@ class SwarmDownloader:
             for worker in workers:
                 worker.start()
             for worker in workers:
-                # plain join is safe: each PeerConnection registers a
-                # cancel hook that closes its socket, so a cancel
-                # unblocks every worker promptly and they exit
+                # deadline: each PeerConnection registers a cancel hook that closes its socket, so a cancel unblocks every worker promptly and they exit
                 worker.join()
             token.raise_if_cancelled()
             if swarm.done():
@@ -858,6 +857,7 @@ class SwarmDownloader:
         # webseeds may still be mid-fetch when the peer rounds end —
         # including the zero-peers case, where they're the only source
         for worker in web_workers:
+            # deadline: webseed workers run HTTP/FTP ops under 30s connection timeouts and exit on the cancelled token between requests
             worker.join()
         token.raise_if_cancelled()
 

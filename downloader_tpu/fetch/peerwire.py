@@ -125,7 +125,7 @@ def _frame(msg_id: int, payload: bytes = b"") -> bytes:
     return struct.pack(">IB", 1 + len(payload), msg_id) + payload
 
 
-def _recv_into(sock: socket.socket, count: int) -> bytes | None:
+def _recv_into(sock: socket.socket, count: int) -> bytes | None:  # deadline: callers set settimeout on the socket first (PeerConnection dial timeout, inbound listener 120s, seeder 20s)
     """Read exactly ``count`` bytes; None on EOF (callers raise their
     side's idiomatic exception — TransferError outbound, OSError inbound)."""
     data = bytearray()

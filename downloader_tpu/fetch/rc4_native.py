@@ -54,7 +54,6 @@ def _compile_source(src_path: str, final: str) -> str | None:
         return None
     tmp = None
     try:
-        # analysis: ignore[resource-finalization] fd is closed on the very next statement; nothing that can raise sits in between
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(final))
         os.close(fd)
         subprocess.run(

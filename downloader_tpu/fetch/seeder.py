@@ -339,5 +339,5 @@ class Seeder:
                     # remote's local id for ut_metadata is 1 (peer.py UT_METADATA)
                     self._send(sock, MSG_EXTENDED, bytes([1]) + header + chunk)
 
-    def _send(self, sock: socket.socket, msg_id: int, payload: bytes = b"") -> None:
+    def _send(self, sock: socket.socket, msg_id: int, payload: bytes = b"") -> None:  # deadline: PeerHandler.handle sets settimeout(20) on every peer socket before serving
         sock.sendall(struct.pack(">IB", 1 + len(payload), msg_id) + payload)

@@ -789,6 +789,7 @@ class SegmentedFetcher:
                 for worker in workers:
                     worker.start()
                 for worker in workers:
+                    # deadline: segment workers run on sockets with finite timeouts and the fetch cancel hook shuts their sockets down, so each join is bounded
                     worker.join()
 
             if state.failure is not None:

@@ -152,6 +152,7 @@ class _ClientSession:
     def run(self) -> None:
         header = self._recv_exact(8)
         if header != wire.PROTOCOL_HEADER:
+            # deadline: test-stub session; kill()/stop() close the socket, unblocking any parked write
             self._sock.sendall(wire.PROTOCOL_HEADER)  # version rejection
             return
         start = (
@@ -251,7 +252,7 @@ class _ClientSession:
                 self.kill()
                 return
 
-    def _recv_exact(self, count: int) -> bytes:
+    def _recv_exact(self, count: int) -> bytes:  # deadline: test-stub session; the stub's heartbeat loop kills wedged sessions and kill()/stop() close the socket
         data = bytearray()
         while len(data) < count:
             chunk = self._sock.recv(count - len(data))

@@ -288,7 +288,7 @@ def _decode_field_value(raw: bytes, pos: int):
 # framing
 
 
-def write_frame(sock: socket.socket, frame_type: int, channel: int, payload: bytes) -> None:
+def write_frame(sock: socket.socket, frame_type: int, channel: int, payload: bytes) -> None:  # deadline: a sendall parked by broker flow control is healthy (streadway semantics); the heartbeat monitor closes the socket of a dead peer, waking it
     frame = (
         struct.pack(">BHI", frame_type, channel, len(payload))
         + payload
@@ -304,7 +304,7 @@ def write_method(
     write_frame(sock, FRAME_METHOD, channel, payload)
 
 
-def _recv_exact(sock: socket.socket, count: int) -> bytes:
+def _recv_exact(sock: socket.socket, count: int) -> bytes:  # deadline: the connection's heartbeat monitor tears down idle/dead sockets (kernel keepalives back it up), raising OSError in any blocked read
     data = bytearray()
     while len(data) < count:
         chunk = sock.recv(count - len(data))

@@ -404,8 +404,13 @@ class QueueClient:
 
     def done(self, poll_interval: float | None = None) -> None:
         """Block until, after cancellation, in-flight deliveries settle and
-        the connection is closed (reference Done, client.go:400-402)."""
-        self._done.wait()
+        the connection is closed (reference Done, client.go:400-402).
+        Waits in ``poll_interval`` slices (default 0.5s) so the caller's
+        thread stays interruptible instead of parking forever on the
+        event."""
+        interval = 0.5 if poll_interval is None else poll_interval
+        while not self._done.wait(timeout=interval):
+            pass
 
     # -- delivery accounting ---------------------------------------------
 

@@ -113,7 +113,7 @@ def ack_batch(deliveries: "list[Delivery]") -> int:
 
 
 class Delivery:
-    def __init__(
+    def __init__(  # protocol: delivery-settle acquire
         self,
         message: Message,
         channel: Channel,
@@ -174,7 +174,7 @@ class Delivery:
             # a broken release hook must not poison the settle path
             log.warning(f"delivery settle hook raised: {exc}")
 
-    def _settle(self) -> bool:
+    def _settle(self) -> bool:  # protocol: delivery-settle release
         with self._lock:
             if self._settled:
                 return False
@@ -189,7 +189,7 @@ class Delivery:
     def settled(self) -> bool:
         return self._settled
 
-    def ack(self) -> None:
+    def ack(self) -> None:  # protocol: delivery-settle release
         if not self._settle():
             return
         try:
@@ -198,7 +198,7 @@ class Delivery:
             # connection died: the broker will redeliver (at-least-once)
             log.warning(f"failed to ack message: {exc}")
 
-    def nack(self, requeue: bool = False) -> None:
+    def nack(self, requeue: bool = False) -> None:  # protocol: delivery-settle release
         if not self._settle():
             return
         try:
@@ -206,7 +206,7 @@ class Delivery:
         except BrokerError as exc:
             log.warning(f"failed to nack message: {exc}")
 
-    def error(self) -> None:
+    def error(self) -> None:  # protocol: delivery-settle release
         """Retry the message: republish with an incremented X-Retries, then
         ack the original. The republish must be CONFIRMED on the broker
         before the ack — when the delivery came through a QueueClient the
@@ -264,7 +264,7 @@ class Delivery:
             # at-least-once, not loss
             log.warning(f"failed to ack message post-retry: {exc}")
 
-    def shed(
+    def shed(  # protocol: delivery-settle release
         self,
         dlq_queue: str,
         reason: str,

@@ -275,6 +275,7 @@ class _FileStream:
         for future in list(self.futures.values()):
             if not future.cancelled():
                 try:
+                    # deadline: part uploads run S3 requests over sockets with finite timeouts, so every in-flight future settles within those bounds
                     future.result()
                 except Exception as exc:
                     # ship() already recorded the first failure for the

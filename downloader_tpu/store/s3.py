@@ -479,7 +479,7 @@ class S3Client:
         stream.seek(start)
         return digest.hexdigest()
 
-    def initiate_multipart(
+    def initiate_multipart(  # protocol: multipart-upload acquire
         self,
         bucket: str,
         key: str,
@@ -566,7 +566,7 @@ class S3Client:
             raise last_error
         raise S3Error(0, f"part {number}: {last_error}")
 
-    def complete_multipart(
+    def complete_multipart(  # protocol: multipart-upload release bind=upload_id may-raise
         self,
         bucket: str,
         key: str,
@@ -600,7 +600,7 @@ class S3Client:
         if status != 200 or b"<Error>" in body:
             raise S3Error(status, body.decode(errors="replace")[:200])
 
-    def abort_multipart(self, bucket: str, key: str, upload_id: str) -> None:
+    def abort_multipart(self, bucket: str, key: str, upload_id: str) -> None:  # protocol: multipart-upload release bind=upload_id
         """Abort an in-progress multipart upload so the store doesn't
         accrue orphaned part storage. Deliberately token-free — aborts
         must run even ON cancellation — with a short timeout so a

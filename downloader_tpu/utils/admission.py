@@ -274,7 +274,7 @@ class Ledger:
         self._used[budget] = self._used.get(budget, 0) + amount
         self._charges.setdefault(key, {})[budget] = amount
 
-    def charge(self, budget: str, key: str, amount: int) -> bool:
+    def charge(self, budget: str, key: str, amount: int) -> bool:  # protocol: ledger-charge acquire bind=key
         """Record ``amount`` against ``budget`` under ``key``; returns
         whether the budget is still within its limit afterwards. Always
         records (the caller already allocated) — an over-limit verdict
@@ -291,7 +291,7 @@ class Ledger:
             limit = self._limits.get(budget, 0)
         return limit <= 0 or used <= limit
 
-    def try_charge(self, budget: str, key: str, amount: int) -> bool:
+    def try_charge(self, budget: str, key: str, amount: int) -> bool:  # protocol: ledger-charge acquire bind=key conditional
         """Charge only if it fits; nothing is recorded on refusal, so
         a refused admission can retry later. Idempotent: a key already
         charged against ``budget`` is a successful no-op."""
@@ -306,7 +306,7 @@ class Ledger:
             self._record(budget, key, amount)
         return True
 
-    def refund(self, key: str) -> None:
+    def refund(self, key: str) -> None:  # protocol: ledger-charge release bind=key
         """Release every charge recorded under ``key``; safe to call
         any number of times (the second and later are no-ops)."""
         with self._lock:

@@ -84,11 +84,11 @@ class CancelToken:
         """Block until cancelled (or timeout); returns True if cancelled."""
         return self._event.wait(timeout)
 
-    def child(self) -> "CancelToken":
+    def child(self) -> "CancelToken":  # protocol: cancel-token acquire
         """Derive a token cancelled when either it or this token cancels."""
         return CancelToken(parent=self)
 
-    def detach(self) -> None:
+    def detach(self) -> None:  # protocol: cancel-token release
         """Unlink this token from its parent's fan-out list. A
         per-job child token that is not detached when its job settles
         accumulates in the daemon-lifetime parent forever — one dead

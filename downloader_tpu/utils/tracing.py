@@ -318,7 +318,7 @@ class Tracer:
         trace.root.meta = {"job_id": job_id} if job_id else None
         return _RootCM(self, trace)  # type: ignore[return-value]
 
-    def open_job(self, job_id: str = "") -> "OpenTrace":
+    def open_job(self, job_id: str = "") -> "OpenTrace":  # protocol: tracer-trace acquire
         """A manually driven job trace for work whose lifecycle cannot
         be one ``with`` block — the batched fast path records each
         job's phases inside ``activate()`` blocks on the worker thread,
@@ -498,7 +498,7 @@ class OpenTrace:
         thread's current span, so ``span()`` calls nest under it."""
         return adopt(self._trace.root if self._trace is not None else None)
 
-    def complete(self) -> None:
+    def complete(self) -> None:  # protocol: tracer-trace release
         trace, self._trace = self._trace, None
         if trace is None:
             return

@@ -368,7 +368,7 @@ class Watchdog:
 
     # -- registration ------------------------------------------------------
 
-    def job(self, name: str, cancel=None) -> "TaskWatch | _NoopWatch":
+    def job(self, name: str, cancel=None) -> "TaskWatch | _NoopWatch":  # protocol: watchdog-watch acquire
         """Register a job watch — or hand out the shared no-op when the
         watchdog is disabled (WATCHDOG_STALL_S=0), so an ablated run
         pays nothing: no registration, no real counters, no scanning.
@@ -380,7 +380,7 @@ class Watchdog:
             self._watches[watch.key] = watch
         return watch
 
-    def loop(
+    def loop(  # protocol: watchdog-watch acquire
         self, name: str, deadline: float | None = None
     ) -> "TaskWatch | _NoopWatch":
         if not self.enabled:
@@ -391,7 +391,7 @@ class Watchdog:
             self._watches[watch.key] = watch
         return watch
 
-    def unregister(self, watch: TaskWatch) -> None:
+    def unregister(self, watch: TaskWatch) -> None:  # protocol: watchdog-watch release bind=watch
         stalled_now = None
         with self._lock:
             self._watches.pop(watch.key, None)

@@ -84,3 +84,46 @@ def _runtime_lock_order_guard(request):
         assert not cycles, (
             f"lock-order cycles observed at runtime in {module}: {cycles}"
         )
+
+
+# Runtime protocol recording (the dynamic half of the protocol
+# typestate rule): the suites that exercise the seeded lifecycles end
+# to end — delivery settling, ledger charge/refund, child cancel
+# tokens, watchdog watches, job traces, multipart uploads — run with
+# the protocol classes patched so every acquisition is tracked to its
+# release. An obligation still open at module teardown is a leak the
+# static rule could not see (crossed threads, stored state, dynamic
+# dispatch), reported with its acquisition site.
+_PROTOCOL_MODULES = {
+    "test_pipeline",
+    "test_batch",
+    "test_admission",
+    "test_admission_chaos",
+}
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _runtime_protocol_guard(request):
+    module = request.module.__name__
+    if module not in _PROTOCOL_MODULES:
+        yield
+        return
+    from downloader_tpu.analysis.runtime import ProtocolRecorder
+
+    recorder = ProtocolRecorder().install()
+    try:
+        yield
+        # brief settle window: worker/publisher threads release their
+        # liveness watches in finally blocks that can still be running
+        # at teardown — a drain is not a leak
+        import time
+
+        deadline = time.monotonic() + 2.0
+        while recorder.leaked() and time.monotonic() < deadline:
+            time.sleep(0.05)
+    finally:
+        recorder.uninstall()
+        leaks = recorder.leaked()
+        assert not leaks, (
+            f"protocol obligations leaked in {module}:\n" + "\n".join(leaks)
+        )

@@ -1,0 +1,21 @@
+"""Known-bad fixture: a thread target parks forever on an unbounded
+event wait — no timeout, no cancel hook, the exact un-cancellable
+shape the watchdog PRs spent review rounds hunting."""
+
+import threading
+
+
+class Runner:
+    def __init__(self):
+        self._event = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def _run(self):
+        try:
+            self._event.wait()
+        except Exception:
+            return

@@ -1,0 +1,17 @@
+"""Known-bad fixture: the lease is provably released twice on the
+straight-line path — the second release acts on an already-closed
+obligation."""
+
+
+class LeaseManager:
+    def acquire_lease(self):  # protocol: fixture-lease acquire
+        return object()
+
+    def release_lease(self, lease):  # protocol: fixture-lease release bind=lease
+        pass
+
+
+def run(manager):
+    lease = manager.acquire_lease()
+    manager.release_lease(lease)
+    manager.release_lease(lease)

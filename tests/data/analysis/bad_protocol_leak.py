@@ -1,0 +1,19 @@
+"""Known-bad fixture: the lease leaks on the uncaught-exception path —
+only ValueError is handled, so anything else unwinds past the release."""
+
+
+class LeaseManager:
+    def acquire_lease(self):  # protocol: fixture-lease acquire
+        return object()
+
+    def release_lease(self, lease):  # protocol: fixture-lease release bind=lease
+        pass
+
+
+def run(manager):
+    lease = manager.acquire_lease()
+    try:
+        process(lease)
+    except ValueError:
+        log_rejection(lease)
+    manager.release_lease(lease)

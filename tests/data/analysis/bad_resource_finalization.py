@@ -1,8 +1,10 @@
-"""Known-bad fixture: file handle leaks when read() raises."""
+"""Known-bad fixture: the handle leaks on the empty-file early return."""
 
 
 def read_header(path):
     handle = open(path, "rb")
     data = handle.read(16)
+    if not data:
+        return None
     handle.close()
     return data

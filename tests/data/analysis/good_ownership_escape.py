@@ -1,0 +1,25 @@
+"""Known-good fixture: the acquired lease escapes into a wrapper that
+owns releasing it (and is returned to the caller) — ownership moved,
+so no leak is reported in the acquiring function."""
+
+
+class LeaseManager:
+    def acquire_lease(self):  # protocol: fixture-lease acquire
+        return object()
+
+    def release_lease(self, lease):  # protocol: fixture-lease release bind=lease
+        pass
+
+
+class HeldLease:
+    def __init__(self, manager, lease):
+        self._manager = manager
+        self._lease = lease
+
+    def close(self):
+        self._manager.release_lease(self._lease)
+
+
+def begin(manager):
+    lease = manager.acquire_lease()
+    return HeldLease(manager, lease)

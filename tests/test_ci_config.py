@@ -112,42 +112,33 @@ def test_corpus_manifest_tiles_the_binary_exactly():
     )
 
 
-# standard platform variables the package honors but did not invent —
-# they are not operator knobs and have no row in the README's table
-_PLATFORM_ENV_VARS = {"XDG_CACHE_HOME"}
-
-
 def test_env_knobs_documented_in_readme():
     """EVERY env knob the package reads (not just HTTP_*) must appear
     in the README's configuration table: an undocumented knob is
     operator-facing behavior (capacity planning, data paths, feature
-    gates) that nobody can plan around. The scan keys on the literal
-    read patterns — ``environ.get("...")``, ``env.get("...")``,
-    ``getenv("...")``, ``flag_from_env("...")`` — so a renamed or new
-    knob is caught at the source, not remembered by hand."""
-    package = REPO / "downloader_tpu"
-    read_patterns = (
-        r'\benviron\b[^\n]*?\.get\(\s*"([A-Z][A-Z0-9_]*)"',
-        r'\benv\.get\(\s*"([A-Z][A-Z0-9_]*)"',
-        r'\bgetenv\(\s*"([A-Z][A-Z0-9_]*)"',
-        r'\bflag_from_env\(\s*"([A-Z][A-Z0-9_]*)"',
-        r'\benviron\[\s*"([A-Z][A-Z0-9_]*)"',
-    )
-    knobs: set[str] = set()
-    for source in package.rglob("*.py"):
-        text = source.read_text()
-        for pattern in read_patterns:
-            knobs.update(re.findall(pattern, text))
-    knobs -= _PLATFORM_ENV_VARS
-    # the scan must actually see knobs from every read pattern — an
-    # over-tight regex matching nothing would green-light anything
+    gates) that nobody can plan around. The lint itself is the
+    analyzer rule ``env-knob-documented`` (its findings anchor at the
+    offending read, file:line); this test is a thin wrapper over it so
+    tier-1 failure output stays one readable list."""
+    from downloader_tpu.analysis.checkers import EnvKnobChecker, _scan
+    from downloader_tpu.analysis.core import Module, iter_package_files
+
+    checker = EnvKnobChecker()
+    violations = []
+    seen: set[str] = set()
+    for path in iter_package_files(REPO / "downloader_tpu"):
+        module = Module.load(path)
+        seen.update(read.name for read in _scan(module).env_reads)
+        violations.extend(checker.check(module))
+    # the engine's env-read extraction must actually see knobs from
+    # every read pattern — an extractor regressed into matching
+    # nothing would green-light anything
     for expected in ("HTTP_SEGMENTS", "PIPELINE", "ZEROCOPY", "UTP_SACK",
                      "DIGEST_OFFLOAD", "BROKER", "TRACE_RING"):
-        assert expected in knobs, f"env-knob scan lost {expected}"
-    readme = (REPO / "README.md").read_text()
-    undocumented = sorted(k for k in knobs if f"`{k}`" not in readme)
-    assert not undocumented, (
-        f"env knobs missing from README's table: {undocumented}"
+        assert expected in seen, f"env-knob scan lost {expected}"
+    assert not violations, (
+        "env knobs missing from README's configuration table:\n"
+        + "\n".join(str(v) for v in violations)
     )
 
 

@@ -252,6 +252,29 @@ class TestQueueClient:
         assert done_flag == [True]
         assert broker.queue_depth("t-0") == 0 and broker.queue_depth("t-1") == 0
 
+    def test_done_polls_at_the_requested_interval(self, broker, token):
+        """done(poll_interval=) must actually wait in finite slices:
+        the parameter was accepted but ignored, leaving the caller
+        parked on a bare Event.wait() no signal could interrupt
+        (blocking-deadline audit finding)."""
+        client = make_client(broker, token)
+        client.consume("t")
+        client.publish("t", b"x")
+        assert wait_for(lambda: client.stats.published == 1)
+
+        waits = []
+        real_wait = client._done.wait
+
+        def spying_wait(timeout=None):
+            waits.append(timeout)
+            return real_wait(timeout)
+
+        client._done.wait = spying_wait
+        token.cancel()
+        client.done(poll_interval=0.05)
+        assert waits  # the poll loop ran
+        assert all(t == 0.05 for t in waits)  # every slice finite, as asked
+
     def test_connect_retries_with_backoff(self, broker, token):
         attempts = []
 

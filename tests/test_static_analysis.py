@@ -17,7 +17,7 @@ import pytest
 from downloader_tpu.analysis import Analyzer, all_checkers, analyze_paths
 from downloader_tpu.analysis.checkers import LockOrderChecker
 from downloader_tpu.analysis.core import Module, iter_package_files
-from downloader_tpu.analysis.runtime import LockOrderRecorder
+from downloader_tpu.analysis.runtime import LockOrderRecorder, ProtocolRecorder
 
 REPO = Path(__file__).resolve().parent.parent
 FIXTURES = REPO / "tests" / "data" / "analysis"
@@ -27,6 +27,9 @@ RULES = (
     "resource-finalization",
     "lock-order",
     "exception-hygiene",
+    "protocol",
+    "blocking-deadline",
+    "env-knob-documented",
 )
 
 
@@ -52,7 +55,7 @@ def test_every_suppression_carries_a_reason():
                 assert reason, f"{path}:{line}: ignore[{rule}] has no reason"
 
 
-def test_all_five_rules_registered():
+def test_full_rule_catalog_registered():
     assert {cls.rule for cls in all_checkers()} == set(RULES)
 
 
@@ -67,6 +70,9 @@ def test_all_five_rules_registered():
         ("bad_resource_finalization.py", "resource-finalization", {5}),
         ("bad_lock_order.py", "lock-order", {13, 18}),
         ("bad_exception_hygiene.py", "exception-hygiene", {9, 18, 24}),
+        ("bad_protocol_leak.py", "protocol", {14}),
+        ("bad_double_release.py", "protocol", {17}),
+        ("bad_blocking_deadline.py", "blocking-deadline", {19}),
     ],
 )
 def test_rule_fires_on_fixture_with_location(fixture, rule, lines):
@@ -87,6 +93,26 @@ def test_exception_hygiene_reports_all_three_shapes():
     assert "silent broad swallow" in messages
     assert "thread target 'helper'" in messages
     assert "bare 'except:'" in messages
+
+
+def test_protocol_leak_names_the_exception_path():
+    violations = analyze_paths([FIXTURES / "bad_protocol_leak.py"])
+    assert any("exception path" in v.message for v in violations)
+
+
+def test_double_release_names_the_acquire_site():
+    violations = analyze_paths([FIXTURES / "bad_double_release.py"])
+    assert any(
+        "double release" in v.message and "line 15" in v.message
+        for v in violations
+    )
+
+
+def test_ownership_escape_analyzes_clean():
+    """The acquiring function hands the lease to a wrapper and returns
+    it — ownership moved, nothing to report. Guards the escape
+    heuristic against regressing into leak-everything noise."""
+    assert analyze_paths([FIXTURES / "good_ownership_escape.py"]) == []
 
 
 def test_lock_order_cycle_names_both_locks():
@@ -238,6 +264,292 @@ def test_unsuppressed_copy_of_round_trip_fixture_fires(tmp_path):
     assert rules == {"guarded-by", "no-blocking-under-lock"}
 
 
+# -- runtime budget ----------------------------------------------------------
+
+
+def test_full_tree_analyze_stays_within_budget():
+    """The CFG/dataflow engine must not silently make `make analyze`
+    unusably slow: a full uncached tree analysis (the worst case — the
+    cache serves warm runs in ~0.2s) stays under a generous budget on
+    this host. Measured ~2s on the 1-vCPU CI VM; the 20s ceiling is
+    headroom for host noise, not a target. One remeasure absorbs a
+    noisy-neighbor burst (a guard asks whether the analyzer CAN hit
+    budget)."""
+    import time
+
+    budget_s = 20.0
+    for _ in range(2):
+        start = time.monotonic()
+        Analyzer(full_scope=True).run(
+            iter_package_files(REPO / "downloader_tpu")
+        )
+        elapsed = time.monotonic() - start
+        if elapsed <= budget_s:
+            break
+    assert elapsed <= budget_s, (
+        f"full-tree analyze took {elapsed:.1f}s (budget {budget_s:.0f}s); "
+        "the engine has regressed into unusable territory"
+    )
+
+
+# -- scan cache --------------------------------------------------------------
+
+
+def _run_with_cache(files, cache_path):
+    from downloader_tpu.analysis.cache import ScanCache
+
+    cache = ScanCache(cache_path)
+    replayed = cache.replay(files)
+    if replayed is not None:
+        return replayed, cache
+    return Analyzer(full_scope=True).run(files, scan_cache=cache), cache
+
+
+def test_scan_cache_runs_are_byte_identical(tmp_path):
+    """The cache's whole contract: cold, warm-replay, and
+    partially-adopted runs produce the same violations at the same
+    locations as an uncached run — on a tree that actually fires."""
+    tree = tmp_path / "pkg"
+    tree.mkdir()
+    (tree / "leaky.py").write_text(
+        "def leak(path):\n"
+        "    handle = open(path)\n"
+        "    data = handle.read()\n"
+        "    if not data:\n"
+        "        return None\n"
+        "    handle.close()\n"
+        "    return data\n"
+    )
+    (tree / "clean.py").write_text(
+        "def fine(items):\n"
+        "    return sorted(items)\n"
+    )
+    files = sorted(tree.rglob("*.py"))
+    cache_path = tmp_path / "cache.json"
+
+    baseline = Analyzer(full_scope=True).run(list(files))
+    assert baseline, "fixture tree must fire or the test is vacuous"
+
+    cold, cache = _run_with_cache(list(files), cache_path)
+    assert [str(v) for v in cold] == [str(v) for v in baseline]
+    assert cache.adopted == 0  # nothing to adopt on a cold run
+
+    warm, _ = _run_with_cache(list(files), cache_path)
+    assert [str(v) for v in warm] == [str(v) for v in baseline]
+
+    # touch one file: the other adopts its cached scan, results hold
+    leaky = tree / "leaky.py"
+    leaky.write_text(leaky.read_text())  # same content, new mtime
+    partial, cache = _run_with_cache(list(files), cache_path)
+    assert [str(v) for v in partial] == [str(v) for v in baseline]
+    assert cache.adopted == 1  # clean.py skipped its re-scan
+
+
+def test_scan_cache_sees_edits_through_a_stale_entry(tmp_path):
+    """An edited file must be re-scanned even when the cache holds an
+    entry for it: fixing the leak clears the violation on the next
+    cached run."""
+    tree = tmp_path / "pkg"
+    tree.mkdir()
+    target = tree / "leaky.py"
+    target.write_text(
+        "def leak(path):\n"
+        "    handle = open(path)\n"
+        "    data = handle.read()\n"
+        "    if not data:\n"
+        "        return None\n"
+        "    handle.close()\n"
+        "    return data\n"
+    )
+    cache_path = tmp_path / "cache.json"
+    files = sorted(tree.rglob("*.py"))
+    first, _ = _run_with_cache(list(files), cache_path)
+    assert first
+
+    target.write_text(
+        "def leak(path):\n"
+        "    with open(path) as handle:\n"
+        "        return handle.read()\n"
+    )
+    fixed, _ = _run_with_cache(list(files), cache_path)
+    assert fixed == []
+    # and the replay tier serves the fixed result too
+    replayed, _ = _run_with_cache(list(files), cache_path)
+    assert replayed == []
+
+
+def test_finally_body_facts_do_not_duplicate_violations(tmp_path):
+    """The CFG builds one finalbody copy per continuation, so one
+    statement owns several nodes — a blocking call in a `finally`
+    under a lock must still be reported exactly once (review finding:
+    the identical violation was emitted 2-3 times)."""
+    target = tmp_path / "fin.py"
+    target.write_text(
+        "import threading\n"
+        "\n"
+        "\n"
+        "class Conn:\n"
+        "    def __init__(self, sock):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._sock = sock\n"
+        "\n"
+        "    def farewell(self):\n"
+        "        with self._lock:\n"
+        "            try:\n"
+        "                if self.dirty():\n"
+        "                    return\n"
+        "                self.flush()\n"
+        "            finally:\n"
+        "                self._sock.sendall(b'bye')\n"
+    )
+    violations = analyze_paths([target])
+    hits = [v for v in violations if v.rule == "no-blocking-under-lock"]
+    assert len(hits) == 1, violations
+
+
+def test_scan_cache_replay_sees_readme_edits(tmp_path):
+    """The env-knob verdict rides on README.md, which is not a .py
+    file: a README-only edit must break the replay tier (review
+    finding: replay green-lit undocumented knobs)."""
+    tree = tmp_path / "pkg"
+    tree.mkdir()
+    readme = tmp_path / "README.md"
+    readme.write_text("| `MY_KNOB` | does things |\n")
+    (tree / "knobby.py").write_text(
+        'import os\n\nLIMIT = os.environ.get("MY_KNOB", "1")\n'
+    )
+    cache_path = tmp_path / "cache.json"
+    files = sorted(tree.rglob("*.py"))
+    first, _ = _run_with_cache(list(files), cache_path)
+    assert first == []
+
+    readme.write_text("nothing documented anymore\n")
+    stale, _ = _run_with_cache(list(files), cache_path)
+    assert [v.rule for v in stale] == ["env-knob-documented"]
+
+
+def test_guarded_resource_construction_is_not_a_leak(tmp_path):
+    """``try: h = open(p) / except OSError: return None`` is the
+    correct idiom: if open() raises, nothing was acquired, so the
+    handler path must NOT carry an open obligation (review finding:
+    the acquire leaked onto its own exception edge)."""
+    target = tmp_path / "guarded.py"
+    target.write_text(
+        "def load(path):\n"
+        "    try:\n"
+        "        handle = open(path, 'rb')\n"
+        "    except OSError:\n"
+        "        return None\n"
+        "    data = handle.read()\n"
+        "    handle.close()\n"
+        "    return data\n"
+    )
+    violations = analyze_paths([target])
+    assert [v for v in violations if v.rule == "resource-finalization"] == [], (
+        violations
+    )
+
+
+def test_select_three_arg_form_has_no_timeout(tmp_path):
+    """``select.select(r, w, x)`` blocks forever — the audit must not
+    mistake the read list for a finite timeout (review finding: 3-arg
+    select passed as bounded); the 4-arg form stays clean."""
+    target = tmp_path / "sel.py"
+    target.write_text(
+        "import select\n"
+        "import threading\n"
+        "\n"
+        "\n"
+        "def pump(socks):\n"
+        "    try:\n"
+        "        select.select(socks, [], [])\n"
+        "    except Exception:\n"
+        "        raise\n"
+        "\n"
+        "\n"
+        "def bounded(socks):\n"
+        "    try:\n"
+        "        select.select(socks, [], [], 1.0)\n"
+        "    except Exception:\n"
+        "        raise\n"
+        "\n"
+        "\n"
+        "def runner():\n"
+        "    threading.Thread(target=pump, args=([],)).start()\n"
+        "    threading.Thread(target=bounded, args=([],)).start()\n"
+    )
+    violations = [
+        v for v in analyze_paths([target]) if v.rule == "blocking-deadline"
+    ]
+    assert [v.line for v in violations] == [7], violations
+
+
+def test_conditional_acquire_refines_through_assigned_flag(tmp_path):
+    """``ok = try_lease(...); if not ok: return`` is the assign
+    spelling of testing the call directly — the refused early return
+    must not read as a leak (review finding), while a success path
+    that really never releases still must."""
+    header = (
+        "class LeaseManager:\n"
+        "    def try_lease(self, key):"
+        "  # protocol: fixture-flag acquire bind=key conditional\n"
+        "        return True\n"
+        "\n"
+        "    def release_lease(self, key):"
+        "  # protocol: fixture-flag release bind=key\n"
+        "        pass\n"
+        "\n"
+        "\n"
+    )
+    clean = tmp_path / "clean.py"
+    clean.write_text(
+        header
+        + "def run(manager, key):\n"
+        "    ok = manager.try_lease(key)\n"
+        "    if not ok:\n"
+        "        return False\n"
+        "    manager.release_lease(key)\n"
+        "    return True\n"
+    )
+    assert [
+        v for v in analyze_paths([clean]) if v.rule == "protocol"
+    ] == []
+
+    leaky = tmp_path / "leaky.py"
+    leaky.write_text(
+        header
+        + "def run(manager, key):\n"
+        "    ok = manager.try_lease(key)\n"
+        "    if not ok:\n"
+        "        return False\n"
+        "    return True\n"
+    )
+    leaks = [v for v in analyze_paths([leaky]) if v.rule == "protocol"]
+    assert len(leaks) == 1 and leaks[0].line == 10, leaks
+
+
+def test_cli_list_suppressions_inventories_reasons():
+    result = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "downloader_tpu.analysis",
+            "--list-suppressions",
+            "--json",
+            str(REPO / "downloader_tpu"),
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stderr
+    payload = json.loads(result.stdout)
+    assert payload["count"] == len(payload["suppressions"])
+    for entry in payload["suppressions"]:
+        assert entry["reason"], f"reasonless suppression: {entry}"
+        assert entry["path"] and entry["line"] and entry["rule"]
+
+
 # -- CLI ---------------------------------------------------------------------
 
 
@@ -369,6 +681,113 @@ def test_recorder_across_streaming_pipeline_scenario(tmp_path):
                 session.close()
                 uploader.close()
     assert recorder.cycles() == [], recorder.cycles()
+
+
+def test_protocol_recorder_flags_deliberate_leak():
+    """A child token acquired and never detached must surface at
+    teardown with its acquisition site — this is the recorder's whole
+    contract, so it gets proven on a deliberate leak."""
+    from downloader_tpu.utils.cancel import CancelToken
+
+    with ProtocolRecorder() as recorder:
+        parent = CancelToken()
+        child = parent.child()  # acquired ...
+        # ... and deliberately never detached
+    leaks = recorder.leaked()
+    assert len(leaks) == 1, leaks
+    assert "cancel-token" in leaks[0]
+    assert "test_static_analysis.py" in leaks[0]  # the acquisition site
+    child.detach()  # hygiene: drop it from the parent after the assert
+
+
+def test_protocol_recorder_balances_released_lifecycles():
+    """Exercised-and-released lifecycles leave nothing open, refused
+    conditional acquires record nothing, and double releases stay
+    no-ops — the recorder mirrors the idempotent settle design."""
+    from downloader_tpu.utils.admission import Ledger
+    from downloader_tpu.utils.cancel import CancelToken
+    from downloader_tpu.utils.tracing import Tracer
+
+    with ProtocolRecorder() as recorder:
+        ledger = Ledger({"slots": 1})
+        assert ledger.try_charge("slots", "job-1", 1)
+        assert not ledger.try_charge("slots", "job-2", 5)  # refused: no obligation
+        token = CancelToken()
+        child = token.child()
+        child.detach()
+        child.detach()  # double release is settle-safe
+        trace = Tracer(capacity=4).open_job("job-1")
+        trace.complete()
+        ledger.refund("job-1")
+        ledger.refund("job-1")  # double refund is settle-safe
+    assert recorder.leaked() == [], recorder.leaked()
+
+
+def test_protocol_recorder_partial_install_unwinds():
+    """An install that fails partway (a spec naming a method that no
+    longer exists) must restore everything it already patched:
+    conftest holds ``install()`` OUTSIDE its try/finally, so a partial
+    install would otherwise leave half-patched classes bound to a dead
+    recorder for the rest of the session (review finding)."""
+    from downloader_tpu.utils.cancel import CancelToken
+
+    original_child = CancelToken.__dict__["child"]
+    broken = {
+        "cancel-token": {
+            "module": "downloader_tpu.utils.cancel",
+            "methods": [
+                {
+                    "class": "CancelToken",
+                    "name": "child",
+                    "kind": "acquire",
+                    "key": "result",
+                },
+                {
+                    "class": "CancelToken",
+                    "name": "no_such_method",
+                    "kind": "release",
+                    "key": "self",
+                },
+            ],
+        },
+    }
+    recorder = ProtocolRecorder(broken)
+    with pytest.raises(KeyError):
+        recorder.install()
+    assert CancelToken.__dict__["child"] is original_child
+    recorder.uninstall()  # no-op: nothing stayed half-patched
+    assert CancelToken.__dict__["child"] is original_child
+
+
+def test_protocol_vocabulary_agreement():
+    """The static annotations and the runtime patch table must agree:
+    every runtime patch target carries the matching ``# protocol:``
+    annotation (same protocol, same kind, conditional flags aligned),
+    and the two sides declare the same protocol set — the rule's two
+    halves can never drift apart silently."""
+    from downloader_tpu.analysis.protocols import (
+        RUNTIME_PROTOCOLS,
+        collect_table,
+    )
+
+    modules = [
+        Module.load(path)
+        for path in iter_package_files(REPO / "downloader_tpu")
+    ]
+    table = collect_table(modules)
+    static = {(m.protocol, m.kind, m.method): m for m in table.methods}
+    assert {m.protocol for m in table.methods} == set(RUNTIME_PROTOCOLS)
+    for protocol, spec in RUNTIME_PROTOCOLS.items():
+        for entry in spec["methods"]:
+            key = (protocol, entry["kind"], entry["name"])
+            assert key in static, (
+                f"runtime patches {entry['class']}.{entry['name']} as a "
+                f"{protocol} {entry['kind']} but no `# protocol:` "
+                "annotation declares it"
+            )
+            assert bool(entry.get("conditional")) == static[key].conditional, (
+                f"conditional flag disagrees for {protocol} {entry['name']}"
+            )
 
 
 def test_recorder_across_queue_client_scenario():
