@@ -36,6 +36,9 @@ pair, default 4), BENCH_REPEATS (pairs, default 5), BENCH_DIR (default
 BENCH_ABLATION_REPEATS (interleaved triples, default 3), BENCH_PIPELINE=0
 to skip the streaming-pipeline ablation, BENCH_PIPELINE_REPEATS
 (interleaved pipelined/store-and-forward pairs, default 3),
+BENCH_MULTI_SOURCE=0 to skip the multi-source racing arm
+(BENCH_MULTI_MB MB per job, BENCH_MULTI_THROTTLE_MBPS aggregate origin
+cap, BENCH_MULTI_REPEATS interleaved single/multi rounds),
 BENCH_WATCHDOG=0 to skip the stall-watchdog heartbeat ablation,
 BENCH_SMALL=0 to skip the small-object batched/unbatched arm
 (BENCH_SMALL_WAVE jobs per wave, BENCH_SMALL_WAVES rounds),
@@ -194,6 +197,88 @@ class RangeQuiet(http.server.BaseHTTPRequestHandler):
         except (BrokenPipeError, ConnectionResetError):
             pass  # endgame loser cancellation closes mid-body; expected
 httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), RangeQuiet)
+print(httpd.server_address[1], flush=True)
+httpd.serve_forever()
+"""
+
+# Range server with an ORIGIN-AGGREGATE bandwidth cap (one token
+# bucket across every connection) for the multi-source ablation: the
+# per-connection throttle above is what the single-origin stripe
+# defeats; a whole origin being slow — rate-limited egress, a
+# saturated uplink — is what racing a SECOND origin defeats, and that
+# cap must bind no matter how many connections one job opens to it.
+_AGGREGATE_RANGE_SERVER = """
+import http.server, os, sys, threading, time
+root, throttle_mbps = sys.argv[1], float(sys.argv[2])
+rate = throttle_mbps * 1e6
+bucket_lock = threading.Lock()
+bucket = {"at": time.monotonic(), "tokens": 0.0}
+def take(n):
+    if rate <= 0:
+        return
+    while True:
+        with bucket_lock:
+            now = time.monotonic()
+            bucket["tokens"] = min(
+                rate / 4, bucket["tokens"] + (now - bucket["at"]) * rate
+            )
+            bucket["at"] = now
+            if bucket["tokens"] >= n:
+                bucket["tokens"] -= n
+                return
+            short = (n - bucket["tokens"]) / rate
+        time.sleep(min(short, 0.05))
+class AggQuiet(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    def log_message(self, *args): pass
+    def _meta(self):
+        path = os.path.join(root, os.path.basename(self.path))
+        try:
+            return path, os.path.getsize(path)
+        except OSError:
+            return None, 0
+    def do_HEAD(self):
+        path, size = self._meta()
+        if path is None:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(size))
+        self.send_header("Accept-Ranges", "bytes")
+        self.end_headers()
+    def do_GET(self):
+        path, size = self._meta()
+        if path is None:
+            self.send_error(404)
+            return
+        lo, hi = 0, size - 1
+        rng = self.headers.get("Range")
+        if rng and rng.startswith("bytes="):
+            a, b = rng[6:].split("-", 1)
+            lo = int(a)
+            hi = int(b) if b else size - 1
+            self.send_response(206)
+            self.send_header("Content-Range", f"bytes {lo}-{hi}/{size}")
+        else:
+            self.send_response(200)
+        length = hi - lo + 1
+        self.send_header("Content-Length", str(length))
+        self.end_headers()
+        window = 256 * 1024
+        try:
+            with open(path, "rb") as f:
+                f.seek(lo)
+                sent = 0
+                while sent < length:
+                    chunk = f.read(min(window, length - sent))
+                    if not chunk:
+                        break
+                    take(len(chunk))
+                    self.wfile.write(chunk)
+                    sent += len(chunk)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # endgame loser / failover cancellation; expected
+httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), AggQuiet)
 print(httpd.server_address[1], flush=True)
 httpd.serve_forever()
 """
@@ -690,6 +775,145 @@ def run_segmented_ablation(
     }
 
 
+def run_multi_source_arm(
+    site: str,
+    mb: int = 32,
+    throttle_mbps: float = 10.0,
+    repeats: int = 3,
+) -> dict:
+    """The multi-source racing ablation (ISSUE 9). Two measurements:
+
+    - **throughput**: one job from an origin with an AGGREGATE
+      bandwidth cap (the condition racing a second origin exists to
+      beat — the single-origin stripe cannot exceed it however many
+      connections it opens), single-source vs the same job carrying an
+      unthrottled mirror in ``X-Mirrors``. Interleaved rounds, median
+      ratio — the acceptance bar is >= 1.8x.
+    - **failover**: one multi-source job whose throttled primary is
+      KILLED mid-stream; the job must complete from the mirror, and
+      the per-kind byte counters must show the object fetched ~once
+      (``fetch_amplification`` near 1.0 — journaled spans were not
+      re-fetched).
+    """
+    from downloader_tpu.queue.delivery import MIRRORS_HEADER
+    from downloader_tpu.utils import metrics as metrics_mod
+
+    payload = os.path.join(site, "multi_src.mkv")
+    if not os.path.exists(payload):
+        with open(payload, "wb") as sink:
+            chunk = os.urandom(1024 * 1024)
+            for _ in range(mb):
+                sink.write(chunk)
+    primary_server = (_AGGREGATE_RANGE_SERVER, (str(throttle_mbps),))
+
+    def run_job(mirror_url: "str | None") -> float:
+        headers = (
+            {MIRRORS_HEADER: mirror_url} if mirror_url is not None else {}
+        )
+        pipeline = _Pipeline(
+            1, 1, site, payload="multi_src.mkv", server=primary_server,
+            batch_jobs=1,
+        )
+        try:
+            start = time.monotonic()
+            pipeline.publish_job(0, headers=headers)
+            pipeline.wait_converts(1, timeout=300.0)
+            return mb / (time.monotonic() - start)
+        finally:
+            pipeline.close()
+
+    mirror_proc, mirror_port = _spawn_server(
+        _AGGREGATE_RANGE_SERVER, site, "0"
+    )
+    mirror_url = f"http://127.0.0.1:{mirror_port}/multi_src.mkv"
+    try:
+        rounds: list[dict] = []
+        for i in range(repeats):
+            single = run_job(None)
+            multi = run_job(mirror_url)
+            rounds.append(
+                {
+                    "single_MBps": round(single, 1),
+                    "multi_MBps": round(multi, 1),
+                    "ratio": round(multi / single, 2),
+                }
+            )
+            _log(
+                f"bench: multi-source round {i + 1}: single "
+                f"{single:.1f} MB/s -> multi {multi:.1f} MB/s "
+                f"({rounds[-1]['ratio']:.2f}x)"
+            )
+
+        # -- failover: kill the throttled primary mid-stream ---------------
+        # the failover mirror is THROTTLED too (3x the primary's cap):
+        # an unthrottled loopback mirror finishes the whole object
+        # before the kill can land, and the arm would measure nothing
+        failover_mirror_proc, failover_mirror_port = _spawn_server(
+            _AGGREGATE_RANGE_SERVER, site, str(3 * throttle_mbps)
+        )
+        failover_mirror_url = (
+            f"http://127.0.0.1:{failover_mirror_port}/multi_src.mkv"
+        )
+        counters0 = metrics_mod.GLOBAL.snapshot()
+        pipeline = _Pipeline(
+            1, 1, site, payload="multi_src.mkv", server=primary_server,
+            batch_jobs=1,
+        )
+        completed = False
+        try:
+            pipeline.publish_job(
+                0, headers={MIRRORS_HEADER: failover_mirror_url}
+            )
+            # wait until the job has real progress, then kill the origin
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                fetched = (
+                    metrics_mod.GLOBAL.snapshot().get(
+                        "source_bytes_total_mirror", 0
+                    )
+                    - counters0.get("source_bytes_total_mirror", 0)
+                )
+                if fetched >= 1024 * 1024:
+                    break
+                time.sleep(0.005)
+            pipeline.httpd.kill()
+            pipeline.httpd.wait()
+            pipeline.wait_converts(1, timeout=300.0)
+            completed = True
+        finally:
+            pipeline.close()
+            failover_mirror_proc.kill()
+            failover_mirror_proc.wait()
+        counters1 = metrics_mod.GLOBAL.snapshot()
+        fetched = counters1.get("source_bytes_total_mirror", 0) - counters0.get(
+            "source_bytes_total_mirror", 0
+        )
+        failover = {
+            "completed": completed,
+            "fetch_amplification": round(fetched / (mb * 1024 * 1024), 3),
+            "source_failovers": counters1.get("http_source_failovers", 0)
+            - counters0.get("http_source_failovers", 0),
+        }
+        _log(
+            f"bench: multi-source failover: completed={completed}, "
+            f"amplification {failover['fetch_amplification']:.3f}, "
+            f"failovers {failover['source_failovers']}"
+        )
+    finally:
+        mirror_proc.kill()
+        mirror_proc.wait()
+
+    ordered = sorted(r["ratio"] for r in rounds)
+    return {
+        "metric": "multi_source",
+        "multi_vs_single": ordered[len(ordered) // 2],
+        "throttle_MBps_aggregate": throttle_mbps,
+        "mb": mb,
+        "rounds": rounds,
+        "failover": failover,
+    }
+
+
 def run_latency(
     site: str, samples: int, concurrency: int
 ) -> tuple[float, dict]:
@@ -1151,6 +1375,36 @@ def main() -> None:
                 f"small {segmented_ablation['segmented_vs_single_small']:.2f}x"
             )
 
+        multi_source = None
+        if os.environ.get("BENCH_MULTI_SOURCE", "1") != "0":
+            multi_repeats = max(
+                1, int(os.environ.get("BENCH_MULTI_REPEATS", 3))
+            )
+            # 32 MB: big enough that the mid-job kill reliably lands
+            # while spans are still in flight on BOTH origins (a small
+            # object can finish before the kill fires, measuring nothing)
+            multi_mb = max(8, int(os.environ.get("BENCH_MULTI_MB", 32)))
+            multi_throttle = float(
+                os.environ.get("BENCH_MULTI_THROTTLE_MBPS", 10.0)
+            )
+            _log(
+                f"bench: multi-source ablation, {multi_repeats} interleaved "
+                f"single/multi rounds of one {multi_mb} MB job against an "
+                f"origin capped at {multi_throttle} MB/s aggregate, plus a "
+                "mid-job primary kill"
+            )
+            multi_source = run_multi_source_arm(
+                site, mb=multi_mb, throttle_mbps=multi_throttle,
+                repeats=multi_repeats,
+            )
+            _log(
+                "bench: multi-source ablation median: "
+                f"{multi_source['multi_vs_single']:.2f}x vs single-source; "
+                "failover completed="
+                f"{multi_source['failover']['completed']}, amplification "
+                f"{multi_source['failover']['fetch_amplification']:.3f}"
+            )
+
         latency_samples = max(3, int(os.environ.get("BENCH_LATENCY_SAMPLES", 15)))
         _log(f"bench: per-job overhead latency, {latency_samples} tiny jobs")
         tiny = os.path.join(site, "tiny.bin")
@@ -1238,6 +1492,8 @@ def main() -> None:
             extra_metrics.append(pipeline_ablation)
         if segmented_ablation is not None:
             extra_metrics.append(segmented_ablation)
+        if multi_source is not None:
+            extra_metrics.append(multi_source)
         if small_object is not None:
             extra_metrics.append(small_object)
         if overload is not None:

@@ -55,6 +55,13 @@ def digest_line(report: dict) -> dict:
                 arm = rounds[-1]["arms"].get("segmented_large", {})
                 out["segmented_overlap_ratio"] = arm.get("overlap_ratio")
                 out["segmented_pool_reuse_hits"] = arm.get("pool_reuse_hits")
+        elif metric == "multi_source":
+            out["multi_source_x"] = extra.get("multi_vs_single")
+            failover = extra.get("failover") or {}
+            out["multi_failover_completed"] = failover.get("completed")
+            out["multi_failover_amplification"] = failover.get(
+                "fetch_amplification"
+            )
         elif metric == "small_object_overhead":
             sizes = extra.get("sizes") or {}
             for label in ("1k", "64k", "1m"):

@@ -179,6 +179,40 @@ RUNTIME_PROTOCOLS: dict[str, dict] = {
             {"class": "OpenTrace", "name": "complete", "kind": "release", "key": "self"},
         ],
     },
+    "source-claim": {
+        "module": "downloader_tpu.fetch.segments",
+        "methods": [
+            # the span scheduler's claim lifecycle (ISSUE 9): every
+            # claim handed to a worker must reach exactly one of the
+            # three release gates — complete, abandon (a rescue twin
+            # standing down), or release_failed (the failover path)
+            {
+                "class": "_FetchState",
+                "name": "next_segment",
+                "kind": "acquire",
+                "key": "result",
+                "conditional": True,
+            },
+            {
+                "class": "_FetchState",
+                "name": "complete",
+                "kind": "release",
+                "key": "arg:seg",
+            },
+            {
+                "class": "_FetchState",
+                "name": "abandon",
+                "kind": "release",
+                "key": "arg:seg",
+            },
+            {
+                "class": "_FetchState",
+                "name": "release_failed",
+                "kind": "release",
+                "key": "arg:seg",
+            },
+        ],
+    },
     "multipart-upload": {
         "module": "downloader_tpu.store.s3",
         "methods": [

@@ -251,12 +251,23 @@ class Daemon:
         session = self._uploader.streaming_session(media.id, job_token)
         try:
             watch.stage("fetch")
+            mirrors = self._job_mirrors(delivery, media.source_uri)
             with tracing.span(
-                "fetch", url=tracing.redact_url(media.source_uri)
+                "fetch", url=tracing.redact_url(media.source_uri),
+                mirrors=len(mirrors),
             ), transfer_progress.install(session):
-                job_dir = self._dispatcher.download(
-                    media.id, media.source_uri, token=job_token
-                )
+                # the kwarg rides only when the job actually has
+                # mirrors, so mirror-less deployments keep the exact
+                # call shape every existing dispatcher stub expects
+                if mirrors:
+                    job_dir = self._dispatcher.download(
+                        media.id, media.source_uri, token=job_token,
+                        mirrors=mirrors,
+                    )
+                else:
+                    job_dir = self._dispatcher.download(
+                        media.id, media.source_uri, token=job_token
+                    )
             watch.stage("scan")
             with tracing.span("scan"):
                 files = scan_dir(job_dir)
@@ -339,6 +350,21 @@ class Daemon:
         elapsed = time.monotonic() - started
         metrics.GLOBAL.observe("job_duration_seconds", elapsed)
         self._observe_slo(delivery, elapsed)
+
+    def _job_mirrors(self, delivery: Delivery, url: str) -> "tuple[str, ...]":
+        """The mirror URLs riding this job: the producer's X-Mirrors
+        header first (it knows the object), the worker's MIRROR_URLS
+        fallback second, deduplicated against the primary and capped at
+        MIRROR_MAX. The fetch layer vets each one against the primary's
+        probe before a single span is assigned to it."""
+        from ..fetch import sources
+
+        return sources.merge_mirrors(
+            url,
+            getattr(delivery, "mirrors", ()),
+            self._config.mirror_urls,
+            cap=self._config.mirror_max,
+        )
 
     def _observe_slo(self, delivery: Delivery, elapsed: float) -> None:
         """Per-class SLO latency histogram: the series an operator

@@ -58,6 +58,13 @@ class Config:
     http_segments: int = 8
     http_pool_per_host: int = 6
     http_pool_idle: float = 30.0
+    # multi-source racing fetch (fetch/sources.py): fallback mirror
+    # list applied to every job (merged with the job's X-Mirrors
+    # header), capped at mirror_max. The per-source demotion/
+    # retirement knobs (SOURCE_DEMOTE_RATIO, SOURCE_RETIRE_ERRORS) are
+    # read by the fetcher itself, like ZEROCOPY.
+    mirror_urls: "tuple[str, ...]" = ()
+    mirror_max: int = 4
     # batched small-object fast path (daemon/app.py): one dequeue wave
     # drains up to batch_jobs already-waiting deliveries (lingering at
     # most batch_wait_ms once a burst is in progress — a lone job never
@@ -156,6 +163,10 @@ class Config:
         config.http_segments = segments_from_env(env)
         config.http_pool_per_host = pool_per_host_from_env(env)
         config.http_pool_idle = pool_idle_from_env(env)
+        from ..fetch import sources
+
+        config.mirror_urls = sources.mirrors_from_env(env)
+        config.mirror_max = sources.mirror_max_from_env(env)
         from ..utils import incident, watchdog
 
         config.watchdog_stall_s = watchdog.stall_from_env(env)

@@ -211,13 +211,23 @@ class DispatchClient:
         return job_dir if done else None
 
     def download(
-        self, media_id: str, url: str, token: CancelToken | None = None
+        self,
+        media_id: str,
+        url: str,
+        token: CancelToken | None = None,
+        mirrors: "tuple[str, ...]" = (),
     ) -> str:
         """Download a job into ``base_dir/<media_id>/`` and return that dir.
 
         ``token`` scopes cancellation to this job (the daemon passes a
         per-job child so the stall watchdog can release one wedged
         download); None falls back to the client-wide token.
+
+        ``mirrors`` are alternate URLs for the same object (job header
+        ``X-Mirrors`` + config fallback); they reach only backends that
+        declare ``supports_mirrors`` — the HTTP backend races byte
+        spans across them, the torrent backend rides them as extra
+        webseeds — and are silently dropped for any other backend.
 
         Raises UnsupportedJobError for unroutable URLs and propagates
         backend errors (unlike the reference's HTTP backend, which
@@ -232,9 +242,16 @@ class DispatchClient:
             with tracing.span(
                 "backend", backend=backend.register().name
             ):
-                backend.download(
-                    token or self._token, job_dir, self._progress.update, url
-                )
+                if mirrors and getattr(backend, "supports_mirrors", False):
+                    backend.download(
+                        token or self._token, job_dir,
+                        self._progress.update, url, mirrors=tuple(mirrors),
+                    )
+                else:
+                    backend.download(
+                        token or self._token, job_dir,
+                        self._progress.update, url,
+                    )
         finally:
             # whatever happened, stop displaying this URL
             self._progress.update(url, 100.0)

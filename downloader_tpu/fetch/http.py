@@ -218,6 +218,11 @@ def filename_for(url: str, content_disposition: str | None) -> str:
 
 
 class HTTPBackend:
+    # the dispatcher may pass a job's mirror URLs (X-Mirrors header +
+    # MIRROR_URLS config fallback) to download(); the segmented fetcher
+    # races byte spans across every admitted mirror
+    supports_mirrors = True
+
     def __init__(
         self,
         progress_interval: float = 1.0,
@@ -312,15 +317,24 @@ class HTTPBackend:
         return response, offset
 
     def download(
-        self, token: CancelToken, base_dir: str, progress: ProgressFn, url: str
+        self,
+        token: CancelToken,
+        base_dir: str,
+        progress: ProgressFn,
+        url: str,
+        mirrors: "tuple[str, ...]" = (),
     ) -> None:
         if self._segmenter is not None and self._segmenter.enabled:
             # the segmented path handles everything when the probe says
             # the server supports ranges and the object is big enough;
+            # with mirrors it races spans across every admitted source.
             # False means "run the single-stream path" — either the
             # probe declined (no side effects) or Range support
-            # vanished mid-job (speculative state already invalidated)
-            if self._segmenter.fetch(token, base_dir, progress, url):
+            # vanished mid-job on the last live source (speculative
+            # state already invalidated)
+            if self._segmenter.fetch(
+                token, base_dir, progress, url, mirrors=mirrors
+            ):
                 return
         attempts = 0
         offset = 0

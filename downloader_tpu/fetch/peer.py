@@ -85,6 +85,7 @@ from .peerwire import (
     generate_peer_id,
     pack_bitfield,
 )
+from . import sources as source_board
 from .pieces import PieceStore
 from .swarmstate import _PieceBatch, _SwarmState
 from .tracker import (
@@ -442,6 +443,12 @@ class SwarmDownloader:
             self._run_guarded(token, progress)
         finally:
             metrics.GLOBAL.gauge_add("torrent_active_swarms", -1)
+            # settle the per-kind active-source gauges for whatever
+            # webseed/peer sources the swarm registered, however the
+            # job ended (the board is created with the swarm state)
+            swarm = getattr(self, "_swarm_ref", None)
+            if swarm is not None:
+                swarm.sources.close()
 
     def _run_guarded(self, token: CancelToken, progress) -> None:
         listener: PeerListener | None = None
@@ -742,6 +749,7 @@ class SwarmDownloader:
         self._session_start_bytes = session_start_bytes
 
         swarm = _SwarmState(store, progress, self._progress_interval)
+        self._swarm_ref = swarm  # run()'s finally settles its source board
         # outbound reciprocation: completed pieces are announced (HAVE)
         # on every live outbound connection, mirroring the listener's
         # observer on the inbound side
@@ -941,6 +949,12 @@ class SwarmDownloader:
         batch = _PieceBatch(swarm, owner=source)
         store = swarm.store
         client = _WebSeedClient()
+        # multi-source accounting (fetch/sources.py): this webseed's
+        # rate and error score land on the swarm's shared board next to
+        # the peers'; a demotion slows the lane down (trickle pacing
+        # below) instead of banning it, and retirement ends the worker
+        board = swarm.sources
+        lane = board.add(source_board.KIND_WEBSEED, tracing.redact_url(url))
         # cancellation must unblock an in-flight HTTP read immediately
         # (the established pattern — HTTPBackend registers the same
         # kind of hook on its response)
@@ -948,6 +962,15 @@ class SwarmDownloader:
         failures = 0
         try:
             while not token.cancelled() and not swarm.done():
+                if lane.retired:
+                    break  # the board gave this webseed up for the job
+                if lane.state == source_board.TRICKLE:
+                    # the trickle lane: demoted-but-not-banned — keep
+                    # fetching (the rate stays measured, recovery
+                    # re-promotes) at a pace that cannot crowd the
+                    # claim pool's tail
+                    time.sleep(0.1)
+                board.rebalance()
                 index = swarm.claim(source)
                 if index is swarm.WAIT:
                     batch.flush()
@@ -958,13 +981,16 @@ class SwarmDownloader:
                 try:
                     data = _fetch_webseed_piece(client, url, store, index)
                     failures = 0
+                    board.note_success(lane)
                 except _WebSeedPermanent:
                     swarm.release(index, source)
+                    board.note_error(lane, permanent=True)
                     raise  # retrying cannot fix a 4xx/redirect
                 except TransferError as exc:
                     swarm.release(index, source)
                     token.raise_if_cancelled()  # close() looks transient
                     swarm.last_error = exc
+                    board.note_error(lane)
                     failures += 1
                     if failures >= 3:
                         raise
@@ -973,6 +999,7 @@ class SwarmDownloader:
                 except BaseException:
                     swarm.release(index, source)
                     raise
+                board.note_bytes(lane, len(data))
                 batch.add(index, data)
                 if swarm.endgame:
                     batch.flush()
@@ -1026,9 +1053,16 @@ class SwarmDownloader:
                     )
                 with conn:
                     swarm.register(conn)
+                    # per-peer lane on the swarm's source board: piece
+                    # bytes feed its EWMA so /metrics and the incident
+                    # probes tell the same mirror/webseed/peer story
+                    lane = swarm.sources.add(
+                        source_board.KIND_PEER, f"{host}:{port}"
+                    )
                     try:
-                        self._serve_pieces(conn, swarm, token)
+                        self._serve_pieces(conn, swarm, token, lane)
                     finally:
+                        swarm.sources.retire(lane)  # connection over
                         swarm.unregister(conn)
                         with swarm._lock:  # concurrent worker exits
                             self.blocks_served += conn.blocks_served
@@ -1111,7 +1145,11 @@ class SwarmDownloader:
         return b"".join(blocks[b] for b in sorted(blocks))
 
     def _serve_pieces(
-        self, conn: PeerConnection, swarm: "_SwarmState", token: CancelToken
+        self,
+        conn: PeerConnection,
+        swarm: "_SwarmState",
+        token: CancelToken,
+        lane: "source_board.Source | None" = None,
     ) -> None:
         store = swarm.store
         batch = _PieceBatch(swarm, owner=conn)
@@ -1188,6 +1226,11 @@ class SwarmDownloader:
                     with tracing.span("piece", index=index):
                         data = self._download_piece(conn, store, index)
                     if data is not None:
+                        if lane is not None:
+                            # per-peer rate accounting on the shared
+                            # source board (fetch/sources.py)
+                            swarm.sources.note_bytes(lane, len(data))
+                            swarm.sources.note_success(lane)
                         batch.add(index, data)
                         if swarm.endgame:
                             # tail pieces settle immediately: batching an

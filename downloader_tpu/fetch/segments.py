@@ -1,4 +1,4 @@
-"""Segmented multi-connection HTTP fetch with tail re-dispatch.
+"""Segmented multi-SOURCE HTTP fetch with cross-source tail racing.
 
 The single-stream backend (fetch/http.py) is bounded by ONE
 connection's throughput: server-side per-connection rate limits, a TCP
@@ -6,7 +6,19 @@ congestion window still opening, or a long-RTT path all cap a job well
 below the host's actual capacity. Multi-path transfer work (PAPERS.md,
 "Accelerating Intra-Node GPU-to-GPU Communication Through Multi-Path
 Transfers") recovers that bandwidth by striping one logical transfer
-across several concurrent paths; this module is the HTTP analogue:
+across several concurrent paths; this module is the HTTP analogue —
+and since PR 9 the *paths* are not just connections to one origin but
+whole origins: one job draws byte spans concurrently from the primary
+URL and any number of mirror URLs (job header ``X-Mirrors`` plus the
+``MIRROR_URLS`` config fallback), each admitted only when its probe
+matches the primary's size (and strong validator, when both have one).
+Every source carries an EWMA bandwidth estimate and an error score
+(fetch/sources.py); the span scheduler hands the next missing span to
+the best idle source, demotes sources slower than a fraction of the
+leader to a small-span trickle lane (recovery re-promotes), and
+retires sources that die mid-job — connection reset, Range dropped,
+deterministic 4xx/5xx — WITHOUT restarting the job: their in-flight
+spans return to the missing set and the surviving sources absorb them.
 
 1. **Probe** — one HEAD through the pooled connection: the object is
    segmentable iff the server advertises ``Accept-Ranges: bytes`` and
@@ -26,10 +38,11 @@ across several concurrent paths; this module is the HTTP analogue:
    span journal (``.part.spans``); a crashed or retried job reloads it
    and re-fetches only the missing ranges.
 5. **Endgame** — when no unclaimed ranges remain, idle workers
-   re-issue the slowest in-flight segment's remaining range on a
-   pooled connection (the torrent endgame pattern); whichever copy
-   finishes first cancels the loser. Duplicate bytes are identical
-   bytes at identical offsets — harmless.
+   re-issue the slowest in-flight segment's remaining range — on a
+   DIFFERENT source when one is live (the torrent endgame pattern,
+   generalized across origins); whichever copy finishes first cancels
+   the loser. Duplicate bytes are identical bytes at identical
+   offsets — harmless.
 
 If the server stops honoring Range mid-job (a cache tier change, a
 failover to a dumber origin), the whole segmented attempt aborts, the
@@ -51,6 +64,7 @@ import urllib.request
 from ..utils import admission, get_logger, incident, metrics, tracing, watchdog
 from ..utils.cancel import Cancelled, CancelToken
 from . import progress as transfer_progress
+from . import sources as source_accounting
 from .connpool import ConnectionPool
 from .progress import SpanSet
 
@@ -148,8 +162,29 @@ def plan_ranges(
 
 
 class RangeDropped(Exception):
-    """The server answered a ranged GET with 200 mid-job: it no longer
-    honors Range, so the striped plan is void — fall back."""
+    """A source answered a ranged GET with 200 mid-job: it no longer
+    honors Range. With other sources live the source is simply retired
+    and its spans reassigned; for the last source standing the striped
+    plan is void — fall back to single-stream."""
+
+
+class SourceRejected(Exception):
+    """A source answered in a way retrying cannot fix (deterministic
+    4xx, malformed or mismatched Content-Range, the wrong range):
+    permanent for THIS source, recoverable for the job while other
+    sources remain. The last source standing converts it into a plain
+    TransferError so the job-level retry policy applies unchanged."""
+
+
+# a demoted source's trickle lane carries spans at most this large: it
+# keeps being measured (so recovery re-promotes) without parking
+# megabytes of the object behind a known-slow lane
+TRICKLE_SPAN = 1024 * 1024
+
+# aggregate wall-clock budget for vetting a job's mirror candidates
+# (the concurrent HEADs in _admit_mirrors): a dead mirror costs every
+# job at most this once per PROBE_TTL, never a connect timeout each
+MIRROR_PROBE_BUDGET = 5.0
 
 
 def _abort_connection(conn: http.client.HTTPConnection) -> None:
@@ -301,10 +336,12 @@ class _Probe:
 class _Segment:
     """One claimed byte range. ``pos`` advances as bytes land on disk;
     ``stop`` is set when a rival copy (endgame) or a failure elsewhere
-    makes further work on this range pointless."""
+    makes further work on this range pointless. ``source`` is the
+    transfer source (fetch/sources.py) the claim is assigned to."""
 
     __slots__ = (
         "start", "end", "pos", "reported", "stop", "rival", "done", "rescue",
+        "source", "requeued",
     )
 
     def __init__(self, start: int, end: int, rival: "_Segment | None" = None):
@@ -316,6 +353,12 @@ class _Segment:
         self.rival = rival
         self.rescue = rival is not None  # born as an endgame duplicate
         self.done = False
+        self.source: "source_accounting.Source | None" = None
+        # a failed straggler/twin pair's tail goes back to the missing
+        # set exactly ONCE (guarded by the state lock): whichever side
+        # requeues marks both, or two live sources would fetch the same
+        # offsets outside endgame
+        self.requeued = False
 
 
 class _FetchState:
@@ -335,6 +378,7 @@ class _FetchState:
         progress,
         progress_interval: float,
         trace_parent,
+        mirrors: "list[tuple[str, _Probe]] | None" = None,
     ):
         self.fetcher = fetcher
         self.token = token
@@ -352,20 +396,42 @@ class _FetchState:
         # a plain counter add, safe from any thread
         self.fetch_hb = watchdog.current().heartbeat("fetch")
         self._lock = threading.Lock()
+        # the racing sources: primary first, then every admitted mirror
+        # (probes already vetted by fetch() — same total, compatible
+        # validator). The board owns rates/demotions; each source's
+        # payload is its own probe, so segment GETs dial the RIGHT
+        # origin with the RIGHT If-Range pin per source.
+        self.board = source_accounting.SourceBoard(
+            demote_ratio=getattr(fetcher, "_demote_ratio", None),
+            retire_errors=getattr(fetcher, "_retire_errors", None),
+        )
+        self.primary = self.board.add(
+            source_accounting.KIND_MIRROR, tracing.redact_url(url),
+            payload=probe,
+        )
+        for mirror_url, mirror_probe in mirrors or ():
+            self.board.add(
+                source_accounting.KIND_MIRROR,
+                tracing.redact_url(mirror_url),
+                payload=mirror_probe,
+            )
         self._queue: list[_Segment] = [  # guarded-by: _lock
             _Segment(lo, hi) for lo, hi in ranges
         ]
         self._active: list[_Segment] = []  # guarded-by: _lock
         self.failure: BaseException | None = None  # guarded-by: _lock
         self.redispatches = 0  # guarded-by: _lock
-        # endgame budget: ONE rescue per fetch (the ISSUE's "re-issue
-        # the slowest segment's remaining range", singular). Healthy
-        # segments all finish around the same time; letting every
-        # idle worker duplicate a remainder re-downloads the whole
-        # tail of the file in duplicate — measured 0.78x on the bench
-        # instead of a win. One rescue bounds the duplicate waste to
-        # one segment while still unsticking a genuinely dead tail.
-        self._rescue_budget = 1  # guarded-by: _lock
+        # endgame budget. Single-source: ONE rescue per fetch (PR 3's
+        # measured answer — healthy segments all finish around the same
+        # time, and duplicating every tail re-downloads it, 0.78x on
+        # the bench). Multi-source: one rescue per source — the whole
+        # point of racing origins is that the last spans may sit on a
+        # lane that just died or slowed, and each straggler is still
+        # duplicated at most once.
+        live_sources = self.board.live_count()
+        self._rescue_budget = (  # guarded-by: _lock
+            1 if live_sources <= 1 else live_sources
+        )
         self._bytes_done = 0  # guarded-by: _lock
         self._last_tick = time.monotonic()  # guarded-by: _lock
         # incident-bundle introspection: this transfer's live internals
@@ -397,21 +463,49 @@ class _FetchState:
             "redispatches": redispatches,
             "failure": failure,
             "heartbeat": self.fetch_hb.count,
+            "sources": self.board.snapshot(),
         }
 
     # -- work distribution ------------------------------------------------
 
-    def next_segment(self) -> _Segment | None:
+    def next_segment(self) -> _Segment | None:  # protocol: source-claim acquire conditional
+        """Claim the next span for the best available source. Queued
+        spans go to the board's pick (rate-weighted across active
+        sources, one bounded span at a time for the trickle lane);
+        with the queue drained, idle capacity races a straggler's
+        remainder on ANOTHER source (endgame). None: nothing for this
+        worker — done, failed, or every assignable lane is busy."""
+        self.board.rebalance()
         with self._lock:
             if self.failure is not None:
                 return None
             if self._queue:
+                source = self.board.pick(queued=len(self._queue))
+                if source is None:
+                    # every live lane is at capacity (trickle-only
+                    # moments); in-flight claims requeue through their
+                    # own workers, so idle ones may stand down
+                    return None
                 seg = self._queue.pop(0)
+                if (
+                    source.state == source_accounting.TRICKLE
+                    and seg.end - seg.start > TRICKLE_SPAN
+                ):
+                    # the trickle lane carries small spans only: the
+                    # demoted source keeps being measured without
+                    # parking megabytes behind a known-slow lane
+                    self._queue.insert(
+                        0, _Segment(seg.start + TRICKLE_SPAN, seg.end)
+                    )
+                    seg = _Segment(seg.start, seg.start + TRICKLE_SPAN)
+                seg.source = source
+                self.board.checkout(source)
                 self._active.append(seg)
                 return seg
             # endgame: duplicate the slowest straggler's remaining range
-            # on this now-idle worker; at most one rival per segment
-            # and one rescue per fetch (see _rescue_budget above)
+            # on this now-idle worker — on a DIFFERENT source when one
+            # is live; at most one rival per segment and one rescue per
+            # source (see _rescue_budget above)
             if self._rescue_budget <= 0:
                 return None
             straggler = None
@@ -427,6 +521,9 @@ class _FetchState:
                     straggler = seg
             if straggler is None:
                 return None
+            rescue_source = self.board.pick_rescue(straggler.source)
+            if rescue_source is None:
+                return None
             # steal from the REPORTED mark, not the in-memory pos: the
             # journal (and the streaming sink) only cover up to
             # ``reported``, and a loser cancelled mid-window exits with
@@ -434,6 +531,8 @@ class _FetchState:
             # would leave [reported, pos) covered by neither copy. The
             # ≤1 report-window overlap re-downloads identical bytes.
             twin = _Segment(straggler.reported, straggler.end, rival=straggler)
+            twin.source = rescue_source
+            self.board.checkout(rescue_source)
             straggler.rival = twin
             self._active.append(twin)
             self.redispatches += 1
@@ -443,22 +542,177 @@ class _FetchState:
             url=tracing.redact_url(self.url),
             start=twin.start,
             end=twin.end,
-        ).info("endgame: re-dispatching straggling segment range")
+            source=rescue_source.name,
+        ).info("endgame: racing straggling segment range across sources")
         return twin
 
-    def complete(self, seg: _Segment) -> None:
+    def complete(self, seg: _Segment) -> None:  # protocol: source-claim release bind=seg
         with self._lock:
             seg.done = True
             rival = seg.rival
+            source = seg.source
+        if source is not None:
+            self.board.checkin(source)
+            self.board.note_success(source)
         # first copy across the finish line cancels the loser
         if rival is not None and not rival.done:
             rival.stop.set()
 
-    def abandon(self, seg: _Segment) -> None:
+    def abandon(self, seg: _Segment) -> None:  # protocol: source-claim release bind=seg
         """A rescue twin giving up WITHOUT cancelling its rival — the
         straggler still owns the range; only the duplicate dies."""
         with self._lock:
             seg.done = True
+            source = seg.source
+        if source is not None:
+            self.board.checkin(source)
+
+    def release_failed(self, seg: _Segment, exc: BaseException) -> None:  # protocol: source-claim release bind=seg
+        """The single release point for every failed claim: classify
+        the failure, return the claim's unfinished range to the missing
+        set when another live source can absorb it, and fail the whole
+        fetch only when the job is truly out of sources. Written-but-
+        unjournaled bytes are reported first — they are on disk, and a
+        requeue from ``pos`` without them would leave [reported, pos)
+        covered by neither source."""
+        from .http import TransferError
+
+        def job_level(err: BaseException) -> BaseException:
+            # SourceRejected is a per-source verdict; when it must fail
+            # the JOB it becomes a plain TransferError so the daemon's
+            # transient-retry classification applies unchanged (a raw
+            # SourceRejected would miss the retry's except clause)
+            if isinstance(err, SourceRejected):
+                wrapped = TransferError(str(err))
+                wrapped.__cause__ = err
+                return wrapped
+            if (
+                isinstance(err, RangeDropped)
+                and source is not None
+                and source is not self.primary
+            ):
+                # the PR 3 RangeDropped fallback discards the journal
+                # and single-streams the PRIMARY URL — right when the
+                # primary itself dropped Range, wrong when a last-
+                # standing MIRROR did (the primary may already be dead,
+                # and the journaled bytes are the job's only progress).
+                # Fail job-level instead: the broker retry re-probes
+                # and resumes from the journal.
+                wrapped = TransferError(
+                    f"mirror stopped honoring Range mid-job ({err!r}); "
+                    "retry resumes from the span journal"
+                )
+                wrapped.__cause__ = err
+                return wrapped
+            return err
+
+        source = seg.source
+        if not isinstance(exc, (TransferError, RangeDropped, SourceRejected)):
+            # cancellation / unexpected: the job dies (journal and part
+            # file stay on disk for the broker retry)
+            if source is not None:
+                self.board.checkin(source)
+            with self._lock:
+                seg.done = True
+            self.fail(exc)
+            return
+        if seg.rescue:
+            # the rescue is a pure optimization and its range is still
+            # owned by the straggler; an origin rejecting the EXTRA
+            # connection (per-client caps → 503s) must not kill the
+            # healthy transfer it was backing up
+            self.abandon(seg)
+            if source is not None:
+                # a deterministic answer (200 instead of 206, 4xx) is
+                # just as final on a rescue claim as on a primary one:
+                # the source retires, it doesn't linger in the trickle
+                # lane failing the same way per claim
+                self.board.note_error(
+                    source,
+                    permanent=isinstance(
+                        exc, (RangeDropped, SourceRejected)
+                    ),
+                )
+            # the twin's written window is on disk: journal it, or an
+            # orphan requeue from ``pos`` below would leave
+            # [reported, pos) covered by neither copy
+            self.report(seg)
+            with self._lock:
+                # ... unless the straggler ALREADY died: it skipped its
+                # own requeue because this twin owned the range, so the
+                # uncovered tail now belongs to NOBODY — return it to
+                # the missing set (both writers journaled up to their
+                # pos, so the requeue starts past the further of them)
+                rival = seg.rival
+                orphaned = (
+                    rival is not None
+                    and rival.done
+                    and rival.pos < rival.end
+                    and not seg.requeued
+                    and not rival.requeued
+                    and self.failure is None
+                )
+                if orphaned:
+                    seg.requeued = rival.requeued = True
+                    lo = max(seg.pos, rival.pos)
+                    if lo < seg.end:
+                        self._queue.insert(0, _Segment(lo, seg.end))
+            log.with_fields(url=tracing.redact_url(self.url)).info(
+                f"endgame rescue gave up ({exc})"
+            )
+            return
+        if source is not None:
+            self.board.checkin(source)
+        permanent = isinstance(exc, (RangeDropped, SourceRejected))
+        # survivors = live sources OTHER than the failing one: the
+        # failing source never counts as its own survivor (a sibling
+        # claim's failure may have retired it already, and counting
+        # the healthy remainder as "last source standing" would kill
+        # a job the mirror could finish)
+        if source is None or self.board.live_count(exclude=source) < 1:
+            # the last source standing: PR 3 semantics bit for bit —
+            # the fetch fails (RangeDropped falls back to single-stream
+            # upstream)
+            with self._lock:
+                seg.done = True
+            if source is not None:
+                self.board.retire(source)
+            self.fail(job_level(exc))
+            return
+        self.board.note_error(source, permanent=permanent)
+        metrics.GLOBAL.add("http_source_failovers")
+        # journal the written-but-unreported window before the requeue
+        self.report(seg)
+        with self._lock:
+            seg.done = True
+            rival = seg.rival
+            rival_owns = rival is not None and not rival.done
+            already = seg.requeued or (rival is not None and rival.requeued)
+            if (
+                seg.pos < seg.end
+                and not rival_owns
+                and not already
+                and self.failure is None
+            ):
+                seg.requeued = True
+                if rival is not None:
+                    rival.requeued = True
+                # start past the further write mark of the pair: a dead
+                # twin journaled up to its own pos too
+                lo = (
+                    max(seg.pos, rival.pos) if rival is not None else seg.pos
+                )
+                if lo < seg.end:
+                    self._queue.insert(0, _Segment(lo, seg.end))
+        log.with_fields(
+            url=tracing.redact_url(self.url),
+            source=source.name,
+            start=seg.pos,
+            end=seg.end,
+        ).warning("source failed mid-job; remaining sources absorb its span")
+        if self.board.live_count() == 0:
+            # a concurrent failure retired the other sources too
+            self.fail(job_level(exc))
 
     def fail(self, exc: BaseException) -> None:
         with self._lock:
@@ -481,8 +735,12 @@ class _FetchState:
         self.journal.add(lo, hi)
         self.sink.add_span(self.final_path, lo, hi)
 
-    def note_bytes(self, got: int) -> None:
+    def note_bytes(self, seg: _Segment, got: int) -> None:
         self.fetch_hb.beat(got)
+        if seg.source is not None:
+            # per-source EWMA + the per-kind byte counters: what the
+            # scheduler's demotion/promotion decisions run on
+            self.board.note_bytes(seg.source, got)
         with self._lock:
             self._bytes_done += got
             now = time.monotonic()
@@ -508,6 +766,8 @@ class SegmentedFetcher:
         timeout: float = 30.0,
         max_attempts: int = 3,
         progress_interval: float = 1.0,
+        demote_ratio: float | None = None,
+        retire_errors: int | None = None,
     ):
         self.pool = pool or ConnectionPool(timeout=timeout)
         self._limit = segments_from_env() if segments is None else segments
@@ -519,6 +779,19 @@ class SegmentedFetcher:
         self._timeout = timeout
         self._max_attempts = max_attempts
         self._progress_interval = progress_interval
+        # multi-source racing knobs (fetch/sources.py): when to demote
+        # a slow source to the trickle lane and when repeated failures
+        # retire one for the job
+        self._demote_ratio = (
+            source_accounting.demote_ratio_from_env()
+            if demote_ratio is None
+            else demote_ratio
+        )
+        self._retire_errors = (
+            source_accounting.retire_errors_from_env()
+            if retire_errors is None
+            else retire_errors
+        )
         self._declined: dict[str, float] = {}  # url -> expiry; guarded-by: _declined_lock
         self._declined_lock = threading.Lock()
         # url -> (probe | None, expiry): every HEAD verdict — usable or
@@ -698,10 +971,21 @@ class SegmentedFetcher:
 
     # -- the transfer ------------------------------------------------------
 
-    def fetch(self, token: CancelToken, base_dir: str, progress, url: str) -> bool:
-        """Run the segmented transfer end to end. True: the file is
-        complete at its final path. False: not segmentable (or Range
-        support vanished mid-job) — run the single-stream path."""
+    def fetch(
+        self,
+        token: CancelToken,
+        base_dir: str,
+        progress,
+        url: str,
+        mirrors: "tuple[str, ...] | list[str]" = (),
+    ) -> bool:
+        """Run the (multi-source) segmented transfer end to end. True:
+        the file is complete at its final path. False: not segmentable
+        (or Range support vanished mid-job on the last live source) —
+        run the single-stream path. ``mirrors`` are alternate URLs for
+        the SAME object; each is admitted only when its probe matches
+        the primary's size (and strong validator, when both carry
+        one) — a mismatched mirror is skipped, never trusted."""
         from .http import TransferError, filename_for
 
         if not self.enabled or self._declined_recently(url):
@@ -717,6 +1001,7 @@ class SegmentedFetcher:
         if count < 2:
             self._note_declined(url)
             return False
+        admitted = self._admit_mirrors(token, url, probe, mirrors)
 
         final_path = os.path.join(
             base_dir, filename_for(url, probe.content_disposition)
@@ -753,6 +1038,7 @@ class SegmentedFetcher:
         # reacts to the recorded pressure at the next dequeue wave.
         scratch = admission.scratch_key(part_path)
         admission.LEDGER.charge("disk", scratch, probe.total)
+        state: _FetchState | None = None
         try:
             os.truncate(part_file.fileno(), probe.total)
 
@@ -772,13 +1058,15 @@ class SegmentedFetcher:
             state = _FetchState(
                 self, token, probe, url, final_path, part_file.fileno(),
                 journal, sink, ranges, progress, self._progress_interval,
-                tracing.current_span(),
+                tracing.current_span(), mirrors=admitted,
             )
             if ranges:
                 metrics.GLOBAL.observe(
                     "http_segments_per_fetch", len(ranges),
                     buckets=metrics.COUNT_BUCKETS,
                 )
+                if admitted:
+                    metrics.GLOBAL.add("http_multi_source_fetches")
                 workers = [
                     threading.Thread(
                         target=self._worker, args=(state,),
@@ -831,6 +1119,10 @@ class SegmentedFetcher:
             raise
         finally:
             admission.LEDGER.refund(scratch)
+            if state is not None:
+                # settle the per-kind active-source gauges whichever
+                # way this fetch ended
+                state.board.close()
         part_file.close()
 
         os.replace(part_path, final_path)
@@ -841,6 +1133,98 @@ class SegmentedFetcher:
         metrics.GLOBAL.add("http_segmented_fetches")
         progress(url, 100.0)
         return True
+
+    def _admit_mirrors(
+        self, token: CancelToken, url: str, probe: _Probe, mirrors
+    ) -> "list[tuple[str, _Probe]]":
+        """Vet each candidate mirror with its own (cached) HEAD: only a
+        mirror that accepts ranges and reports the primary's exact size
+        may serve spans of this object — and when both ends carry a
+        strong validator, those must agree too (same size, different
+        ETag means a different object, and stitching two objects into
+        one file is silent corruption). A rejected mirror just means
+        fewer lanes; it is never fatal.
+
+        Probes run CONCURRENTLY under one aggregate budget: a dead or
+        black-holed mirror must cost the job one bounded wait, not
+        MIRROR_MAX serial connect timeouts before the first byte (the
+        same hostile-HEAD shape the admission layer budgets its byte
+        probes against). A candidate whose probe outlives the budget is
+        skipped for THIS job; its probe thread parks on its socket
+        timeout and feeds the probe cache for the next one."""
+        candidates = [
+            m for m in dict.fromkeys(mirrors or ()) if m != url
+        ]
+        if not candidates:
+            return []
+        results: "dict[str, _Probe | None]" = {}
+
+        def probe_one(mirror_url: str) -> None:
+            try:
+                cached = self._cached_probe(mirror_url)
+                if cached is not self._PROBE_MISS:
+                    results[mirror_url] = cached
+                    return
+                verdict = self.probe(mirror_url, token)
+                if verdict is None:
+                    # probe() deliberately does not cache connect-level
+                    # failures (transient for a RETRYING caller); for
+                    # admission the verdict is the same either way —
+                    # negative-cache it here so a dead mirror costs
+                    # jobs one budget per PROBE_TTL, not one each.
+                    # This line also runs from a thread that outlived
+                    # the budget, feeding the cache for the next job.
+                    self._remember_probe(mirror_url, None)
+                results[mirror_url] = verdict
+            except Exception as exc:
+                # a probe must never kill the job; unanswered == skip
+                log.with_fields(
+                    mirror=tracing.redact_url(mirror_url)
+                ).debug(f"mirror probe failed ({exc})")
+                results[mirror_url] = None
+
+        threads = [
+            threading.Thread(
+                target=probe_one, args=(m,),
+                name="mirror-probe", daemon=True,
+            )
+            for m in candidates
+        ]
+        for thread in threads:
+            thread.start()
+        deadline = time.monotonic() + min(self._timeout, MIRROR_PROBE_BUDGET)
+        for thread in threads:
+            # deadline: each join is bounded by the shared probe budget computed above
+            thread.join(max(0.0, deadline - time.monotonic()))
+        token.raise_if_cancelled()
+        admitted: "list[tuple[str, _Probe]]" = []
+        for mirror_url in candidates:
+            in_time = mirror_url in results
+            mirror_probe = results.get(mirror_url)
+            reason = None
+            if not in_time:
+                reason = "probe outlived the admission budget"
+            elif mirror_probe is None or not mirror_probe.accept_ranges:
+                reason = "no usable ranged HEAD"
+            elif mirror_probe.total != probe.total:
+                reason = (
+                    f"size {mirror_probe.total} != primary {probe.total}"
+                )
+            elif (
+                probe.strong_validator
+                and mirror_probe.strong_validator
+                and mirror_probe.strong_validator != probe.strong_validator
+            ):
+                reason = "strong validator disagrees with the primary"
+            if reason is not None:
+                metrics.GLOBAL.add("http_mirror_rejects")
+                log.with_fields(
+                    url=tracing.redact_url(url),
+                    mirror=tracing.redact_url(mirror_url),
+                ).warning(f"mirror not admitted ({reason})")
+                continue
+            admitted.append((mirror_url, mirror_probe))
+        return admitted
 
     # -- small-object fast path --------------------------------------------
 
@@ -1021,38 +1405,38 @@ class SegmentedFetcher:
     # -- workers -----------------------------------------------------------
 
     def _worker(self, state: _FetchState) -> None:
-        from .http import TransferError
-
         with tracing.adopt(state.trace_parent):
             while True:
                 seg = state.next_segment()
-                if seg is None:
+                if not seg:
                     return
                 try:
                     self._fetch_segment(state, seg)
-                    state.complete(seg)
                 except BaseException as exc:
-                    if seg.rescue and isinstance(exc, TransferError):
-                        # the rescue is a pure optimization and its
-                        # range is still owned by the straggler; an
-                        # origin rejecting the EXTRA connection (per-
-                        # client caps → 503s) must not kill the healthy
-                        # transfer it was backing up
-                        state.abandon(seg)
-                        log.with_fields(
-                            url=tracing.redact_url(state.url)
-                        ).info(f"endgame rescue gave up ({exc})")
-                        continue
-                    state.fail(exc)
-                    return
+                    # every failure path releases through ONE gate: the
+                    # state decides whether the source retires and the
+                    # span requeues (other sources absorb it) or the
+                    # whole fetch dies (last source standing)
+                    state.release_failed(seg, exc)
+                    if state.failure is not None:
+                        return
+                    continue
+                state.complete(seg)
 
     def _fetch_segment(self, state: _FetchState, seg: _Segment) -> None:
         from .http import TransferError
 
-        probe = state.probe
+        # the claim's own source decides which origin the GETs dial and
+        # which validator pins If-Range — per-source, per the ISSUE's
+        # "ETag/If-Range pinning and resume-journal semantics per
+        # source" (the journal itself stays pinned to the primary)
+        source = seg.source
+        probe = source.payload if source is not None else state.probe
         attempts = 0
         span = tracing.span(
             "http-segment", start=seg.start, end=seg.end, rescue=seg.rescue,
+            source=source.name if source is not None else "primary",
+            kind=source.kind if source is not None else "mirror",
         )
         with span:
             metrics.GLOBAL.gauge_add("http_segments_in_flight", 1)
@@ -1130,18 +1514,19 @@ class SegmentedFetcher:
     ) -> bool:
         """Write one ranged response's body at its offsets. Returns
         True when the body was drained to its end (connection clean for
-        reuse). Raises RangeDropped / TransferError on protocol-level
-        surprises; transient statuses just return False."""
-        from .http import TransferError
-
+        reuse). Raises RangeDropped / SourceRejected on protocol-level
+        surprises (permanent for the serving source); transient
+        statuses just return False."""
         with response:
             if response.status == 200:
-                # mid-job loss of Range support: the caller falls back
+                # mid-job loss of Range support: this SOURCE is done —
+                # other live sources absorb its spans; the last source
+                # standing falls the whole fetch back to single-stream
                 raise RangeDropped()
             if response.status != 206:
                 response.read()  # drain the error body best-effort
                 if response.status < 500 and response.status != 429:
-                    raise TransferError(
+                    raise SourceRejected(
                         f"http status {response.status} for ranged GET"
                     )
                 return False  # transient; the attempt loop retries
@@ -1149,21 +1534,24 @@ class SegmentedFetcher:
                 (response.getheader("Content-Range") or "").strip()
             )
             if not match:
-                raise TransferError(
+                raise SourceRejected(
                     "malformed Content-Range on ranged response: "
                     f"{response.getheader('Content-Range')!r}"
                 )
             got_start, got_total = int(match.group(1)), int(match.group(3))
             if got_total != state.probe.total:
-                # the object changed size under us: every byte already
-                # journaled or speculatively uploaded is suspect
+                # the object changed size under THIS source: every byte
+                # already journaled or speculatively uploaded is
+                # suspect, so the stream is invalidated (the upload
+                # degrades to store-and-forward) — but surviving
+                # sources still pin the probed total and finish the job
                 state.sink.invalidate(state.final_path)
-                raise TransferError(
+                raise SourceRejected(
                     f"Content-Range total {got_total} != probed "
                     f"{state.probe.total}; object changed mid-transfer"
                 )
             if got_start != seg.pos:
-                raise TransferError(
+                raise SourceRejected(
                     f"server returned range at {got_start}, asked {seg.pos}"
                 )
 
@@ -1198,7 +1586,7 @@ class SegmentedFetcher:
                     view = view[wrote:]
                 seg.pos += len(chunk)
                 remaining -= len(chunk)
-                state.note_bytes(len(chunk))
+                state.note_bytes(seg, len(chunk))
                 if seg.pos - seg.reported >= REPORT_WINDOW or remaining == 0:
                     state.report(seg)
             # reusable only when the body is EXACTLY drained: a server

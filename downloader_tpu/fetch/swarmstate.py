@@ -16,6 +16,7 @@ import time
 
 from ..parallel import DigestEngine, default_engine
 from ..utils import get_logger, metrics
+from . import sources as source_accounting
 from .http import TransferError
 from .peerwire import BLOCK_SIZE, PeerProtocolError
 
@@ -124,6 +125,11 @@ class _SwarmState:
         # scan cursor: everything below it is permanently complete, so
         # claims stay O(total) over the torrent instead of O(n^2)
         self._scan_start = 0
+        # multi-source accounting (fetch/sources.py): webseed and peer
+        # workers register here so swarm traffic lands on the same
+        # per-kind rate/demotion board as the HTTP span scheduler —
+        # one /metrics story for mirror, webseed, and peer bytes
+        self.sources = source_accounting.SourceBoard()
 
     def register(self, conn) -> None:
         """Track a live connection; its (HAVE-updated) bitfield feeds

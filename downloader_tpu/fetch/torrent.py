@@ -37,6 +37,10 @@ METADATA_TIMEOUT = 600.0  # reference torrent.go:67: 10 minutes
 
 
 class TorrentBackend:
+    # job mirrors (X-Mirrors / MIRROR_URLS) ride as extra BEP 19
+    # webseeds: the swarm races them against peers piece for piece
+    supports_mirrors = True
+
     def __init__(
         self,
         progress_interval: float = 1.0,
@@ -145,12 +149,30 @@ class TorrentBackend:
     # -- download --------------------------------------------------------
 
     def download(
-        self, token: CancelToken, base_dir: str, progress: ProgressFn, url: str
+        self,
+        token: CancelToken,
+        base_dir: str,
+        progress: ProgressFn,
+        url: str,
+        mirrors: "tuple[str, ...]" = (),
     ) -> None:
         try:
             job = self._job_from_url(token, url)
         except MagnetError as exc:
             raise TransferError(str(exc)) from exc
+        if mirrors:
+            # a torrent job's mirrors ARE webseeds: HTTP(S)/FTP origins
+            # serving the same content ride the swarm's claim pool and
+            # race the peers piece for piece (BEP 19), with the shared
+            # source board accounting their rates and demotions
+            merged = tuple(
+                dict.fromkeys((*job.web_seeds, *mirrors))
+            )
+            if merged != job.web_seeds:
+                log.with_fields(extra=len(merged) - len(job.web_seeds)).info(
+                    "riding job mirrors as extra webseeds"
+                )
+                job.web_seeds = merged
 
         log.with_fields(
             info_hash=job.info_hash.hex(), name=job.display_name

@@ -22,6 +22,7 @@ import threading
 import time
 from typing import Callable
 
+from ..fetch.sources import parse_mirror_list
 from ..utils import admission, get_logger, metrics
 from .broker import BrokerError, Channel, Message
 
@@ -33,6 +34,11 @@ RETRY_HEADER = "X-Retries"
 # the worker's configured defaults
 CLASS_HEADER = "X-Job-Class"
 TENANT_HEADER = "X-Tenant"
+# multi-source racing fetch (fetch/sources.py): alternate URLs for the
+# SAME object, comma/whitespace separated; the fetch layer races byte
+# spans across every mirror whose probe matches the primary. Garbage
+# entries degrade to fewer sources, never to a dropped job.
+MIRRORS_HEADER = "X-Mirrors"
 # the DLQ contract for shed jobs: how many times this message has been
 # shed, when a re-injector may retry it, why it was shed, and — past
 # the redelivery cap — a terminal marker re-injectors must honor
@@ -144,6 +150,11 @@ class Delivery:
         )
         self.tenant = admission.normalize_tenant(
             message.headers.get(TENANT_HEADER)
+        )
+        # parsed mirror list for the multi-source fetch; the daemon
+        # merges it with the MIRROR_URLS config fallback per job
+        self.mirrors = parse_mirror_list(
+            message.headers.get(MIRRORS_HEADER)
         )
         self._channel = channel
         self._on_settled = on_settled

@@ -104,6 +104,53 @@ HELP = {
     "slo_job_duration_seconds_bulk": (
         "completed bulk-class job latency, consume to ack"
     ),
+    "http_multi_source_fetches": (
+        "segmented fetches that raced spans across more than one source"
+    ),
+    "http_mirror_rejects": (
+        "candidate mirrors refused admission (probe disagreed with the "
+        "primary's size or validator)"
+    ),
+    "http_source_failovers": (
+        "mid-job source failures whose spans were absorbed by the "
+        "remaining live sources"
+    ),
+    "fetch_sources_active_mirror": (
+        "live HTTP mirror sources (primary included) across in-flight jobs"
+    ),
+    "fetch_sources_active_webseed": (
+        "live BEP 19 webseed sources across in-flight swarms"
+    ),
+    "fetch_sources_active_peer": (
+        "live torrent peer sources across in-flight swarms"
+    ),
+    "source_bytes_total_mirror": "bytes fetched from HTTP mirror sources",
+    "source_bytes_total_webseed": "bytes fetched from webseed sources",
+    "source_bytes_total_peer": "bytes fetched from torrent peer sources",
+    "source_demotions_total_mirror": (
+        "mirror sources demoted to the trickle lane (slow or erroring; "
+        "recovery re-promotes)"
+    ),
+    "source_demotions_total_webseed": (
+        "webseed sources demoted to the trickle lane (slow or erroring; "
+        "recovery re-promotes)"
+    ),
+    "source_demotions_total_peer": (
+        "peer sources demoted to the trickle lane (slow or erroring; "
+        "recovery re-promotes)"
+    ),
+    "source_retires_total_mirror": (
+        "mirror sources retired for their job (repeated or deterministic "
+        "failures, or job end)"
+    ),
+    "source_retires_total_webseed": (
+        "webseed sources retired for their job (repeated or deterministic "
+        "failures, or job end)"
+    ),
+    "source_retires_total_peer": (
+        "peer sources retired for their job (connection end, repeated or "
+        "deterministic failures)"
+    ),
     "watchdog_stalls": "stall episodes flagged (no forward progress)",
     "watchdog_cancels": "stalled jobs cancelled (WATCHDOG_ACTION=cancel)",
     "watchdog_stalled_tasks": "watched tasks currently flagged as stalled",

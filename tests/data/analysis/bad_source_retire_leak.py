@@ -1,0 +1,21 @@
+"""Known-bad fixture: the source-RETIRE path leaks the claim — the
+worker bails out when its source retires mid-job without checking the
+claim back in, so the lane's in-flight slot is held forever and the
+span scheduler reads the dead lane as busy."""
+
+
+class ClaimBoard:
+    def checkout(self, source):  # protocol: fixture-source-claim acquire
+        return object()
+
+    def checkin(self, claim):  # protocol: fixture-source-claim release bind=claim
+        pass
+
+
+def drain(board, source):
+    claim = board.checkout(source)
+    if source.retired:
+        return None  # the retire path: the claim is never checked in
+    transfer(claim)
+    board.checkin(claim)
+    return None

@@ -182,6 +182,38 @@ def test_bench_digest_picks_up_segmented_ablation():
     assert digest["segmented_pool_reuse_hits"] == 9
 
 
+def test_bench_digest_picks_up_multi_source_arm():
+    """The multi_source ablation's contract numbers — the >=1.8x
+    racing ratio and the failover's completed/amplification pair —
+    must survive into the digest line."""
+    import sys
+
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench_digest
+    finally:
+        sys.path.remove(str(REPO))
+
+    report = {
+        "value": 100.0,
+        "extra_metrics": [
+            {
+                "metric": "multi_source",
+                "multi_vs_single": 2.4,
+                "failover": {
+                    "completed": True,
+                    "fetch_amplification": 1.04,
+                    "source_failovers": 1,
+                },
+            }
+        ],
+    }
+    digest = bench_digest.digest_line(report)
+    assert digest["multi_source_x"] == 2.4
+    assert digest["multi_failover_completed"] is True
+    assert digest["multi_failover_amplification"] == 1.04
+
+
 def test_bench_digest_picks_up_overload_shedding_arm():
     """The overload_shedding ablation must survive into the digest
     line: the interactive-p99 protection contract would otherwise
@@ -224,3 +256,18 @@ def test_circleci_runs_overload_smoke():
         if isinstance(s, dict) and "run" in s
     )
     assert "test_admission_chaos.py" in commands
+
+
+def test_circleci_runs_mirror_failover_smoke():
+    """The multi-source acceptance scenario — primary killed
+    mid-stream, job completes from the secondary with zero dangling
+    multipart uploads — must run as a named CI smoke step."""
+    yaml = pytest.importorskip("yaml")
+    ci = yaml.safe_load(CONFIG.read_text())
+    commands = " ".join(
+        s["run"]["command"]
+        for s in ci["jobs"]["tests"]["steps"]
+        if isinstance(s, dict) and "run" in s
+    )
+    assert "test_multisource.py" in commands
+    assert "test_primary_death_e2e_zero_dangling_multiparts" in commands

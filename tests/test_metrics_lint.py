@@ -59,6 +59,16 @@ def exposition():
     metrics.GLOBAL.add("watchdog_stalls", 1)
     metrics.GLOBAL.gauge_set("pipeline_parts_in_flight", 2)
     metrics.GLOBAL.gauge_set("watchdog_stalled_tasks", 1)
+    # the per-kind multi-source families (fetch/sources.py): populate
+    # every kind so the lint walks the real exposition each would get
+    for kind in ("mirror", "webseed", "peer"):
+        metrics.GLOBAL.gauge_set(f"fetch_sources_active_{kind}", 1)
+        metrics.GLOBAL.add(f"source_bytes_total_{kind}", 1024)
+        metrics.GLOBAL.add(f"source_demotions_total_{kind}", 1)
+        metrics.GLOBAL.add(f"source_retires_total_{kind}", 1)
+    metrics.GLOBAL.add("http_multi_source_fetches", 1)
+    metrics.GLOBAL.add("http_source_failovers", 1)
+    metrics.GLOBAL.add("http_mirror_rejects", 1)
     metrics.GLOBAL.observe("job_duration_seconds", 0.5)
     metrics.GLOBAL.observe(
         "overhead_seconds", 0.002, buckets=metrics.OVERHEAD_BUCKETS
@@ -191,6 +201,33 @@ def test_histogram_triples_consistent(exposition):
         # an observation above the top finite bound must still land in
         # +Inf/_count (the over-bound tail observed in the fixture)
         assert count >= counts[-2] if len(counts) > 1 else True
+
+
+def test_source_families_carry_catalogued_help(exposition):
+    """Every per-kind multi-source family must have a CATALOGUED HELP
+    line (metrics.HELP), not the derived word-swap fallback — these are
+    the series the multi-source dashboards key on."""
+    from downloader_tpu.utils.metrics import HELP
+
+    families, _ = _parse(exposition)
+    for kind in ("mirror", "webseed", "peer"):
+        for stem in (
+            "fetch_sources_active",
+            "source_bytes_total",
+            "source_demotions_total",
+            "source_retires_total",
+        ):
+            name = f"{stem}_{kind}"
+            assert name in HELP, f"{name} missing from the HELP catalog"
+            exported = f"downloader_{name}"
+            assert exported in families, f"{exported} not exported"
+            assert families[exported]["help"] == HELP[name]
+    for name in (
+        "http_multi_source_fetches",
+        "http_source_failovers",
+        "http_mirror_rejects",
+    ):
+        assert name in HELP, f"{name} missing from the HELP catalog"
 
 
 def test_expected_series_present(exposition):

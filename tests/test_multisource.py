@@ -1,0 +1,849 @@
+"""Multi-source racing fetch, end to end (ISSUE 9): one job draws
+byte spans concurrently from its primary URL and admitted mirrors.
+
+- mirror admission: a candidate must match the primary's size (and
+  strong validator when both carry one) or it is skipped, never
+  trusted;
+- span racing: both origins serve ranged GETs of ONE job, bytes land
+  byte-identical;
+- failover: the primary dying mid-stream (connection aborts, then
+  refused requests) retires it; surviving sources absorb its spans
+  WITHOUT re-fetching journaled bytes and without restarting the job
+  — including the acceptance run against the real S3 stub proving
+  zero dangling multipart uploads (the CI mirror-failover smoke
+  step);
+- per-source protocol failures (Range dropped, deterministic 4xx) on
+  a mirror retire the mirror only — the job stays segmented;
+- the endgame re-dispatch races a straggler's tail on a DIFFERENT
+  source when one is live.
+"""
+
+import hashlib
+import http.server
+import os
+import threading
+import time
+
+import pytest
+
+from downloader_tpu.fetch import HTTPBackend
+from downloader_tpu.fetch import progress as transfer_progress
+from downloader_tpu.fetch.segments import SegmentedFetcher, _FetchState
+from downloader_tpu.queue.broker import Message
+from downloader_tpu.queue.delivery import Delivery
+from downloader_tpu.utils import metrics
+from downloader_tpu.utils.cancel import CancelToken
+
+PAYLOAD = os.urandom(6 * 1024 * 1024)
+SEG_MIN = 256 * 1024
+
+
+class _QuietThreadingServer(http.server.ThreadingHTTPServer):
+    daemon_threads = True
+
+    def handle_error(self, request, client_address):
+        pass  # aborted connections are this suite's bread and butter
+
+
+class _Origin:
+    """One configurable origin server: Range + HEAD capable, with the
+    failure modes the scheduler must survive — per-chunk throttling, a
+    kill switch (in-flight bodies abort, new requests are refused), a
+    Range-support drop after N ranged GETs, and a deterministic error
+    status. Tracks requests and bytes actually handed to the socket."""
+
+    def __init__(
+        self,
+        payload=PAYLOAD,
+        etag='"v1"',
+        chunk_sleep=0.0,
+        drop_ranges_after=None,
+        reject_status=None,
+        accept_ranges=True,
+    ):
+        origin = self
+        origin.requests = []
+        origin.head_requests = 0
+        origin.served_bytes = 0
+        origin.dead = threading.Event()
+        origin.ranged_gets = 0
+        origin._lock = threading.Lock()
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def do_HEAD(self):
+                origin.head_requests += 1
+                if origin.dead.is_set():
+                    self.close_connection = True
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                if accept_ranges:
+                    self.send_header("Accept-Ranges", "bytes")
+                if etag:
+                    self.send_header("ETag", etag)
+                self.end_headers()
+
+            def do_GET(self):
+                rng = self.headers.get("Range")
+                with origin._lock:
+                    origin.requests.append(rng)
+                if origin.dead.is_set():
+                    self.close_connection = True
+                    return
+                if reject_status is not None and rng is not None:
+                    self.send_response(reject_status)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                honor = rng is not None
+                if honor and drop_ranges_after is not None:
+                    with origin._lock:
+                        origin.ranged_gets += 1
+                        honor = origin.ranged_gets <= drop_ranges_after
+                body = payload
+                if honor:
+                    lo, hi = rng[6:].split("-")
+                    lo, hi = int(lo), int(hi) if hi else len(payload) - 1
+                    self.send_response(206)
+                    self.send_header(
+                        "Content-Range", f"bytes {lo}-{hi}/{len(payload)}"
+                    )
+                    body = body[lo : hi + 1]
+                else:
+                    self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                sent = 0
+                while sent < len(body):
+                    if origin.dead.is_set():
+                        # mid-body death: promise broken, socket down
+                        self.close_connection = True
+                        return
+                    chunk = body[sent : sent + 64 * 1024]
+                    try:
+                        self.wfile.write(chunk)
+                        self.wfile.flush()
+                    except OSError:
+                        return  # client cancelled (endgame loser)
+                    sent += len(chunk)
+                    with origin._lock:
+                        origin.served_bytes += len(chunk)
+                    if chunk_sleep:
+                        time.sleep(chunk_sleep)
+
+        self.httpd = _QuietThreadingServer(("127.0.0.1", 0), Handler)
+        threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        ).start()
+        self.url = (
+            f"http://127.0.0.1:{self.httpd.server_address[1]}/movie.mkv"
+        )
+
+    def kill(self):
+        """In-flight bodies abort at the next chunk; new requests get
+        the connection closed in their face."""
+        self.dead.set()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def make_fetcher(**kwargs):
+    kwargs.setdefault("segments", 4)
+    kwargs.setdefault("min_segment_bytes", SEG_MIN)
+    kwargs.setdefault("timeout", 5)
+    kwargs.setdefault("progress_interval", 0.01)
+    return SegmentedFetcher(**kwargs)
+
+
+@pytest.fixture(autouse=True)
+def _reset_metrics():
+    metrics.GLOBAL.reset()
+    yield
+    metrics.GLOBAL.reset()
+
+
+# ---------------------------------------------------------------------------
+# racing + admission
+
+
+class TestMirrorRacing:
+    def test_spans_race_across_origins_byte_identical(self, tmp_path):
+        primary, mirror = _Origin(), _Origin()
+        fetcher = make_fetcher()
+        try:
+            done = fetcher.fetch(
+                CancelToken(), str(tmp_path), lambda u, p: None,
+                primary.url, mirrors=(mirror.url,),
+            )
+            assert done is True
+            got = (tmp_path / "movie.mkv").read_bytes()
+            assert hashlib.sha256(got).digest() == hashlib.sha256(
+                PAYLOAD
+            ).digest()
+            # BOTH origins carried ranged spans of the one job
+            assert any(r for r in primary.requests)
+            assert any(r for r in mirror.requests)
+            snap = metrics.GLOBAL.snapshot()
+            assert snap.get("http_multi_source_fetches", 0) == 1
+            assert snap.get("source_bytes_total_mirror", 0) >= len(PAYLOAD)
+            # the board settled its gauges on the way out
+            assert metrics.GLOBAL.gauges().get(
+                "fetch_sources_active_mirror", 0
+            ) == 0
+        finally:
+            fetcher.close()
+            primary.close()
+            mirror.close()
+
+    def test_size_mismatched_mirror_is_rejected(self, tmp_path):
+        primary = _Origin()
+        liar = _Origin(payload=PAYLOAD[: len(PAYLOAD) // 2])
+        fetcher = make_fetcher()
+        try:
+            done = fetcher.fetch(
+                CancelToken(), str(tmp_path), lambda u, p: None,
+                primary.url, mirrors=(liar.url,),
+            )
+            assert done is True
+            assert (tmp_path / "movie.mkv").read_bytes() == PAYLOAD
+            # the liar answered its vetting HEAD but never served a span
+            assert liar.requests == []
+            snap = metrics.GLOBAL.snapshot()
+            assert snap.get("http_mirror_rejects", 0) == 1
+            assert snap.get("http_multi_source_fetches", 0) == 0
+        finally:
+            fetcher.close()
+            primary.close()
+            liar.close()
+
+    def test_mirror_admission_rides_the_probe_cache(self, tmp_path):
+        """Back-to-back jobs with the same mirror must not re-HEAD it:
+        admission reads the probe cache (and negative-caches a dead
+        candidate) so a mirror costs one vetting round per PROBE_TTL,
+        not one per job."""
+        primary, mirror = _Origin(), _Origin()
+        fetcher = make_fetcher()
+        try:
+            for job in ("a", "b"):
+                job_dir = tmp_path / job
+                job_dir.mkdir()
+                done = fetcher.fetch(
+                    CancelToken(), str(job_dir), lambda u, p: None,
+                    primary.url, mirrors=(mirror.url,),
+                )
+                assert done is True
+                assert (job_dir / "movie.mkv").read_bytes() == PAYLOAD
+            assert mirror.head_requests == 1, (
+                f"mirror re-probed per job ({mirror.head_requests} HEADs)"
+            )
+        finally:
+            fetcher.close()
+            primary.close()
+            mirror.close()
+
+    def test_validator_mismatched_mirror_is_rejected(self, tmp_path):
+        primary = _Origin(etag='"v1"')
+        stale = _Origin(etag='"v2"')  # same size, different object
+        fetcher = make_fetcher()
+        try:
+            done = fetcher.fetch(
+                CancelToken(), str(tmp_path), lambda u, p: None,
+                primary.url, mirrors=(stale.url,),
+            )
+            assert done is True
+            assert stale.requests == []
+            assert metrics.GLOBAL.snapshot().get(
+                "http_mirror_rejects", 0
+            ) == 1
+        finally:
+            fetcher.close()
+            primary.close()
+            stale.close()
+
+
+# ---------------------------------------------------------------------------
+# failover: sources dying mid-job
+
+
+class TestFailover:
+    def test_primary_death_completes_from_mirror_without_refetch(
+        self, tmp_path, monkeypatch
+    ):
+        """Kill the primary once it has served real bytes: the mirror
+        absorbs the returned spans and the job completes WITHOUT
+        re-fetching what the journal already covers — measured at the
+        disk, where re-fetched bytes cannot hide."""
+        # BOTH origins paced: an unthrottled loopback mirror can finish
+        # the whole job before the kill thread fires, and the test
+        # would measure nothing (the bench failover arm learned the
+        # same lesson)
+        primary = _Origin(chunk_sleep=0.02)
+        mirror = _Origin(chunk_sleep=0.005)
+        write_counts = bytearray(len(PAYLOAD))
+        count_lock = threading.Lock()
+        real_pwrite = os.pwrite
+
+        def counting_pwrite(fd, data, offset):
+            wrote = real_pwrite(fd, data, offset)
+            with count_lock:
+                for off in range(offset, offset + wrote):
+                    write_counts[off] = min(255, write_counts[off] + 1)
+            return wrote
+
+        monkeypatch.setattr(os, "pwrite", counting_pwrite)
+        fetcher = make_fetcher(timeout=5, max_attempts=2)
+        killer = None
+        try:
+            def kill_when_warm():
+                deadline = time.monotonic() + 20
+                while time.monotonic() < deadline:
+                    if primary.served_bytes >= 256 * 1024:
+                        primary.kill()
+                        return
+                    time.sleep(0.005)
+
+            killer = threading.Thread(target=kill_when_warm, daemon=True)
+            killer.start()
+            done = fetcher.fetch(
+                CancelToken(), str(tmp_path), lambda u, p: None,
+                primary.url, mirrors=(mirror.url,),
+            )
+            assert done is True, "failover fell back instead of completing"
+            assert (tmp_path / "movie.mkv").read_bytes() == PAYLOAD
+            assert primary.dead.is_set(), "primary outlived the kill window"
+            with count_lock:
+                assert all(c >= 1 for c in write_counts), "holes in the file"
+                doubled = sum(1 for c in write_counts if c > 1)
+            # endgame twins may re-cover a straggler's tail per rescue
+            # (budget: one per source, segments are a quarter of the
+            # object here); a job that re-fetched its journaled spans
+            # doubles well past that
+            assert doubled < len(PAYLOAD) // 2, (
+                f"{doubled} bytes fetched twice: journaled spans were "
+                "re-fetched after the failover"
+            )
+            assert metrics.GLOBAL.snapshot().get(
+                "http_source_failovers", 0
+            ) >= 1
+        finally:
+            if killer is not None:
+                killer.join(timeout=30)
+            fetcher.close()
+            primary.close()
+            mirror.close()
+
+    def test_primary_death_e2e_zero_dangling_multiparts(self, tmp_path):
+        """The CI mirror-failover smoke: the full dispatcher + streaming
+        session + real S3 stub. The primary dies mid-stream; the job
+        completes from the secondary and the store shows ZERO dangling
+        multipart uploads."""
+        from downloader_tpu.fetch import DispatchClient
+        from downloader_tpu.scan import scan_dir
+        from downloader_tpu.store import Credentials, S3Client, Uploader
+        from downloader_tpu.store.stub import S3Stub
+
+        primary = _Origin(chunk_sleep=0.02)
+        mirror = _Origin()
+        creds = Credentials(access_key="k", secret_key="s")
+        killer = None
+        try:
+            with S3Stub(credentials=creds) as stub:
+                client = S3Client(
+                    stub.endpoint, creds,
+                    multipart_threshold=1024 * 1024,
+                    part_size=1024 * 1024,
+                )
+                uploader = Uploader("bucket", client)
+                uploader.configure_pipeline(True, part_workers=2)
+                token = CancelToken()
+                base = tmp_path / "jobs"
+                base.mkdir()
+                backend = HTTPBackend(
+                    progress_interval=0.01, timeout=5,
+                    segments=4, segment_min_bytes=SEG_MIN,
+                )
+                dispatcher = DispatchClient(token, str(base), [backend])
+
+                def kill_when_warm():
+                    deadline = time.monotonic() + 20
+                    while time.monotonic() < deadline:
+                        if primary.served_bytes >= 512 * 1024:
+                            primary.kill()
+                            return
+                        time.sleep(0.01)
+
+                killer = threading.Thread(
+                    target=kill_when_warm, daemon=True
+                )
+                killer.start()
+                session = uploader.streaming_session("job-failover", token)
+                with transfer_progress.install(session):
+                    job_dir = dispatcher.download(
+                        "job-failover", primary.url,
+                        mirrors=(mirror.url,),
+                    )
+                files = scan_dir(job_dir)
+                streamed = session.finalize(files)
+                session.close()
+                assert (
+                    open(job_dir + "/movie.mkv", "rb").read() == PAYLOAD
+                )
+                assert primary.dead.is_set()
+                # the acceptance bar: nothing dangling, however the
+                # stream ended (completed or invalidated mid-failover)
+                assert stub.list_multipart_uploads() == []
+                for path in streamed.values():
+                    assert path  # completed streams name their keys
+                uploader.close()
+        finally:
+            if killer is not None:
+                killer.join(timeout=30)
+            primary.close()
+            mirror.close()
+
+    def test_mirror_range_drop_retires_mirror_job_stays_segmented(
+        self, tmp_path
+    ):
+        """A mirror losing Range support mid-job is ITS problem: the
+        mirror retires, the primary finishes the stripe — no job-wide
+        single-stream fallback (that is last-source-standing behavior,
+        pinned by test_segments)."""
+        primary = _Origin()
+        flaky = _Origin(drop_ranges_after=1)
+        fetcher = make_fetcher()
+        try:
+            done = fetcher.fetch(
+                CancelToken(), str(tmp_path), lambda u, p: None,
+                primary.url, mirrors=(flaky.url,),
+            )
+            assert done is True, "mirror failure must not void the stripe"
+            assert (tmp_path / "movie.mkv").read_bytes() == PAYLOAD
+            snap = metrics.GLOBAL.snapshot()
+            assert snap.get("http_source_failovers", 0) >= 1
+            assert snap.get("source_retires_total_mirror", 0) >= 1
+            assert snap.get("http_segmented_fallbacks", 0) == 0
+        finally:
+            fetcher.close()
+            primary.close()
+            flaky.close()
+
+    def test_blackholed_mirror_costs_one_bounded_wait(self, tmp_path):
+        """A mirror that accepts the TCP connect and then never answers
+        its HEAD must cost the job ONE bounded admission wait (probes
+        run concurrently under a budget), not a serial connect timeout
+        per candidate before the first byte."""
+        import socket
+
+        primary = _Origin()
+        sink = socket.socket()
+        sink.bind(("127.0.0.1", 0))
+        sink.listen(8)
+        dead_url = f"http://127.0.0.1:{sink.getsockname()[1]}/movie.mkv"
+        fetcher = make_fetcher(timeout=2)
+        try:
+            start = time.monotonic()
+            done = fetcher.fetch(
+                CancelToken(), str(tmp_path), lambda u, p: None,
+                primary.url, mirrors=(dead_url, dead_url),
+            )
+            elapsed = time.monotonic() - start
+            assert done is True
+            assert (tmp_path / "movie.mkv").read_bytes() == PAYLOAD
+            assert elapsed < 15, (
+                f"dead mirror stalled admission for {elapsed:.1f}s"
+            )
+            assert metrics.GLOBAL.snapshot().get(
+                "http_mirror_rejects", 0
+            ) >= 1
+        finally:
+            fetcher.close()
+            primary.close()
+            sink.close()
+
+    def test_mirror_4xx_retires_mirror_job_completes(self, tmp_path):
+        primary = _Origin()
+        denier = _Origin(reject_status=403)
+        fetcher = make_fetcher()
+        try:
+            done = fetcher.fetch(
+                CancelToken(), str(tmp_path), lambda u, p: None,
+                primary.url, mirrors=(denier.url,),
+            )
+            assert done is True
+            assert (tmp_path / "movie.mkv").read_bytes() == PAYLOAD
+            assert metrics.GLOBAL.snapshot().get(
+                "source_retires_total_mirror", 0
+            ) >= 1
+        finally:
+            fetcher.close()
+            primary.close()
+            denier.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-source endgame
+
+
+def make_state(fetcher, ranges, mirrors=()):
+    class _Probe:
+        total = max(hi for _, hi in ranges)
+        scheme, host, port, request_path = "http", "h", 80, "/"
+        content_disposition = None
+        validator = ""
+        strong_validator = ""
+
+    class _NullJournal:
+        class spans:
+            @staticmethod
+            def total():
+                return 0
+
+        @staticmethod
+        def add(lo, hi):
+            pass
+
+    return _FetchState(
+        fetcher, CancelToken(), _Probe(), "http://h/", "/tmp/x", -1,
+        _NullJournal(), transfer_progress.NOOP, ranges,
+        lambda u, p: None, 1.0, None,
+        mirrors=[(url, _Probe()) for url in mirrors],
+    )
+
+
+class TestCrossSourceEndgame:
+    def test_rescue_twin_rides_a_different_source(self):
+        fetcher = make_fetcher(min_segment_bytes=1, timeout=1)
+        state = make_state(
+            fetcher, [(0, 10_000_000)], mirrors=("http://m/",)
+        )
+        seg = state.next_segment()
+        seg.pos = seg.reported = 1_000_000
+        twin = state.next_segment()
+        assert twin is not None and twin.rescue
+        assert twin.source is not None and seg.source is not None
+        assert twin.source is not seg.source, (
+            "endgame raced the straggler on its own source with a "
+            "live alternative"
+        )
+        state.board.close()
+        fetcher.close()
+
+    def test_multi_source_endgame_budget_is_one_rescue_per_source(self):
+        fetcher = make_fetcher(min_segment_bytes=1, timeout=1)
+        state = make_state(
+            fetcher, [(0, 10_000_000), (10_000_000, 20_000_000)],
+            mirrors=("http://m/",),
+        )
+        a = state.next_segment()
+        b = state.next_segment()
+        a.pos = a.reported = 2_000_000
+        b.pos = b.reported = 12_000_000
+        twins = [state.next_segment(), state.next_segment()]
+        assert all(t is not None and t.rescue for t in twins)
+        # budget exhausted: a third idle worker stands down
+        assert state.next_segment() is None
+        state.board.close()
+        fetcher.close()
+
+    def test_failed_source_spans_return_to_missing_set(self):
+        from downloader_tpu.fetch.http import TransferError
+
+        fetcher = make_fetcher(min_segment_bytes=1, timeout=1)
+        state = make_state(
+            fetcher, [(0, 10_000_000)], mirrors=("http://m/",)
+        )
+        seg = state.next_segment()
+        seg.pos = seg.reported = 4_000_000
+        failed_source = seg.source
+        state.release_failed(seg, TransferError("connection reset"))
+        assert state.failure is None, "failover killed the job"
+        # the unfetched remainder is claimable again — by the OTHER source
+        requeued = state.next_segment()
+        assert requeued is not None
+        assert (requeued.start, requeued.end) == (4_000_000, 10_000_000)
+        assert requeued.source is not failed_source
+        state.board.close()
+        fetcher.close()
+
+    def test_sibling_claim_failure_on_retired_source_spares_the_job(self):
+        """Regression: a source with TWO claims in flight fails both —
+        the first failure retires it, and the second must read as
+        'requeue for the survivor', not 'last source standing' (the
+        live-count used to include the healthy survivor only, killing
+        a job the mirror could finish)."""
+        from downloader_tpu.fetch.segments import SourceRejected
+
+        fetcher = make_fetcher(min_segment_bytes=1, timeout=1)
+        state = make_state(
+            fetcher, [(0, 8_000_000), (8_000_000, 16_000_000)],
+            mirrors=("http://m/",),
+        )
+        claims = [state.next_segment() for _ in range(2)]
+        doomed = claims[0].source
+        # force both claims onto one source for the scenario
+        for claim in claims:
+            if claim.source is not doomed:
+                state.board.checkin(claim.source)
+                state.board.checkout(doomed)
+                claim.source = doomed
+        state.release_failed(claims[0], SourceRejected("403"))
+        assert doomed.retired
+        state.release_failed(claims[1], SourceRejected("403"))
+        assert state.failure is None, (
+            "second sibling failure killed the job despite a live mirror"
+        )
+        # both spans are claimable by the survivor
+        absorbed = state.next_segment()
+        assert absorbed is not None and absorbed.source is not doomed
+        state.board.close()
+        fetcher.close()
+
+    def test_straggler_then_twin_double_failure_requeues_orphan_tail(self):
+        """Regression: straggler fails first (skips its requeue — the
+        twin owns the range), then the twin fails too. The tail then
+        belongs to NOBODY unless the twin's release notices its rival
+        already died and returns the remainder to the missing set."""
+        from downloader_tpu.fetch.http import TransferError
+
+        fetcher = make_fetcher(min_segment_bytes=1, timeout=1)
+        state = make_state(
+            fetcher, [(0, 10_000_000)],
+            mirrors=("http://m1/", "http://m2/"),
+        )
+        straggler = state.next_segment()
+        straggler.pos = straggler.reported = 2_000_000
+        twin = state.next_segment()
+        assert twin is not None and twin.rescue
+        twin.pos = twin.reported = 3_000_000
+        # straggler dies first: rival (the twin) owns the range, so no
+        # requeue happens here
+        state.release_failed(straggler, TransferError("reset"))
+        assert state.failure is None
+        # now the twin dies as well: the orphaned tail must requeue,
+        # starting past the further of the two journaled write marks
+        state.release_failed(twin, TransferError("reset"))
+        assert state.failure is None
+        rescued = state.next_segment()
+        assert rescued is not None, "orphaned tail was never requeued"
+        assert (rescued.start, rescued.end) == (3_000_000, 10_000_000)
+        state.board.close()
+        fetcher.close()
+
+    def test_concurrent_retirement_backstop_wraps_source_rejected(
+        self, monkeypatch
+    ):
+        """Regression: when a sibling failure retires the LAST other
+        source between this claim's survivor check and its requeue, the
+        backstop fails the job — and must wrap SourceRejected into
+        TransferError so the daemon's transient-retry classification
+        still applies (a raw SourceRejected misses its except clause)."""
+        from downloader_tpu.fetch.http import TransferError
+        from downloader_tpu.fetch.segments import SourceRejected
+
+        fetcher = make_fetcher(min_segment_bytes=1, timeout=1)
+        state = make_state(
+            fetcher, [(0, 10_000_000)], mirrors=("http://m/",)
+        )
+        seg = state.next_segment()
+        real_note_error = state.board.note_error
+
+        def concurrent_race(source, permanent=False):
+            out = real_note_error(source, permanent=permanent)
+            # the other source dies concurrently, after the survivor
+            # check already passed
+            for other in state.board.live():
+                state.board.retire(other)
+            return out
+
+        monkeypatch.setattr(state.board, "note_error", concurrent_race)
+        state.release_failed(seg, SourceRejected("http status 403"))
+        assert isinstance(state.failure, TransferError)
+        assert isinstance(state.failure.__cause__, SourceRejected)
+        state.board.close()
+        fetcher.close()
+
+    def test_pair_tail_requeued_at_most_once_under_racing_failures(self):
+        """Regression: a straggler and its twin failing near-
+        simultaneously must requeue their shared tail exactly ONCE —
+        a double requeue hands the same offsets to two live sources
+        outside endgame."""
+        from downloader_tpu.fetch.http import TransferError
+
+        fetcher = make_fetcher(min_segment_bytes=1, timeout=1)
+        state = make_state(
+            fetcher, [(0, 10_000_000)],
+            mirrors=("http://m1/", "http://m2/"),
+        )
+        straggler = state.next_segment()
+        straggler.pos = straggler.reported = 2_000_000
+        twin = state.next_segment()
+        twin.pos = twin.reported = 3_000_000
+        # the twin dies FIRST (abandon marks it done), then the
+        # straggler's failover runs with rival_owns=False and requeues;
+        # the twin's own orphan check must then see the pair's flag
+        state.release_failed(twin, TransferError("reset"))
+        state.release_failed(straggler, TransferError("reset"))
+        first = state.next_segment()
+        assert first is not None
+        assert (first.start, first.end) == (3_000_000, 10_000_000)
+        with state._lock:
+            leftover = list(state._queue)
+        assert leftover == [], (
+            "the pair's tail was requeued twice: "
+            f"{[(s.start, s.end) for s in leftover]}"
+        )
+        state.board.close()
+        fetcher.close()
+
+    def test_rescue_deterministic_failure_retires_its_source(self):
+        """Regression: a 200/4xx on a rescue claim is as final as on a
+        primary claim — the source retires instead of lingering in the
+        trickle lane failing the same way once per claim."""
+        from downloader_tpu.fetch.segments import RangeDropped
+
+        fetcher = make_fetcher(min_segment_bytes=1, timeout=1)
+        state = make_state(
+            fetcher, [(0, 10_000_000)], mirrors=("http://m/",)
+        )
+        seg = state.next_segment()
+        seg.pos = seg.reported = 1_000_000
+        twin = state.next_segment()
+        assert twin is not None and twin.rescue
+        rescue_source = twin.source
+        state.release_failed(twin, RangeDropped())
+        assert rescue_source.retired
+        assert state.failure is None  # the straggler still owns the range
+        state.board.close()
+        fetcher.close()
+
+    def test_mirror_range_drop_as_last_source_fails_job_level(self):
+        """Regression: the PR 3 RangeDropped fallback single-streams
+        the PRIMARY URL after discarding the journal — correct when the
+        primary dropped Range, wrong when a last-standing MIRROR did
+        (the primary may be dead and the journal is the only progress).
+        The mirror case must fail job-level so the retry resumes."""
+        from downloader_tpu.fetch.http import TransferError
+        from downloader_tpu.fetch.segments import RangeDropped
+
+        fetcher = make_fetcher(min_segment_bytes=1, timeout=1)
+        state = make_state(
+            fetcher, [(0, 10_000_000)], mirrors=("http://m/",)
+        )
+        state.board.retire(state.primary)  # the primary died earlier
+        seg = state.next_segment()
+        assert seg.source is not state.primary
+        state.release_failed(seg, RangeDropped())
+        assert isinstance(state.failure, TransferError), (
+            "mirror RangeDropped leaked the PR 3 primary fallback"
+        )
+        assert isinstance(state.failure.__cause__, RangeDropped)
+        state.board.close()
+        fetcher.close()
+
+    def test_last_source_standing_keeps_pr3_failure_semantics(self):
+        from downloader_tpu.fetch.http import TransferError
+
+        fetcher = make_fetcher(min_segment_bytes=1, timeout=1)
+        state = make_state(fetcher, [(0, 10_000_000)])
+        seg = state.next_segment()
+        state.release_failed(seg, TransferError("origin died"))
+        assert isinstance(state.failure, TransferError)
+        assert state.next_segment() is None
+        state.board.close()
+        fetcher.close()
+
+
+# ---------------------------------------------------------------------------
+# job plumbing: X-Mirrors header → Delivery → daemon merge
+
+
+class _NullChannel:
+    def ack(self, tag):
+        pass
+
+    def nack(self, tag, requeue=False):
+        pass
+
+
+class TestMirrorPlumbing:
+    def test_delivery_parses_x_mirrors_header(self):
+        message = Message(
+            body=b"{}", delivery_tag=1,
+            headers={"X-Mirrors": "http://m1/x, junk http://m2/x"},
+        )
+        delivery = Delivery(message, _NullChannel())
+        assert delivery.mirrors == ("http://m1/x", "http://m2/x")
+        delivery.ack()
+
+    def test_delivery_without_header_has_no_mirrors(self):
+        message = Message(body=b"{}", delivery_tag=1)
+        delivery = Delivery(message, _NullChannel())
+        assert delivery.mirrors == ()
+        delivery.ack()
+
+    def test_daemon_merges_header_mirrors_before_config_fallback(self):
+        """The producer's X-Mirrors list (it knows the object) orders
+        ahead of the worker's MIRROR_URLS fallback; the primary is
+        dropped and the cap applies across both."""
+        from downloader_tpu.daemon.app import Daemon
+        from downloader_tpu.daemon.config import Config
+
+        config = Config()
+        config.mirror_urls = ("http://cfg1/x", "http://cfg2/x")
+        config.mirror_max = 3
+        daemon = Daemon.__new__(Daemon)  # plumbing only, no run loop
+        daemon._config = config
+
+        class _Delivery:
+            mirrors = ("http://hdr/x", "http://primary/x")
+
+        got = daemon._job_mirrors(_Delivery(), "http://primary/x")
+        assert got == ("http://hdr/x", "http://cfg1/x", "http://cfg2/x")
+
+    def test_dispatcher_passes_mirrors_only_to_capable_backends(
+        self, tmp_path
+    ):
+        from downloader_tpu.fetch import DispatchClient
+
+        from downloader_tpu.fetch.dispatch import BackendRegistration
+
+        calls = {}
+
+        class Plain:
+            def register(self):
+                return BackendRegistration(
+                    name="plain", protocols=("plain",), file_extensions=()
+                )
+
+            def download(self, token, job_dir, progress, url):
+                calls["plain"] = True
+
+        class MirrorAware:
+            supports_mirrors = True
+
+            def register(self):
+                return BackendRegistration(
+                    name="aware", protocols=("aware",), file_extensions=()
+                )
+
+            def download(self, token, job_dir, progress, url, mirrors=()):
+                calls["aware"] = mirrors
+
+        dispatcher = DispatchClient(
+            CancelToken(), str(tmp_path), [Plain(), MirrorAware()]
+        )
+        dispatcher.download(
+            "a", "plain://x", mirrors=("http://m/x",)
+        )
+        assert calls["plain"] is True  # kwarg never reached it
+        dispatcher.download(
+            "b", "aware://x", mirrors=("http://m/x",)
+        )
+        assert calls["aware"] == ("http://m/x",)

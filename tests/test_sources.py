@@ -1,0 +1,492 @@
+"""Multi-source accounting tests (fetch/sources.py) + the SpanSet
+claim-arithmetic fuzz (ISSUE 9 satellite).
+
+The SourceBoard is the shared bookkeeping half of the multi-source
+racing fetch: EWMA rates, demotion to the trickle lane, retirement,
+and the per-kind /metrics story. The fuzz half drives the SpanSet the
+span scheduler accounts into through randomized concurrent
+claim/write/fail/requeue schedules — the invariant under test is the
+ISSUE's: no byte is ever fetched twice into the same offset by two
+live sources outside endgame.
+"""
+
+import random
+import threading
+
+import pytest
+
+from downloader_tpu.fetch import sources
+from downloader_tpu.fetch.progress import SpanSet
+from downloader_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def _reset_metrics():
+    metrics.GLOBAL.reset()
+    yield
+    metrics.GLOBAL.reset()
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+    def tick(self, seconds):
+        self.now += seconds
+
+
+def make_board(**kwargs):
+    clock = FakeClock()
+    board = sources.SourceBoard(
+        demote_ratio=kwargs.pop("demote_ratio", 0.25),
+        retire_errors=kwargs.pop("retire_errors", 3),
+        clock=clock,
+        **kwargs,
+    )
+    return board, clock
+
+
+# ---------------------------------------------------------------------------
+# mirror-list parsing / merging / env knobs
+
+
+class TestMirrorParsing:
+    def test_parse_mirror_list_formats(self):
+        assert sources.parse_mirror_list(None) == ()
+        assert sources.parse_mirror_list("") == ()
+        assert sources.parse_mirror_list(42) == ()
+        assert sources.parse_mirror_list(
+            "http://a/x, https://b/x\n ftp://c/x"
+        ) == ("http://a/x", "https://b/x", "ftp://c/x")
+
+    def test_parse_drops_garbage_keeps_order_dedups(self):
+        got = sources.parse_mirror_list(
+            "http://a/x not-a-url file:///etc/passwd http://a/x http://b/x"
+        )
+        assert got == ("http://a/x", "http://b/x")
+
+    def test_parse_caps_hostile_lists(self):
+        raw = " ".join(f"http://m{i}/x" for i in range(100))
+        assert len(sources.parse_mirror_list(raw)) == 16
+
+    def test_merge_cap_zero_is_the_off_switch(self):
+        """Regression: MIRROR_MAX=0 must disable mirrors entirely — the
+        cap used to be checked after the first append, so 0 yielded one
+        mirror the operator asked to turn off."""
+        assert sources.merge_mirrors(
+            "http://primary/x", ("http://a/x", "http://b/x"), cap=0
+        ) == ()
+        assert sources.merge_mirrors(
+            "http://primary/x", ("http://a/x",), cap=-1
+        ) == ()
+
+    def test_merge_excludes_primary_and_caps(self):
+        got = sources.merge_mirrors(
+            "http://primary/x",
+            ("http://a/x", "http://primary/x"),
+            ("http://a/x", "http://b/x", "http://c/x"),
+            cap=2,
+        )
+        assert got == ("http://a/x", "http://b/x")
+
+    def test_env_knobs_defaults_and_garbage(self):
+        assert sources.mirrors_from_env({}) == ()
+        assert sources.mirrors_from_env(
+            {"MIRROR_URLS": "http://m1/x,http://m2/x"}
+        ) == ("http://m1/x", "http://m2/x")
+        assert sources.mirror_max_from_env({}) == 4
+        assert sources.mirror_max_from_env({"MIRROR_MAX": "2"}) == 2
+        assert sources.mirror_max_from_env({"MIRROR_MAX": "junk"}) == 4
+        assert sources.demote_ratio_from_env({}) == 0.25
+        assert sources.demote_ratio_from_env(
+            {"SOURCE_DEMOTE_RATIO": "0.5"}
+        ) == 0.5
+        assert sources.demote_ratio_from_env(
+            {"SOURCE_DEMOTE_RATIO": "nan-ish"}
+        ) == 0.25
+        assert sources.demote_ratio_from_env(
+            {"SOURCE_DEMOTE_RATIO": "7"}
+        ) == 1.0
+        assert sources.retire_errors_from_env({}) == 3
+        assert sources.retire_errors_from_env(
+            {"SOURCE_RETIRE_ERRORS": "0"}
+        ) == 1
+        assert sources.retire_errors_from_env(
+            {"SOURCE_RETIRE_ERRORS": "x"}
+        ) == 3
+
+
+# ---------------------------------------------------------------------------
+# the EWMA meter
+
+
+class TestSourceMeter:
+    def test_no_history_reads_none(self):
+        clock = FakeClock()
+        meter = sources.SourceMeter(clock)
+        assert meter.rate() is None
+
+    def test_rate_folds_closed_windows(self):
+        clock = FakeClock()
+        meter = sources.SourceMeter(clock)
+        clock.tick(meter.WINDOW)
+        meter.note(1_000_000)  # closes a window at ~2 MB/s
+        rate = meter.rate()
+        assert rate == pytest.approx(1_000_000 / meter.WINDOW, rel=0.01)
+
+    def test_stalled_source_reads_slower_not_last_good(self):
+        clock = FakeClock()
+        meter = sources.SourceMeter(clock)
+        clock.tick(meter.WINDOW)
+        meter.note(10_000_000)
+        fast = meter.rate()
+        # the blend compounds per elapsed stalled window: a fully
+        # stalled near-leader must sink BELOW any realistic demote
+        # floor, not hover one blend under its last good rate
+        clock.tick(3 * meter.WINDOW)
+        assert meter.rate() < fast * 0.25
+        clock.tick(60.0)  # a minute of silence: effectively zero
+        assert meter.rate() < fast * 0.01
+
+    def test_open_window_burst_never_promotes(self):
+        """A burst inside a half-open window is noise: the read-time
+        blend only ever LOWERS the estimate."""
+        clock = FakeClock()
+        meter = sources.SourceMeter(clock)
+        clock.tick(meter.WINDOW)
+        meter.note(1_000_000)
+        steady = meter.rate()
+        clock.tick(meter.WINDOW)
+        meter.note(100_000_000)  # huge burst, window not yet folded
+        assert meter.rate() <= max(
+            steady, 100_000_000 / meter.WINDOW
+        )
+
+
+# ---------------------------------------------------------------------------
+# board lifecycle: demotion, promotion, retirement, gauges
+
+
+class TestSourceBoard:
+    def test_error_demotes_then_retires_at_budget(self):
+        board, _ = make_board(retire_errors=3)
+        src = board.add(sources.KIND_MIRROR, "m1")
+        assert board.note_error(src) == sources.TRICKLE
+        assert board.note_error(src) == sources.TRICKLE
+        assert board.note_error(src) == sources.RETIRED
+        assert src.retired
+        snap = metrics.GLOBAL.snapshot()
+        assert snap.get("source_demotions_total_mirror") == 1
+        assert snap.get("source_retires_total_mirror") == 1
+        assert board.live_count() == 0
+
+    def test_permanent_error_retires_immediately(self):
+        board, _ = make_board()
+        src = board.add(sources.KIND_WEBSEED, "w1")
+        assert board.note_error(src, permanent=True) == sources.RETIRED
+        assert metrics.GLOBAL.snapshot().get(
+            "source_retires_total_webseed"
+        ) == 1
+
+    def test_success_resets_consecutive_errors(self):
+        board, _ = make_board(retire_errors=2)
+        src = board.add(sources.KIND_MIRROR, "m1")
+        board.note_error(src)
+        board.note_success(src)
+        board.note_error(src)
+        assert not src.retired  # the streak never reached 2
+
+    def test_active_gauge_settles_once_through_any_exit(self):
+        board, _ = make_board()
+        a = board.add(sources.KIND_MIRROR, "m1")
+        board.add(sources.KIND_PEER, "p1")
+        gauges = metrics.GLOBAL.gauges()
+        assert gauges.get("fetch_sources_active_mirror") == 1
+        assert gauges.get("fetch_sources_active_peer") == 1
+        board.retire(a)
+        board.retire(a)  # idempotent
+        board.close()
+        board.close()  # idempotent
+        gauges = metrics.GLOBAL.gauges()
+        assert gauges.get("fetch_sources_active_mirror") == 0
+        assert gauges.get("fetch_sources_active_peer") == 0
+
+    def test_bytes_feed_per_kind_counters(self):
+        board, _ = make_board()
+        src = board.add(sources.KIND_PEER, "p1")
+        board.note_bytes(src, 4096)
+        board.note_bytes(src, -1)  # ignored
+        assert metrics.GLOBAL.snapshot().get("source_bytes_total_peer") == 4096
+
+    def test_rebalance_demotes_slow_source_and_repromotes(self):
+        board, clock = make_board(demote_ratio=0.5)
+        fast = board.add(sources.KIND_MIRROR, "fast")
+        slow = board.add(sources.KIND_MIRROR, "slow")
+        window = fast.meter.WINDOW
+        for _ in range(4):
+            clock.tick(window)
+            board.note_bytes(fast, 10_000_000)
+            board.note_bytes(slow, 1_000_000)
+        clock.tick(sources.REBALANCE_INTERVAL)
+        board.rebalance()
+        assert slow.state == sources.TRICKLE
+        assert fast.state == sources.ACTIVE
+        assert metrics.GLOBAL.snapshot().get(
+            "source_demotions_total_mirror"
+        ) == 1
+        # the slow lane recovers: rates converge, the next rebalance
+        # re-promotes (a demotion is never a ban)
+        for _ in range(8):
+            clock.tick(window)
+            board.note_bytes(fast, 10_000_000)
+            board.note_bytes(slow, 10_000_000)
+        clock.tick(sources.REBALANCE_INTERVAL)
+        board.rebalance()
+        assert slow.state == sources.ACTIVE
+
+    def test_rebalance_needs_signal_before_judging(self):
+        """Sources under MIN_RATE_SAMPLE are never demoted — judging a
+        lane on its first packets would demote every cold start."""
+        board, clock = make_board(demote_ratio=0.9)
+        fast = board.add(sources.KIND_MIRROR, "fast")
+        cold = board.add(sources.KIND_MIRROR, "cold")
+        for _ in range(4):
+            clock.tick(fast.meter.WINDOW)
+            board.note_bytes(fast, 10_000_000)
+            board.note_bytes(cold, 1024)  # barely started
+        clock.tick(sources.REBALANCE_INTERVAL)
+        board.rebalance()
+        assert cold.state == sources.ACTIVE
+
+    def test_rebalance_self_limits_cadence(self):
+        board, clock = make_board(demote_ratio=0.5)
+        fast = board.add(sources.KIND_MIRROR, "fast")
+        slow = board.add(sources.KIND_MIRROR, "slow")
+        for _ in range(4):
+            clock.tick(fast.meter.WINDOW)
+            board.note_bytes(fast, 10_000_000)
+            board.note_bytes(slow, 1_000_000)
+        board.rebalance()
+        assert slow.state == sources.TRICKLE and slow.demotions == 1
+        # hot paths may call rebalance freely: within the cadence
+        # window nothing recomputes (the still-slow lane, manually
+        # re-promoted, is not re-demoted until the interval passes)
+        slow.state = sources.ACTIVE
+        clock.tick(sources.REBALANCE_INTERVAL / 5)
+        board.rebalance()
+        assert slow.state == sources.ACTIVE and slow.demotions == 1
+        clock.tick(sources.REBALANCE_INTERVAL)
+        board.rebalance()
+        assert slow.state == sources.TRICKLE and slow.demotions == 2
+
+
+# ---------------------------------------------------------------------------
+# span assignment: pick() and pick_rescue()
+
+
+class TestPick:
+    def test_pick_prefers_measured_fast_idle_source(self):
+        board, clock = make_board()
+        fast = board.add(sources.KIND_MIRROR, "fast")
+        slow = board.add(sources.KIND_MIRROR, "slow")
+        for _ in range(4):
+            clock.tick(fast.meter.WINDOW)
+            board.note_bytes(fast, 10_000_000)
+            board.note_bytes(slow, 1_000_000)
+        assert board.pick() is fast
+        # loaded leader vs idle runner-up: in-flight claims discount
+        for _ in range(12):
+            board.checkout(fast)
+        assert board.pick() is slow
+
+    def test_unmeasured_source_scores_optimistically(self):
+        """A fresh mirror must get probed with real spans instead of
+        starving behind the first source to report bytes."""
+        board, clock = make_board()
+        measured = board.add(sources.KIND_MIRROR, "measured")
+        fresh = board.add(sources.KIND_MIRROR, "fresh")
+        clock.tick(measured.meter.WINDOW)
+        board.note_bytes(measured, 1_000_000)
+        board.checkout(measured)
+        assert board.pick() is fresh
+
+    def test_trickle_gets_one_span_only_with_work_to_spare(self):
+        board, _ = make_board()
+        active = board.add(sources.KIND_MIRROR, "active")
+        demoted = board.add(sources.KIND_MIRROR, "demoted")
+        board.note_error(demoted)
+        assert demoted.state == sources.TRICKLE
+        # the tail of a transfer never lands on a known-slow lane
+        assert board.pick(queued=1) is active
+        # plenty queued: one span keeps the demoted lane measured
+        assert board.pick(queued=5) is demoted
+        board.checkout(demoted)
+        assert board.pick(queued=5) is active  # its lane is occupied
+
+    def test_trickle_is_the_lane_of_last_resort(self):
+        board, _ = make_board()
+        only = board.add(sources.KIND_MIRROR, "only")
+        board.note_error(only)
+        assert only.state == sources.TRICKLE
+        assert board.pick(queued=1) is only
+        board.checkout(only)
+        assert board.pick(queued=1) is None  # busy; idle workers stand down
+
+    def test_rescue_races_on_a_different_source(self):
+        board, _ = make_board()
+        straggler = board.add(sources.KIND_MIRROR, "straggler")
+        other = board.add(sources.KIND_MIRROR, "other")
+        assert board.pick_rescue(straggler) is other
+
+    def test_trickle_never_rescues(self):
+        board, _ = make_board()
+        straggler = board.add(sources.KIND_MIRROR, "straggler")
+        demoted = board.add(sources.KIND_MIRROR, "demoted")
+        board.note_error(demoted)
+        # the only other lane is known-slow: rescue on the straggler's
+        # own source (the PR 3 single-source endgame)
+        assert board.pick_rescue(straggler) is straggler
+
+    def test_no_rescue_from_a_retired_world(self):
+        board, _ = make_board(retire_errors=1)
+        straggler = board.add(sources.KIND_MIRROR, "straggler")
+        board.note_error(straggler)
+        assert board.pick_rescue(straggler) is None
+
+    def test_snapshot_reports_live_view(self):
+        board, clock = make_board()
+        src = board.add(sources.KIND_MIRROR, "m1")
+        clock.tick(src.meter.WINDOW)
+        board.note_bytes(src, 1_000_000)
+        board.checkout(src)
+        (entry,) = board.snapshot()
+        assert entry["kind"] == "mirror"
+        assert entry["state"] == "active"
+        assert entry["inflight"] == 1
+        assert entry["bytes"] == 1_000_000
+        assert entry["rate_MBps"] > 0
+
+
+# ---------------------------------------------------------------------------
+# SpanSet under concurrent multi-source claims (the fuzz satellite)
+
+
+class _ClaimPool:
+    """The scheduler's claim arithmetic, reduced to its invariant: a
+    shared missing-set that sources claim spans from, return unfetched
+    remainders to, and journal completed windows into — the same moves
+    _FetchState makes (fetch/segments.py) without the sockets."""
+
+    def __init__(self, total):
+        self.total = total
+        self.lock = threading.Lock()
+        self.queue = [(0, total)]
+        self.journal = SpanSet()
+
+    def claim(self, max_len):
+        with self.lock:
+            if not self.queue:
+                return None
+            lo, hi = self.queue.pop(0)
+            if hi - lo > max_len:
+                self.queue.insert(0, (lo + max_len, hi))
+                hi = lo + max_len
+            return lo, hi
+
+    def requeue(self, lo, hi):
+        """A dying source returns its claim's unfetched remainder —
+        zero-length remainders (the claim finished as its source died)
+        must vanish, not poison the queue."""
+        with self.lock:
+            if hi > lo:
+                self.queue.insert(0, (lo, hi))
+
+    def journal_write(self, lo, hi):
+        with self.lock:
+            self.journal.add(lo, hi)
+
+
+@pytest.mark.parametrize("seed", [1, 7, 42, 1337])
+def test_spanset_fuzz_concurrent_claims_never_double_fetch(seed):
+    """N worker threads race claims through randomized schedules —
+    writes land in per-offset counters, claims fail mid-span and
+    requeue their remainder, report windows are split randomly
+    (adjacent-span merges), and zero-length artifacts are thrown in
+    deliberately. Invariants: every offset written EXACTLY once (no
+    byte fetched twice into the same offset by two live sources — the
+    fuzz runs no endgame), the journal converges to one full-coverage
+    span, and missing() agrees at every stage."""
+    total = 64 * 1024
+    pool = _ClaimPool(total)
+    writes = bytearray(total)  # per-offset write counts
+    write_lock = threading.Lock()
+    errors = []
+
+    def worker(worker_seed):
+        rng = random.Random(worker_seed)
+        try:
+            while True:
+                claim = pool.claim(max_len=rng.randrange(1, 4096))
+                if claim is None:
+                    return
+                lo, hi = claim
+                pos = lo
+                # a span returned to missing mid-claim: fail somewhere
+                # inside and requeue the rest
+                fail_at = (
+                    rng.randrange(lo, hi + 1) if rng.random() < 0.3 else hi
+                )
+                reported = lo
+                while pos < fail_at:
+                    step = min(rng.randrange(1, 512), fail_at - pos)
+                    with write_lock:
+                        for off in range(pos, pos + step):
+                            writes[off] += 1
+                    pos += step
+                    # random report windows: journal adds arrive as
+                    # adjacent/merging spans in arbitrary interleavings
+                    if rng.random() < 0.5 or pos == fail_at:
+                        pool.journal_write(reported, pos)
+                        reported = pos
+                pool.journal_write(reported, pos)  # zero-length when ==
+                pool.journal_write(pos, pos)  # deliberate zero-length
+                pool.requeue(pos, hi)
+        except BaseException as exc:  # pragma: no cover - fuzz harness
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=worker, args=(seed * 31 + i,))
+        for i in range(4)
+    ]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join(timeout=60)
+    assert not errors, errors
+    assert not any(thread.is_alive() for thread in threads)
+
+    assert all(count == 1 for count in writes), (
+        "offsets fetched twice by live sources: "
+        f"{[i for i, c in enumerate(writes) if c != 1][:10]}"
+    )
+    with pool.lock:
+        assert pool.journal.spans() == [(0, total)]
+        assert pool.journal.missing(total) == []
+        assert pool.journal.total() == total
+
+
+def test_spanset_adjacent_and_zero_length_edges():
+    spans = SpanSet()
+    spans.add(10, 10)  # zero-length: ignored
+    assert spans.spans() == []
+    spans.add(0, 10)
+    spans.add(10, 20)  # adjacent: merges
+    assert spans.spans() == [(0, 20)]
+    spans.add(30, 40)
+    spans.add(20, 30)  # bridges the gap
+    assert spans.spans() == [(0, 40)]
+    assert spans.missing(50) == [(40, 50)]
+    assert spans.covers(0, 40) and not spans.covers(0, 41)

@@ -72,6 +72,7 @@ def test_full_rule_catalog_registered():
         ("bad_exception_hygiene.py", "exception-hygiene", {9, 18, 24}),
         ("bad_protocol_leak.py", "protocol", {14}),
         ("bad_double_release.py", "protocol", {17}),
+        ("bad_source_retire_leak.py", "protocol", {16}),
         ("bad_blocking_deadline.py", "blocking-deadline", {19}),
     ],
 )
