@@ -40,6 +40,9 @@ BENCH_MULTI_SOURCE=0 to skip the multi-source racing arm
 (BENCH_MULTI_MB MB per job, BENCH_MULTI_THROTTLE_MBPS aggregate origin
 cap, BENCH_MULTI_REPEATS interleaved single/multi rounds),
 BENCH_WATCHDOG=0 to skip the stall-watchdog heartbeat ablation,
+BENCH_TELEMETRY=0 to skip the whole-telemetry-plane on/off ablation
+(tracing + context propagation + watchdog + TSDB scraping + alert
+evaluation vs all of it off),
 BENCH_SMALL=0 to skip the small-object batched/unbatched arm
 (BENCH_SMALL_WAVE jobs per wave, BENCH_SMALL_WAVES rounds),
 BENCH_OVERLOAD=0 to skip the overload-shedding arm (BENCH_OVERLOAD_JOBS
@@ -1212,6 +1215,81 @@ def run_watchdog_ablation(
     }
 
 
+def run_telemetry_ablation(
+    site: str, samples: int, concurrency: int, repeats: int = 3
+) -> dict:
+    """The whole-telemetry-plane ablation (ISSUE 10 satellite): per-job
+    latency with EVERYTHING on — span tracing, trace-context
+    propagation on every publish, watchdog heartbeats + scanner, TSDB
+    scraping at a production-tight cadence, alert evaluation over the
+    default rule set — against all of it off. Interleaved off/on
+    pairs, median of per-pair deltas; the always-on contract is that
+    this delta stays inside host noise, with the isolated per-job cost
+    separately guarded at <= 0.5 ms in tests/test_telemetry.py."""
+    from downloader_tpu.utils import alerts as alerts_mod
+    from downloader_tpu.utils import tracing as tracing_mod
+    from downloader_tpu.utils import tsdb as tsdb_mod
+    from downloader_tpu.utils import watchdog as watchdog_mod
+
+    monitor = watchdog_mod.MONITOR
+
+    def run_arm(enabled: bool) -> float:
+        monitor.reset()
+        tsdb_mod.STORE.reset()
+        alerts_mod.ENGINE.reset()
+        tracing_mod.TRACER.clear()
+        tracing_mod.TRACER.enabled = enabled
+        tracing_mod.TRACER.propagate = enabled
+        if enabled:
+            monitor.configure(stall_s=60.0, action="log")
+            monitor.start()
+            tsdb_mod.STORE.configure(interval_s=1.0)
+            tsdb_mod.STORE.start()
+            alerts_mod.ENGINE.configure(
+                rules=alerts_mod.default_rules(),
+                interval_s=1.0,
+                store=tsdb_mod.STORE,
+            )
+            alerts_mod.ENGINE.start()
+        else:
+            monitor.stall_s = 0.0  # no-op watches on the hot path
+        pipeline = _Pipeline(
+            concurrency, concurrency, site, payload="tiny.bin"
+        )
+        try:
+            laps: list[float] = []
+            for i in range(samples):
+                start = time.monotonic()
+                pipeline.publish_job(i)
+                pipeline.wait_converts(i + 1, timeout=60.0)
+                laps.append((time.monotonic() - start) * 1000.0)
+        finally:
+            pipeline.close()
+            alerts_mod.ENGINE.reset()
+            tsdb_mod.STORE.reset()
+            monitor.reset()
+            monitor.stall_s = watchdog_mod.DEFAULT_STALL_S
+            tracing_mod.TRACER.enabled = True
+            tracing_mod.TRACER.propagate = True
+            tracing_mod.TRACER.clear()
+        laps.sort()
+        return laps[len(laps) // 2]
+
+    pairs = []
+    for _ in range(repeats):
+        off_ms = run_arm(False)
+        on_ms = run_arm(True)
+        pairs.append({"off_ms": round(off_ms, 2), "on_ms": round(on_ms, 2),
+                      "delta_ms": round(on_ms - off_ms, 3)})
+    deltas = sorted(p["delta_ms"] for p in pairs)
+    return {
+        "metric": "telemetry_overhead",
+        "unit": "ms",
+        "delta_ms": deltas[len(deltas) // 2],
+        "pairs": pairs,
+    }
+
+
 def main() -> None:
     jobs = int(os.environ.get("BENCH_JOBS", 24))
     mb_per_job = int(os.environ.get("BENCH_MB", 48))
@@ -1461,6 +1539,20 @@ def main() -> None:
                 f"{watchdog_ablation['delta_ms']:+.3f} ms/job"
             )
 
+        telemetry_ablation = None
+        if os.environ.get("BENCH_TELEMETRY", "1") != "0":
+            _log(
+                f"bench: telemetry-plane ablation, interleaved off/on "
+                f"pairs of {latency_samples} tiny jobs"
+            )
+            telemetry_ablation = run_telemetry_ablation(
+                site, latency_samples, concurrency
+            )
+            _log(
+                "bench: telemetry ablation median delta "
+                f"{telemetry_ablation['delta_ms']:+.3f} ms/job"
+            )
+
         extra_metrics = [
             {
                 "metric": "job_overhead_latency_ms",
@@ -1500,6 +1592,8 @@ def main() -> None:
             extra_metrics.append(overload)
         if watchdog_ablation is not None:
             extra_metrics.append(watchdog_ablation)
+        if telemetry_ablation is not None:
+            extra_metrics.append(telemetry_ablation)
         if os.environ.get("BENCH_DIGEST", "1") != "0":
             _log("bench: digest kernel micro-benchmark (pallas vs hashlib)")
             try:

@@ -83,6 +83,10 @@ def digest_line(report: dict) -> dict:
             )
             out["overload_shed_jobs"] = protected.get("shed_jobs")
             out["overload_protection_x"] = extra.get("protection_ratio")
+        elif metric == "watchdog_overhead":
+            out["watchdog_ms"] = extra.get("delta_ms")
+        elif metric == "telemetry_overhead":
+            out["telemetry_ms"] = extra.get("delta_ms")
         elif metric == "digest_kernel":
             out["hashlib_GBps"] = extra.get("hashlib_GBps")
             out["pallas_GBps"] = extra.get("pallas_GBps")

@@ -213,6 +213,29 @@ RUNTIME_PROTOCOLS: dict[str, dict] = {
             },
         ],
     },
+    "alert-episode": {
+        "module": "downloader_tpu.utils.alerts",
+        "methods": [
+            # a firing alert is an open obligation: every
+            # pending→firing transition must reach exactly one resolve
+            # (_exit_firing), whether through the rule's own clear
+            # streak or the engine's reset — a rule stuck "firing"
+            # forever with its condition gone is the alerting analogue
+            # of a leaked lock
+            {
+                "class": "AlertRule",
+                "name": "_enter_firing",
+                "kind": "acquire",
+                "key": "result",
+            },
+            {
+                "class": "AlertRule",
+                "name": "_exit_firing",
+                "kind": "release",
+                "key": "self",
+            },
+        ],
+    },
     "multipart-upload": {
         "module": "downloader_tpu.store.s3",
         "methods": [

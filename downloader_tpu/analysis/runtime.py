@@ -3,7 +3,7 @@
 
 ``LockOrderRecorder`` is the lock-order half (below).
 ``ProtocolRecorder`` is the protocol typestate half: it patches the
-acquire/release methods of the six declared lifecycle protocols (the
+acquire/release methods of the declared lifecycle protocols (the
 ``protocols.RUNTIME_PROTOCOLS`` table — same vocabulary the static
 rule reads from the ``# protocol:`` annotations) and tracks every
 still-open obligation, so a test suite can assert at teardown that

@@ -153,8 +153,12 @@ class Daemon:
         # publish → ack, rooted here; backend internals (tracker
         # announces, peer connects, webseed ranges, multipart parts)
         # attach as descendants. Lands on /debug/jobs and feeds the
-        # per-stage latency histograms on completion.
-        with tracing.TRACER.job() as trace:
+        # per-stage latency histograms on completion. The trace adopts
+        # the delivery's propagated X-Trace-Context, so a redelivered
+        # attempt continues its logical job's ONE trace id.
+        with tracing.TRACER.job(
+            context=getattr(delivery, "trace_context", None)
+        ) as trace:
             trace.record(
                 "dequeue", delivery.received_at, started,
                 queue=delivery.queue_name,
@@ -628,7 +632,9 @@ class Daemon:
         when the job was settled here — the failure paths mirror
         ``_process_watched``'s semantics exactly."""
         started = time.monotonic()
-        trace = tracing.TRACER.open_job(media.id)
+        trace = tracing.TRACER.open_job(
+            media.id, context=getattr(delivery, "trace_context", None)
+        )
         job_token = self._token.child()
         watch = watchdog.MONITOR.job(media.id, cancel=job_token.cancel)
         job_class = delivery.job_class or self._config.admission_default_class
@@ -969,12 +975,16 @@ class Daemon:
             ).warning("shed hand-off unconfirmed; job requeued instead")
             return
         if admission.CONTROLLER.note_shed(delivery.tenant, reason):
+            context = getattr(delivery, "trace_context", None)
             extra = {
                 "tenant": delivery.tenant,
                 "job_class": delivery.job_class,
                 "shed_reason": reason,
                 "tripped_budget": admission.LEDGER.tripped(),
                 "pressure": round(admission.LEDGER.pressure(), 4),
+                # the shed job's logical identity: the incident bundle
+                # and the DLQ message it describes share this id
+                "trace_id": context.trace_id if context else None,
             }
 
             def _capture():
@@ -1177,6 +1187,32 @@ def serve(
 
     tracing.TRACER.enabled = config.trace
     tracing.TRACER.set_capacity(config.trace_ring)
+    tracing.TRACER.propagate = config.trace_propagate
+
+    # telemetry plane: the local time-series store samples the registry
+    # on an interval, and the alert engine evaluates burn-rate/threshold
+    # rules over it — both liveness-watched loops, both off when their
+    # interval is 0
+    from ..utils import alerts, tsdb
+
+    metrics.FEDERATION.instance = config.instance
+    tsdb.STORE.configure(
+        interval_s=config.tsdb_interval,
+        samples=config.tsdb_samples,
+        downsample=config.tsdb_downsample,
+    )
+    alerts.ENGINE.configure(
+        rules=alerts.default_rules(
+            slo_interactive_s=config.alert_slo_interactive_s,
+            slo_bulk_s=config.alert_slo_bulk_s,
+            objective=config.alert_objective,
+            fast_window_s=config.alert_fast_window,
+            slow_window_s=config.alert_slow_window,
+            factor=config.alert_burn_factor,
+        ),
+        interval_s=config.alert_interval,
+        store=tsdb.STORE,
+    )
 
     # stall watchdog + incident flight recorder: stages report progress
     # heartbeats; a job whose active stage stops advancing for
@@ -1192,6 +1228,8 @@ def serve(
         on_stall=capture_stall_incident,
     )
     watchdog.MONITOR.start()
+    tsdb.STORE.start()
+    alerts.ENGINE.start()
 
     token = token or CancelToken()
     if install_signal_handlers:
@@ -1253,6 +1291,8 @@ def serve(
     try:
         daemon.run()
     finally:
+        alerts.ENGINE.stop()
+        tsdb.STORE.stop()
         watchdog.MONITOR.stop()
         if health is not None:
             health.stop()

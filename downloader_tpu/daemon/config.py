@@ -52,6 +52,23 @@ class Config:
     health_host: str = "127.0.0.1"  # bind loopback unless told otherwise
     trace: bool = True  # per-job span tracing (TRACE=off disables)
     trace_ring: int = 64  # completed span trees kept for /debug/jobs
+    # telemetry plane (utils/{tracing,tsdb,alerts}.py): trace-context
+    # propagation across queue hops, the local time-series store the
+    # burn-rate rules evaluate over, and the alert engine's cadence +
+    # SLO parameters. instance is this worker's label in federated
+    # scrapes (/metrics/federate).
+    trace_propagate: bool = True
+    tsdb_interval: float = 10.0
+    tsdb_samples: int = 360
+    tsdb_downsample: int = 10
+    alert_interval: float = 15.0
+    alert_fast_window: float = 300.0
+    alert_slow_window: float = 3600.0
+    alert_burn_factor: float = 14.4
+    alert_objective: float = 0.99
+    alert_slo_interactive_s: float = 1.0
+    alert_slo_bulk_s: float = 60.0
+    instance: str = ""
     # segmented HTTP fetch (fetch/segments.py): max concurrent ranges
     # per object (1 = single-stream only) and the per-host keep-alive
     # pool bounds (fetch/connpool.py)
@@ -154,6 +171,24 @@ class Config:
         config.trace_ring = ring_from_value(
             env.get("TRACE_RING"), config.trace_ring
         )
+        from ..utils import alerts, metrics, tsdb
+        from ..utils.tracing import propagate_from_env
+
+        config.trace_propagate = propagate_from_env(env)
+        config.tsdb_interval = tsdb.interval_from_env(env)
+        config.tsdb_samples = tsdb.samples_from_env(env)
+        config.tsdb_downsample = tsdb.downsample_from_env(env)
+        config.alert_interval = alerts.interval_from_env(env)
+        config.alert_fast_window, config.alert_slow_window = (
+            alerts.windows_from_env(env)
+        )
+        config.alert_burn_factor = alerts.burn_factor_from_env(env)
+        config.alert_objective = alerts.objective_from_env(env)
+        (
+            config.alert_slo_interactive_s,
+            config.alert_slo_bulk_s,
+        ) = alerts.slo_targets_from_env(env)
+        config.instance = metrics.instance_from_env(env)
         from ..fetch.connpool import (
             pool_idle_from_env,
             pool_per_host_from_env,

@@ -24,6 +24,15 @@ server exposes:
 - ``GET /debug/incidents`` — captured incident bundles
   (utils/incident.py); ``/debug/incidents/<id>`` serves one bundle.
   ``POST /debug/incident`` captures a bundle on demand.
+- ``GET /debug/tsdb`` — the local time-series store (utils/tsdb.py):
+  store snapshot, or ``?name=&window=`` for one series' windowed
+  points, counter rates, and histogram quantile estimates.
+- ``GET /debug/alerts`` — the alert engine's rules, states, and recent
+  transitions (utils/alerts.py). ``GET /debug/trace?trace_id=`` links
+  every attempt of one logical job into a single lineage view.
+- ``GET /metrics/federate`` — this worker's exposition merged with
+  every registered child-worker source, per-sample ``instance``
+  labels (the fleet-aggregation groundwork for ROADMAP item 1).
 
 The server is a ``ThreadingHTTPServer`` (daemon threads) on purpose: a
 slow ``/debug/trace`` serialization or a fat incident bundle must
@@ -39,9 +48,14 @@ from __future__ import annotations
 
 import http.server
 import json
+import re
 import threading
+import urllib.parse
 
-from ..utils import admission, get_logger, incident, metrics, tracing, watchdog
+from ..utils import (
+    admission, alerts, get_logger, incident, metrics, tracing, tsdb,
+    watchdog,
+)
 from ..utils.logging import ring_tail
 
 log = get_logger("daemon.health")
@@ -59,25 +73,34 @@ class HealthServer:
 
             def do_GET(self):
                 try:
-                    if self.path == "/healthz":
+                    parsed = urllib.parse.urlsplit(self.path)
+                    path = parsed.path
+                    query = urllib.parse.parse_qs(parsed.query)
+                    if path == "/healthz":
                         code, body, ctype = health._healthz()
-                    elif self.path == "/metrics":
+                    elif path == "/metrics":
                         code, body, ctype = health._metrics()
-                    elif self.path == "/debug/jobs":
+                    elif path == "/metrics/federate":
+                        code, body, ctype = health._metrics_federate()
+                    elif path == "/debug/jobs":
                         code, body, ctype = health._debug_jobs()
-                    elif self.path == "/debug/trace":
-                        code, body, ctype = health._debug_trace()
-                    elif self.path == "/debug/watchdog":
+                    elif path == "/debug/trace":
+                        code, body, ctype = health._debug_trace(query)
+                    elif path == "/debug/tsdb":
+                        code, body, ctype = health._debug_tsdb(query)
+                    elif path == "/debug/alerts":
+                        code, body, ctype = health._debug_alerts()
+                    elif path == "/debug/watchdog":
                         code, body, ctype = health._debug_watchdog()
-                    elif self.path == "/debug/admission":
+                    elif path == "/debug/admission":
                         code, body, ctype = health._debug_admission()
-                    elif self.path == "/debug/logs":
+                    elif path == "/debug/logs":
                         code, body, ctype = health._debug_logs()
-                    elif self.path == "/debug/incidents":
+                    elif path == "/debug/incidents":
                         code, body, ctype = health._debug_incidents()
-                    elif self.path.startswith("/debug/incidents/"):
+                    elif path.startswith("/debug/incidents/"):
                         code, body, ctype = health._debug_incident(
-                            self.path[len("/debug/incidents/"):]
+                            path[len("/debug/incidents/"):]
                         )
                     else:
                         code, body, ctype = 404, b"not found\n", "text/plain"
@@ -173,10 +196,59 @@ class HealthServer:
             "application/json",
         )
 
-    def _debug_trace(self) -> tuple[int, bytes, str]:
+    def _debug_trace(self, query: dict | None = None) -> tuple[int, bytes, str]:
+        # ?trace_id= serves the cross-attempt lineage view: every
+        # attempt of one logical job (propagated X-Trace-Context),
+        # ordered, each with its parent-span back-link — the linked
+        # tree a retried/shed job's post-mortem walks. Without it the
+        # Chrome export groups attempts under per-trace-id pids.
+        trace_id = (query or {}).get("trace_id", [""])[0]
+        if trace_id:
+            attempts = tracing.TRACER.lineage(trace_id)
+            payload = {"trace_id": trace_id, "attempts": attempts}
+            return (
+                200,
+                (json.dumps(payload, indent=1) + "\n").encode(),
+                "application/json",
+            )
         return (
             200,
             (json.dumps(tracing.TRACER.chrome_trace()) + "\n").encode(),
+            "application/json",
+        )
+
+    def _debug_tsdb(self, query: dict | None = None) -> tuple[int, bytes, str]:
+        """The local time-series store: without ``name``, the store
+        snapshot (what series exist, cadence, depth); with ``name`` (+
+        optional ``window`` seconds), that series' in-window points and
+        derived rate/quantiles."""
+        query = query or {}
+        name = query.get("name", [""])[0]
+        if not name:
+            payload = tsdb.STORE.snapshot()
+            return (
+                200,
+                (json.dumps(payload, indent=1) + "\n").encode(),
+                "application/json",
+            )
+        try:
+            window = float(query.get("window", ["300"])[0])
+        except ValueError:
+            window = 300.0
+        payload = tsdb.STORE.query(name, max(1.0, window))
+        if payload is None:
+            return 404, b"no such series\n", "text/plain"
+        return (
+            200,
+            (json.dumps(payload, indent=1) + "\n").encode(),
+            "application/json",
+        )
+
+    def _debug_alerts(self) -> tuple[int, bytes, str]:
+        payload = alerts.ENGINE.snapshot()
+        return (
+            200,
+            (json.dumps(payload, indent=1) + "\n").encode(),
             "application/json",
         )
 
@@ -261,6 +333,12 @@ class HealthServer:
         gauges = {
             "torrent_active_swarms": 0.0,
             "torrent_active_peers": 0.0,
+            # telemetry-plane levels, present from the first scrape so
+            # alert expressions and dashboards never see a gap: the
+            # publisher gauge goes live when the queue client builds
+            # its publisher; alerts_firing when the engine evaluates
+            "alerts_firing": 0.0,
+            "queue_publisher_alive": 0.0,
             **metrics.GLOBAL.gauges(),
         }
         for name, value in sorted(gauges.items()):
@@ -310,5 +388,74 @@ class HealthServer:
             lines.append(f'{metric}_bucket{{le="+Inf"}} {count}')
             lines.append(f"{metric}_sum {total:.6f}")
             lines.append(f"{metric}_count {count}")
+        body = ("\n".join(lines) + "\n").encode()
+        return 200, body, "text/plain; version=0.0.4"
+
+    # one exposition sample line: name, optional {labels}, value. The
+    # label body is parsed quote-aware — label VALUES may legally
+    # contain '}' (path templates, regexes), so a naive [^}]* would
+    # drop those samples from the merge as "malformed"
+    _SAMPLE_RE = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+        r'(\{(?:[^"}]|"(?:[^"\\]|\\.)*")*\})? (.+)$'
+    )
+
+    def _metrics_federate(self) -> tuple[int, bytes, str]:
+        """ROADMAP item 1's "one /metrics scrape, per-worker labels":
+        this worker's exposition plus every registered child-worker
+        source (metrics.FEDERATION), each sample tagged with its
+        ``instance`` label. Family HELP/TYPE metadata is declared once
+        (first worker wins); a failing child source costs its samples
+        and a counter bump, never the scrape."""
+        _, own_body, _ = self._metrics()
+        instance = metrics.FEDERATION.instance or "worker-0"
+        lines: list[str] = []
+        declared: set[tuple[str, str]] = set()
+
+        def fold(text: str, inst: str) -> None:
+            # label values are quoted strings in the exposition format:
+            # an instance like us-"east" must escape, not break parsing
+            escaped = inst.replace("\\", "\\\\").replace('"', '\\"')
+            for line in text.splitlines():
+                if not line.strip():
+                    continue
+                if line.startswith("#"):
+                    parts = line.split(" ", 3)
+                    if len(parts) >= 3:
+                        key = (parts[1], parts[2])
+                        if key in declared:
+                            continue
+                        declared.add(key)
+                    lines.append(line)
+                    continue
+                match = self._SAMPLE_RE.match(line)
+                if match is None:
+                    continue  # a malformed child line never poisons ours
+                name, labels, value = match.groups()
+                inner = (labels or "{}")[1:-1]
+                if inner.startswith('instance="') or ',instance="' in inner:
+                    # the source already tagged its samples (a child
+                    # that is itself federating): keep its labels —
+                    # duplicating the label name is a hard parse error.
+                    # Anchored match: a label NAMED xyz_instance must
+                    # not suppress the tagging
+                    lines.append(line)
+                    continue
+                tag = f'instance="{escaped}"'
+                inner = tag if not inner else f"{tag},{inner}"
+                lines.append(f"{name}{{{inner}}} {value}")
+
+        fold(own_body.decode(), instance)
+        for inst, fetch in sorted(metrics.FEDERATION.sources().items()):
+            try:
+                text = fetch()
+            except Exception as exc:
+                metrics.GLOBAL.add("federate_source_errors")
+                log.with_fields(instance=inst).warning(
+                    f"federate source scrape failed: {exc}"
+                )
+                continue
+            fold(text, inst)
+        metrics.GLOBAL.add("federate_scrapes")
         body = ("\n".join(lines) + "\n").encode()
         return 200, body, "text/plain; version=0.0.4"

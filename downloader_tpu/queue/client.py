@@ -38,7 +38,7 @@ import time
 from dataclasses import dataclass, field
 
 from ..utils import get_logger, metrics
-from ..utils import incident, watchdog
+from ..utils import incident, tracing, watchdog
 from ..utils.cancel import CancelToken
 from .broker import BrokerError, Channel, Connection, ConnectionFactory, Message
 from .delivery import Delivery
@@ -129,6 +129,11 @@ class QueueClient:
         self._reconcile_lock = threading.Lock()
         self._done = threading.Event()
         self.stats = ClientStats()
+        # seed the liveness gauge DOWN before the first connect: the
+        # alert engine reads the registry, and a publisher that never
+        # comes up (broker unreachable from the start) must read as
+        # dead — an absent series is "no data", which never pages
+        metrics.GLOBAL.gauge_set("queue_publisher_alive", 0)
         # incident-bundle introspection (utils/incident.py): buffer
         # depth + settlement state is exactly what a wedged-publisher
         # post-mortem needs. WeakMethod-held; expires with the client.
@@ -342,8 +347,17 @@ class QueueClient:
             raise ValueError(
                 "publishing to the default exchange requires routing_key"
             )
+        headers = dict(headers) if headers else {}
+        # trace-context propagation (TRACE_PROPAGATE): every publish
+        # from inside a job trace — the Convert hand-off above all —
+        # carries the logical job's X-Trace-Context, so the downstream
+        # consumer (or the next attempt) keeps ONE trace id. Retry/shed
+        # paths stamp their own header first; setdefault respects it.
+        context = tracing.outbound_header()
+        if context is not None:
+            headers.setdefault(tracing.TRACE_CONTEXT_HEADER, context)
         pending = _PendingPublish(
-            topic=topic, body=body, headers=headers or {}, routing_key=routing_key
+            topic=topic, body=body, headers=headers, routing_key=routing_key
         )
         with self._lock:
             self._publishes_pending += 1
@@ -490,6 +504,15 @@ class QueueClient:
             with self._lock:
                 self._publisher_channel = channel
                 self._publisher_alive = True
+                # liveness as a first-class series: the alert engine's
+                # publisher-liveness rule watches this gauge, closing
+                # the PR 4 wedged-publisher class's detection loop.
+                # Written UNDER the lock (a cheap leaf-lock set) so the
+                # gauge ordering always matches the state transitions —
+                # a crashed generation's late 0 must not land after the
+                # supervisor's rebuild wrote 1 and stick a false
+                # publisher-dead page until the next reconnect
+                metrics.GLOBAL.gauge_set("queue_publisher_alive", 1)
             threading.Thread(
                 target=self._publish_loop,
                 args=(channel,),
@@ -522,6 +545,7 @@ class QueueClient:
             self._publisher_channel = None
             self._publisher_alive = False
             self._ensured_topics.clear()
+            metrics.GLOBAL.gauge_set("queue_publisher_alive", 0)
         for shard in shards:
             if shard.channel is not None:
                 try:
@@ -632,6 +656,7 @@ class QueueClient:
                 if self._publisher_channel is my_channel:
                     self._publisher_alive = False
                     self._publisher_channel = None
+                    metrics.GLOBAL.gauge_set("queue_publisher_alive", 0)
             try:
                 my_channel.close()
             except BrokerError:
@@ -706,6 +731,7 @@ class QueueClient:
             if self._publisher_channel is my_channel:
                 self._publisher_alive = False
                 self._publisher_channel = None
+                metrics.GLOBAL.gauge_set("queue_publisher_alive", 0)
         try:
             my_channel.close()
         except BrokerError:
@@ -752,6 +778,7 @@ class QueueClient:
             if self._publisher_channel is my_channel:
                 self._publisher_alive = False
                 self._publisher_channel = None
+                metrics.GLOBAL.gauge_set("queue_publisher_alive", 0)
         try:
             my_channel.close()
         except BrokerError:

@@ -23,7 +23,7 @@ import time
 from typing import Callable
 
 from ..fetch.sources import parse_mirror_list
-from ..utils import admission, get_logger, metrics
+from ..utils import admission, get_logger, metrics, tracing
 from .broker import BrokerError, Channel, Message
 
 log = get_logger("queue")
@@ -156,6 +156,14 @@ class Delivery:
         self.mirrors = parse_mirror_list(
             message.headers.get(MIRRORS_HEADER)
         )
+        # the logical job's trace identity: adopted from the propagated
+        # X-Trace-Context when a prior attempt (or the producer)
+        # stamped one, minted fresh otherwise — so even a job that is
+        # shed before any trace opens (the admission path) has ONE id
+        # its DLQ message and incident bundle can share
+        self.trace_context = tracing.TraceContext.parse(
+            message.headers.get(tracing.TRACE_CONTEXT_HEADER)
+        ) or tracing.TraceContext.mint()
         self._channel = channel
         self._on_settled = on_settled
         self._publisher = publisher
@@ -184,6 +192,16 @@ class Delivery:
         except Exception as exc:
             # a broken release hook must not poison the settle path
             log.warning(f"delivery settle hook raised: {exc}")
+
+    def _stamp_trace_context(self, headers: dict) -> None:
+        """Carry the logical job's trace id onto a republish (retry or
+        DLQ shed): the active job trace when this thread is inside one
+        (real parent-span linkage), else this delivery's inbound/minted
+        context advanced one attempt. TRACE_PROPAGATE=off stamps
+        nothing — each attempt then traces fresh, as before."""
+        value = tracing.outbound_header(fallback=self.trace_context)
+        if value is not None:
+            headers[tracing.TRACE_CONTEXT_HEADER] = value
 
     def _settle(self) -> bool:  # protocol: delivery-settle release
         with self._lock:
@@ -234,6 +252,7 @@ class Delivery:
             return
         headers = dict(self.message.headers)
         headers[RETRY_HEADER] = self.retries + 1
+        self._stamp_trace_context(headers)
         try:
             if self._publisher is not None:
                 # Messages consumed off the default exchange ("") carry the
@@ -304,6 +323,7 @@ class Delivery:
         headers = dict(self.message.headers)
         new_count = self.shed_count + 1
         headers[SHED_HEADER] = new_count
+        self._stamp_trace_context(headers)
         headers[RETRY_AFTER_HEADER] = max(0, int(retry_after))
         headers[SHED_REASON_HEADER] = str(reason)[:200]
         dead = new_count > max_sheds

@@ -298,7 +298,18 @@ class MemoryChannel:
 
     def set_prefetch(self, count: int) -> None:
         self._check()
+        previous = self.prefetch
         self.prefetch = count
+        # a GROWN window makes parked backlog deliverable right now —
+        # pump, as a real broker does after basic.qos raises the
+        # window. Without this, a live-qos widen (the admission
+        # ladder's parked-population stretch) only takes effect at the
+        # next publish/ack event, which on an otherwise-idle queue may
+        # never come: the window ratchet deadlocks with backlog queued
+        # behind a too-small window (exposed by the telemetry plane's
+        # per-delivery work shifting the flood/shrink interleaving).
+        if count == 0 or (previous != 0 and count > previous):
+            self._broker._pump()
 
     def confirm_select(self) -> None:
         self._check()

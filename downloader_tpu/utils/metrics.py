@@ -151,6 +151,16 @@ HELP = {
         "peer sources retired for their job (connection end, repeated or "
         "deterministic failures)"
     ),
+    "queue_publisher_alive": (
+        "whether the buffered-publisher thread is up (1) or down (0)"
+    ),
+    "alerts_firing": "alert rules currently in the firing state",
+    "alerts_fired": "pending->firing alert transitions",
+    "tsdb_scrapes": "registry scrapes taken into the local time-series store",
+    "federate_scrapes": "merged /metrics/federate renders served",
+    "federate_source_errors": (
+        "child-worker scrape sources that failed during a federate render"
+    ),
     "watchdog_stalls": "stall episodes flagged (no forward progress)",
     "watchdog_cancels": "stalled jobs cancelled (WATCHDOG_ACTION=cancel)",
     "watchdog_stalled_tasks": "watched tasks currently flagged as stalled",
@@ -165,6 +175,57 @@ def help_text(name: str) -> str:
     """HELP line body for series ``name``: catalogued text, else a
     derived one so the exposition stays well-formed for every family."""
     return HELP.get(name, f"{name.replace('_', ' ')} (downloader)")
+
+
+def instance_from_env(environ=None) -> str:
+    """``WORKER_INSTANCE``: this worker's identity in the ``instance``
+    label dimension — what a federated scrape tags each sample with so
+    one ``/metrics/federate`` read distinguishes fleet members. Empty
+    (the default) renders as ``worker-0``."""
+    import os
+
+    env = os.environ if environ is None else environ
+    return (env.get("WORKER_INSTANCE") or "").strip()
+
+
+class Federation:
+    """The fleet-aggregation half of ROADMAP item 1's "one /metrics
+    scrape, per-worker labels": child workers (or a supervisor's
+    per-process scrapers) register a named source — a callable
+    returning a Prometheus exposition body — and the health server's
+    ``/metrics/federate`` merges every source's samples under its
+    ``instance`` label. Sources are plain callables so a future
+    supervisor can hand in HTTP fetchers without this module learning
+    about sockets."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._sources: "dict[str, object]" = {}  # guarded-by: _lock
+        self.instance = ""  # this process's own label value
+
+    def register_source(self, instance: str, fetch) -> None:
+        """``fetch() -> str`` must return exposition text; it is
+        called on every federate render and its failures are counted,
+        never fatal."""
+        with self._lock:
+            self._sources[instance] = fetch
+
+    def unregister_source(self, instance: str) -> None:
+        with self._lock:
+            self._sources.pop(instance, None)
+
+    def sources(self) -> "dict[str, object]":
+        with self._lock:
+            return dict(self._sources)
+
+    def reset(self) -> None:
+        """Test isolation only."""
+        with self._lock:
+            self._sources.clear()
+        self.instance = ""
+
+
+FEDERATION = Federation()
 
 
 class Counters:
@@ -189,6 +250,20 @@ class Counters:
     def gauge_set(self, name: str, value: float) -> None:
         with self._lock:
             self._gauges[name] = value
+
+    def ensure_histogram(
+        self,
+        name: str,
+        buckets: tuple[float, ...] = LATENCY_BUCKETS,
+    ) -> None:
+        """Register ``name`` as a zeroed histogram if absent — for
+        series that must EXIST from the first scrape (the TSDB records
+        only families the registry has; a burn-rate window needs a
+        true zero baseline, not a first sample that already carries
+        the whole burst)."""
+        with self._lock:
+            if name not in self._hists:
+                self._hists[name] = (buckets, [0] * len(buckets), 0.0, 0)
 
     def observe(
         self,

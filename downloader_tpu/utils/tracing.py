@@ -40,6 +40,8 @@ Three consumers:
 
 from __future__ import annotations
 
+import os
+import random
 import threading
 import time
 from collections import deque
@@ -48,6 +50,107 @@ from . import metrics
 from .logging import get_logger
 
 log = get_logger("tracing")
+
+# the W3C-traceparent-style correlation header riding queue messages:
+# one logical job keeps ONE trace id across Convert hand-offs, retry
+# republishes, DLQ sheds, and process hops
+TRACE_CONTEXT_HEADER = "X-Trace-Context"
+
+# id generation: trace/span ids need global UNIQUENESS, not secrecy —
+# and they are minted once per DELIVERY on the broker's inline pump
+# path, where an os.urandom getrandom(2) syscall measures tens of µs
+# with multi-ms spikes under this environment's syscall interposition
+# (enough to blow the batched-lane overhead guard). One urandom seed
+# at import, then a Mersenne Twister per id: ~100 ns, no syscalls.
+_rng = random.Random(os.urandom(16))
+_rng_lock = threading.Lock()
+
+
+def _new_id(bits: int) -> str:
+    # getrandbits on a shared Random is not documented thread-safe; a
+    # torn state could mint colliding ids, so take the (uncontended,
+    # nanoseconds-scale) lock
+    with _rng_lock:
+        value = _rng.getrandbits(bits)
+    return f"{value:0{bits // 4}x}"
+
+
+def propagate_from_env(environ=None) -> bool:
+    """``TRACE_PROPAGATE``: stamp ``X-Trace-Context`` on outbound
+    publishes (Convert hand-offs, retry republishes, DLQ sheds) so a
+    redelivered or handed-off job keeps its trace id. Default on;
+    ``off`` reverts to a fresh trace per attempt."""
+    from . import flag_from_env
+
+    return flag_from_env("TRACE_PROPAGATE", environ)
+
+
+class TraceContext:
+    """Parsed ``X-Trace-Context``: the trace id a logical job keeps for
+    life, the span id of the attempt that published this message (the
+    cross-attempt parent link), and how many publishes preceded this
+    one. Wire format is traceparent-shaped: ``<32 hex trace id>-<16
+    hex parent span id>-<attempt>``, with an all-zero span id meaning
+    "no parent" (the producer stamped nothing; the first consumer
+    minted the id)."""
+
+    __slots__ = ("trace_id", "parent_span_id", "attempt")
+
+    _NO_PARENT = "0" * 16
+
+    def __init__(
+        self, trace_id: str, parent_span_id: str = "", attempt: int = 0
+    ):
+        self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
+        self.attempt = attempt
+
+    @classmethod
+    def mint(cls) -> "TraceContext":
+        """A fresh logical-job identity (no parent, attempt 0) — what a
+        delivery gets when the producer stamped nothing."""
+        return cls(_new_id(128), "", 0)
+
+    @classmethod
+    def parse(cls, raw) -> "TraceContext | None":
+        """Tolerant header parse: None/garbage degrade to None (the
+        consumer mints a fresh identity), never to a dropped job."""
+        if not isinstance(raw, (str, bytes)):
+            return None
+        if isinstance(raw, bytes):
+            try:
+                raw = raw.decode("ascii")
+            except UnicodeDecodeError:
+                return None
+        parts = raw.strip().split("-")
+        if len(parts) != 3:
+            return None
+        trace_id, parent, attempt_raw = parts
+        try:
+            int(trace_id, 16)
+            int(parent, 16)
+            attempt = int(attempt_raw)
+        except ValueError:
+            return None
+        if len(trace_id) != 32 or len(parent) != 16 or attempt < 0:
+            return None
+        if parent == cls._NO_PARENT:
+            parent = ""
+        return cls(trace_id, parent, attempt)
+
+    def header_value(self) -> str:
+        return (
+            f"{self.trace_id}-"
+            f"{self.parent_span_id or self._NO_PARENT}-{self.attempt}"
+        )
+
+    def next_attempt(self, parent_span_id: str = "") -> "TraceContext":
+        """The context an outbound republish carries: same trace id,
+        this attempt's root span as the parent link, attempt + 1."""
+        return TraceContext(
+            self.trace_id, parent_span_id or self.parent_span_id,
+            self.attempt + 1,
+        )
 
 
 def ring_from_value(raw: str | None, default: int) -> int:
@@ -230,18 +333,38 @@ NOOP = _NoopSpan()
 
 
 class Trace:
-    """One job's span tree plus the wall-clock anchor for export."""
+    """One job's span tree plus the wall-clock anchor for export.
+
+    A trace additionally carries the job's LOGICAL identity: a trace
+    id that survives redeliveries and process hops (adopted from the
+    delivery's ``X-Trace-Context`` when one rode in, minted here
+    otherwise), this attempt's own span id (what the next attempt's
+    parent link names), the attempt ordinal, and the parent attempt's
+    span id — enough for ``/debug/trace`` to stitch every attempt of
+    one logical job into a single cross-attempt tree."""
 
     __slots__ = (
         "job_id", "root", "wall_start", "seq", "status",
+        "trace_id", "span_id", "parent_span_id", "attempt",
         "_lock", "_span_count", "dropped_spans",
     )
 
-    def __init__(self, job_id: str, seq: int):
+    def __init__(
+        self, job_id: str, seq: int, context: TraceContext | None = None
+    ):
         self.job_id = job_id
         self.seq = seq
         self.wall_start = time.time()
         self.status = "in-flight"
+        if context is not None:
+            self.trace_id = context.trace_id
+            self.parent_span_id = context.parent_span_id
+            self.attempt = context.attempt
+        else:
+            self.trace_id = _new_id(128)
+            self.parent_span_id = ""
+            self.attempt = 0
+        self.span_id = _new_id(64)
         self._lock = threading.Lock()
         self._span_count = 1  # guarded-by: _lock
         self.dropped_spans = 0  # guarded-by: _lock
@@ -280,8 +403,13 @@ class Trace:
                 "job_id": self.job_id,
                 "status": self.status,
                 "wall_start": self.wall_start,
+                "trace_id": self.trace_id,
+                "span_id": self.span_id,
+                "attempt": self.attempt,
                 "spans": self.root.to_dict(self.root.start),
             }
+            if self.parent_span_id:
+                entry["parent_span_id"] = self.parent_span_id
             if self.dropped_spans:
                 entry["dropped_spans"] = self.dropped_spans
         return entry
@@ -294,6 +422,9 @@ class Tracer:
 
     def __init__(self, capacity: int = DEFAULT_RING, enabled: bool = True):
         self.enabled = enabled
+        # gate for OUTBOUND context stamping (TRACE_PROPAGATE): parsing
+        # an inbound header stays on either way — adoption is free
+        self.propagate = True
         self._lock = threading.Lock()
         self._ring: "deque[Trace]" = deque(maxlen=capacity)  # guarded-by: _lock
         self._in_flight: dict[int, Trace] = {}  # guarded-by: _lock
@@ -305,31 +436,39 @@ class Tracer:
 
     # -- job lifecycle ---------------------------------------------------
 
-    def job(self, job_id: str = "") -> Span:
+    def job(
+        self, job_id: str = "", context: TraceContext | None = None
+    ) -> Span:
         """Open a job trace rooted on the calling thread. Use as a
         context manager; on exit the trace completes, lands in the ring,
-        and its stage durations feed the metrics histograms."""
+        and its stage durations feed the metrics histograms. With
+        ``context`` (a delivery's propagated ``X-Trace-Context``) the
+        trace adopts the logical job's trace id and attempt ordinal
+        instead of minting fresh ones, so redeliveries stay ONE trace."""
         if not self.enabled:
             return NOOP  # type: ignore[return-value]
         with self._lock:
             self._seq += 1
-            trace = Trace(job_id, self._seq)
+            trace = Trace(job_id, self._seq, context)
             self._in_flight[trace.seq] = trace
         trace.root.meta = {"job_id": job_id} if job_id else None
         return _RootCM(self, trace)  # type: ignore[return-value]
 
-    def open_job(self, job_id: str = "") -> "OpenTrace":  # protocol: tracer-trace acquire
+    def open_job(  # protocol: tracer-trace acquire
+        self, job_id: str = "", context: TraceContext | None = None
+    ) -> "OpenTrace":
         """A manually driven job trace for work whose lifecycle cannot
         be one ``with`` block — the batched fast path records each
         job's phases inside ``activate()`` blocks on the worker thread,
         keeps the trace open across the batch's coalesced confirm/ack,
         then settles it with ``complete()``. Disabled tracing hands out
-        the shared no-op instance."""
+        the shared no-op instance. ``context`` adopts a propagated
+        identity exactly as in ``job()``."""
         if not self.enabled:
             return NOOP_OPEN_TRACE
         with self._lock:
             self._seq += 1
-            trace = Trace(job_id, self._seq)
+            trace = Trace(job_id, self._seq, context)
             self._in_flight[trace.seq] = trace
         trace.root.meta = {"job_id": job_id} if job_id else None
         return OpenTrace(self, trace)
@@ -377,6 +516,16 @@ class Tracer:
         with self._lock:
             return self._ring[-1] if self._ring else None
 
+    def lineage(self, trace_id: str) -> list[dict]:
+        """Every attempt of one logical job — completed ring entries
+        plus in-flight trees sharing ``trace_id`` — ordered by attempt
+        then arrival. The cross-attempt view /debug/trace links by."""
+        with self._lock:
+            candidates = list(self._ring) + list(self._in_flight.values())
+        attempts = [t for t in candidates if t.trace_id == trace_id]
+        attempts.sort(key=lambda t: (t.attempt, t.seq))
+        return [t.to_dict() for t in attempts]
+
     def find(self, job_id: str) -> dict | None:
         """The newest trace for ``job_id`` — in-flight first (a stalled
         job is by definition still in flight; a retried job also has a
@@ -404,13 +553,28 @@ class Tracer:
 
     def chrome_trace(self) -> dict:
         """The ring (plus any in-flight trees) as Chrome trace-event
-        JSON: one ``pid`` for the process, one ``tid`` lane per job,
-        complete ("X") events in microseconds. Loadable in
-        chrome://tracing and Perfetto."""
+        JSON: one ``pid`` per LOGICAL job (all attempts sharing a
+        propagated trace id group under it, named by the id), one
+        ``tid`` lane per attempt, complete ("X") events in
+        microseconds. Loadable in chrome://tracing and Perfetto —
+        a retried job reads as one process whose attempt lanes line up
+        on a shared timeline instead of N unrelated traces."""
         events: list[dict] = []
         with self._lock:
             traces = list(self._ring) + list(self._in_flight.values())
+        pids: dict[str, int] = {}
         for trace in traces:
+            pid = pids.get(trace.trace_id)
+            if pid is None:
+                pid = pids[trace.trace_id] = len(pids) + 1
+                events.append(
+                    {
+                        "name": "process_name",
+                        "ph": "M",
+                        "pid": pid,
+                        "args": {"name": f"trace {trace.trace_id}"},
+                    }
+                )
             # anchor monotonic offsets to the trace's wall start so
             # lanes from different jobs line up on one timeline
             base_us = trace.wall_start * 1e6
@@ -422,13 +586,20 @@ class Tracer:
                     "ph": "X",
                     "ts": round(base_us + (span.start - t0) * 1e6, 1),
                     "dur": round(span.duration * 1e6, 1),
-                    "pid": 1,
+                    "pid": pid,
                     "tid": trace.seq,
                 }
                 args = dict(span.meta) if span.meta else {}
                 if span is trace.root:
                     args.setdefault("job_id", trace.job_id)
                     args.setdefault("status", trace.status)
+                    args.setdefault("trace_id", trace.trace_id)
+                    args.setdefault("span_id", trace.span_id)
+                    args.setdefault("attempt", trace.attempt)
+                    if trace.parent_span_id:
+                        args.setdefault(
+                            "parent_span_id", trace.parent_span_id
+                        )
                 if args:
                     event["args"] = args
                 events.append(event)
@@ -441,9 +612,14 @@ class Tracer:
                 {
                     "name": "thread_name",
                     "ph": "M",
-                    "pid": 1,
+                    "pid": pid,
                     "tid": trace.seq,
-                    "args": {"name": f"job {trace.job_id or trace.seq}"},
+                    "args": {
+                        "name": (
+                            f"attempt {trace.attempt} "
+                            f"(job {trace.job_id or trace.seq})"
+                        )
+                    },
                 }
             )
         return {"traceEvents": events, "displayTimeUnit": "ms"}
@@ -547,14 +723,39 @@ def span(name: str, **meta):
     return parent.child(name, **meta)
 
 
+def outbound_header(fallback: TraceContext | None = None) -> str | None:
+    """The ``X-Trace-Context`` value an outbound publish on this thread
+    should carry, or None (propagation off, or no identity to carry).
+    Inside an active job trace the context is the trace's own identity
+    with THIS attempt's root span as the parent link; outside one (the
+    admission shed path settles deliveries it never started a trace
+    for), ``fallback`` — the delivery's inbound/minted context — is
+    advanced instead."""
+    if not TRACER.propagate:
+        return None
+    span = current_span()
+    trace = getattr(span, "_trace", None)
+    if trace is not None:
+        return TraceContext(
+            trace.trace_id, trace.span_id, trace.attempt + 1
+        ).header_value()
+    if fallback is not None:
+        return fallback.next_attempt().header_value()
+    return None
+
+
 def _log_context() -> dict | None:
     """Correlation fields for the log ring (utils/logging.py): which
-    job/trace the calling thread is working for right now."""
+    job/trace the calling thread is working for right now — including
+    the PROPAGATED trace id, so ring records from every attempt of one
+    logical job correlate across redeliveries."""
     span = current_span()
     trace = getattr(span, "_trace", None)
     if trace is None:
         return None
-    context: dict = {"trace": trace.seq}
+    context: dict = {"trace": trace.seq, "trace_id": trace.trace_id}
+    if trace.attempt:
+        context["attempt"] = trace.attempt
     if trace.job_id:
         context["job_id"] = trace.job_id
     return context

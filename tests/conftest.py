@@ -99,6 +99,10 @@ _PROTOCOL_MODULES = {
     "test_batch",
     "test_admission",
     "test_admission_chaos",
+    # the telemetry plane's lifecycles: alert-episode fire/resolve and
+    # the trace/watch/delivery protocols the e2e walks exercise
+    "test_alerts",
+    "test_telemetry",
 }
 
 

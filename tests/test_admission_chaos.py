@@ -38,7 +38,7 @@ from downloader_tpu.queue.delivery import (
 )
 from downloader_tpu.store import Credentials, S3Client, Uploader
 from downloader_tpu.store.stub import S3Stub
-from downloader_tpu.utils import admission, incident, metrics, watchdog
+from downloader_tpu.utils import admission, incident, metrics, tracing, watchdog
 from downloader_tpu.utils.cancel import CancelToken
 from downloader_tpu.wire import Download, Media
 
@@ -250,11 +250,18 @@ def test_interactive_p99_holds_while_bulk_tenant_saturates_slow_origin(chaos):
             f"vs mixed {mixed_p99:.3f}s"
         )
 
-        # the DLQ contract: Retry-After + shed count on every message
+        # the DLQ contract: Retry-After + shed count + trace context
+        # on every message — a shed job keeps its logical identity
+        dlq_trace_ids = set()
         for body, headers, _, _, _ in list(h.broker._queues[dlq]):
             assert headers[SHED_HEADER] == 1
             assert headers[RETRY_AFTER_HEADER] >= 1
             assert headers[TENANT_HEADER] == "batch-co"
+            context = tracing.TraceContext.parse(
+                headers[tracing.TRACE_CONTEXT_HEADER]
+            )
+            assert context is not None, "shed message lost trace context"
+            dlq_trace_ids.add(context.trace_id)
             job = Download.unmarshal(body)
             assert job.media.source_uri.startswith(h.base)
 
@@ -279,6 +286,23 @@ def test_interactive_p99_holds_while_bulk_tenant_saturates_slow_origin(chaos):
 
         assert wait_for(_admission_bundle, timeout=10), (
             "no admission incident bundle captured"
+        )
+        # the bundle and the DLQ message it describes share ONE trace
+        # id (ISSUE 10 satellite): the flight-recorder evidence is
+        # joinable with the shed message by the propagated identity
+        admission_bundles = [
+            incident.RECORDER.get(s["id"])
+            for s in incident.RECORDER.list_incidents()
+            if s.get("trigger") == "admission"
+        ]
+        bundle_trace_ids = {
+            b["extra"].get("trace_id")
+            for b in admission_bundles
+            if b and b.get("extra")
+        }
+        assert bundle_trace_ids & dlq_trace_ids, (
+            "admission incident bundle and DLQ messages share no "
+            f"trace id: bundle {bundle_trace_ids} vs DLQ {dlq_trace_ids}"
         )
 
         # per-class SLO series populated: interactive completions

@@ -1,0 +1,490 @@
+"""Alert engine (utils/alerts.py): the rule state machine
+(pending/firing/resolved with flap damping), burn-rate math over
+synthetic histogram series, the engine's /debug/alerts + incident
+hand-off, the eval thread's watchdog liveness watch, and the
+burn-rate chaos smoke CI runs as a named step (ISSUE 10)."""
+
+import http.server
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from downloader_tpu.daemon.app import Daemon
+from downloader_tpu.daemon.config import Config
+from downloader_tpu.daemon.health import HealthServer
+from downloader_tpu.fetch import DispatchClient, HTTPBackend
+from downloader_tpu.queue import MemoryBroker, QueueClient
+from downloader_tpu.queue.delivery import CLASS_HEADER, TENANT_HEADER
+from downloader_tpu.store import Credentials, S3Client, Uploader
+from downloader_tpu.store.stub import S3Stub
+from downloader_tpu.utils import alerts, incident, metrics, tsdb, watchdog
+from downloader_tpu.utils.cancel import CancelToken
+from downloader_tpu.wire import Download, Media
+
+SERIES = "slo_job_duration_seconds_interactive"
+
+
+def wait_for(predicate, timeout=15.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    metrics.GLOBAL.reset()
+    yield
+    alerts.ENGINE.reset()
+    metrics.GLOBAL.reset()
+
+
+@pytest.fixture
+def store():
+    s = tsdb.TimeSeriesStore(interval_s=0.05, samples=64, downsample=8)
+    yield s
+    s.reset()
+
+
+def _burn_series(store, error_fraction, count=100, now=None):
+    """Synthesize a window: ``count`` interactive completions of which
+    ``error_fraction`` blew a 1 s target, then scrape."""
+    now = time.time() if now is None else now
+    bad = int(count * error_fraction)
+    for _ in range(count - bad):
+        metrics.GLOBAL.observe(SERIES, 0.05)
+    for _ in range(bad):
+        metrics.GLOBAL.observe(SERIES, 8.0)
+    store.sample(now=now)
+
+
+# -- burn-rate math ------------------------------------------------------------
+
+
+def test_error_burn_math_against_synthetic_series(store):
+    view = alerts.RegistryView(store)
+    t0 = time.time() - 30.0
+    # seed the family, then take the baseline snapshot: burns are
+    # deltas between snapshots, and a single-sample window is BY
+    # DESIGN not enough to fire (startup protection)
+    metrics.GLOBAL.observe(SERIES, 0.05)
+    store.sample(now=t0)
+    _burn_series(store, error_fraction=0.10, count=100, now=t0 + 10)
+    # 10% of jobs over target against a 1% budget = 10x burn
+    burn = view.error_burn(SERIES, 1.0, 0.99, 60.0, t0 + 10)
+    assert burn == pytest.approx(10.0, rel=0.05)
+    # a clean follow-up window burns zero: the 12 s window's oldest
+    # in-window sample is the post-spike one, so the delta covers only
+    # the 100 clean completions
+    for _ in range(100):
+        metrics.GLOBAL.observe(SERIES, 0.05)
+    store.sample(now=t0 + 20)
+    burn = view.error_burn(SERIES, 1.0, 0.99, 12.0, t0 + 21)
+    assert burn == pytest.approx(0.0, abs=1e-9)
+    # no data at all -> None, never a fire
+    assert view.error_burn("slo_job_duration_seconds_bulk", 1.0, 0.99,
+                           60.0, t0 + 20) is None
+
+
+def test_burn_rule_needs_both_windows(store):
+    """The multi-window shape: a fast-window spike alone must not fire
+    when the slow window is measured and clean."""
+    rule = alerts.BurnRateRule(
+        "r", SERIES, target_s=1.0, objective=0.99,
+        fast_window_s=10.0, slow_window_s=1000.0, factor=5.0,
+    )
+    view = alerts.RegistryView(store)
+    t0 = time.time() - 900.0
+    # the family must exist before the baseline sample (the store only
+    # records families the registry has seen)
+    metrics.GLOBAL.observe(SERIES, 0.05)
+    store.sample(now=t0)  # near-empty slow-window baseline
+    # long clean history accrues INSIDE the slow window's delta
+    for _ in range(200):
+        metrics.GLOBAL.observe(SERIES, 0.05)
+    for i in range(1, 5):
+        store.sample(now=t0 + i * 200)
+    store.sample(now=t0 + 890)
+    # then a 100%-bad spike confined to the fast window: 5 of 205
+    # slow-window jobs ≈ 2.4% error rate, under the 5x factor
+    for _ in range(5):
+        metrics.GLOBAL.observe(SERIES, 8.0)
+    store.sample(now=t0 + 895)
+    assert rule.evaluate(view, t0 + 895) != "firing"
+    detail = rule.last_detail
+    assert detail["burn_fast"] >= rule.factor  # the spike alone
+    assert detail["burn_slow"] < rule.factor  # diluted by history
+    # once the slow window is burning too, the rule fires
+    try:
+        for _ in range(60):
+            metrics.GLOBAL.observe(SERIES, 8.0)
+        store.sample(now=t0 + 899)
+        assert rule.evaluate(view, t0 + 900) == "firing"
+    finally:
+        rule.reset()  # resolve the episode (alert-episode protocol)
+
+
+# -- state machine -------------------------------------------------------------
+
+
+class _FlagRule(alerts.AlertRule):
+    """Condition driven directly by the test."""
+
+    def __init__(self, **kwargs):
+        super().__init__("flag", "jobs_processed", **kwargs)
+        self.breached = False
+
+    def _condition(self, view, now):
+        return self.breached, {"breached": self.breached}
+
+
+def test_state_machine_pending_firing_resolved():
+    rule = _FlagRule(for_s=5.0, resolve_evals=2)
+    view = alerts.RegistryView(tsdb.TimeSeriesStore())
+    assert rule.state == "inactive"
+    rule.breached = True
+    assert rule.evaluate(view, 100.0) == "pending"
+    assert rule.state == "pending"
+    # dwell not yet met: still pending
+    assert rule.evaluate(view, 103.0) is None
+    # dwell met: fires
+    assert rule.evaluate(view, 105.0) == "firing"
+    assert rule.state == "firing"
+    assert rule.fire_count == 1
+    # one clear evaluation is NOT enough (flap damping)
+    rule.breached = False
+    assert rule.evaluate(view, 106.0) is None
+    assert rule.state == "firing"
+    # a re-breach resets the clear streak
+    rule.breached = True
+    assert rule.evaluate(view, 107.0) is None
+    rule.breached = False
+    assert rule.evaluate(view, 108.0) is None
+    assert rule.state == "firing"
+    # two consecutive clears resolve
+    assert rule.evaluate(view, 109.0) == "resolved"
+    assert rule.state == "resolved"
+    # and a fresh breach walks pending again from resolved
+    rule.breached = True
+    assert rule.evaluate(view, 110.0) == "pending"
+
+
+def test_pending_clears_without_firing():
+    rule = _FlagRule(for_s=60.0)
+    view = alerts.RegistryView(tsdb.TimeSeriesStore())
+    rule.breached = True
+    assert rule.evaluate(view, 10.0) == "pending"
+    rule.breached = False
+    assert rule.evaluate(view, 11.0) == "inactive"
+    assert rule.fire_count == 0
+
+
+def test_zero_dwell_fires_immediately():
+    rule = _FlagRule(for_s=0.0, resolve_evals=1)
+    view = alerts.RegistryView(tsdb.TimeSeriesStore())
+    rule.breached = True
+    assert rule.evaluate(view, 1.0) == "firing"
+    rule.breached = False
+    assert rule.evaluate(view, 2.0) == "resolved"
+
+
+def test_threshold_rule_gauge_and_missing_series(store):
+    rule = alerts.ThresholdRule("t", "admission_pressure", threshold=1.0)
+    view = alerts.RegistryView(store)
+    # missing series: no data is never a breach
+    assert rule.evaluate(view, 1.0) is None
+    assert rule.state == "inactive"
+    metrics.GLOBAL.gauge_set("admission_pressure", 1.2)
+    assert rule.evaluate(view, 2.0) == "firing"
+    metrics.GLOBAL.gauge_set("admission_pressure", 0.2)
+    rule.resolve_evals = 1
+    assert rule.evaluate(view, 3.0) == "resolved"
+
+
+def test_rule_exception_is_contained():
+    class _Broken(alerts.AlertRule):
+        def _condition(self, view, now):
+            raise RuntimeError("boom")
+
+    rule = _Broken("broken", "jobs_processed")
+    view = alerts.RegistryView(tsdb.TimeSeriesStore())
+    assert rule.evaluate(view, 1.0) is None
+    assert rule.state == "inactive"
+
+
+# -- engine --------------------------------------------------------------------
+
+
+def test_engine_fires_updates_gauge_history_and_incident(store):
+    incident.RECORDER.min_auto_interval = 0.0
+    rule = _FlagRule(for_s=0.0, resolve_evals=1)
+    engine = alerts.AlertEngine(
+        rules=[rule], interval_s=0.05, store=store
+    )
+    try:
+        rule.breached = True
+        fired = engine.evaluate(now=100.0)
+        assert fired == [rule]
+        assert metrics.GLOBAL.gauges()["alerts_firing"] == 1
+        assert metrics.GLOBAL.snapshot()["alerts_fired"] == 1
+        snap = engine.snapshot()
+        assert snap["firing"] == 1
+        assert snap["rules"][0]["state"] == "firing"
+        assert any(
+            e["rule"] == "flag" and e["transition"] == "firing"
+            for e in snap["history"]
+        )
+        # the alert->flight-recorder hand-off (async thread)
+        assert wait_for(
+            lambda: any(
+                b.get("trigger") == "alert"
+                for b in incident.RECORDER.list_incidents()
+            )
+        ), "no alert incident captured"
+        bundles = [
+            b for b in incident.RECORDER.list_incidents()
+            if b.get("trigger") == "alert"
+        ]
+        bundle = incident.RECORDER.get(bundles[-1]["id"])
+        assert bundle["extra"]["rule"] == "flag"
+        assert bundle["extra"]["series"] == "jobs_processed"
+        rule.breached = False
+        engine.evaluate(now=101.0)
+        assert metrics.GLOBAL.gauges()["alerts_firing"] == 0
+    finally:
+        incident.RECORDER.min_auto_interval = (
+            incident.DEFAULT_MIN_AUTO_INTERVAL_S
+        )
+        engine.reset()
+
+
+def test_engine_reset_resolves_open_episodes(store):
+    """The alert-episode lifecycle: a teardown with a rule still
+    firing releases the episode through the declared exit, so the
+    protocol recorder sees balance."""
+    rule = _FlagRule(for_s=0.0)
+    engine = alerts.AlertEngine(rules=[rule], store=store)
+    rule.breached = True
+    engine.evaluate(now=1.0)
+    assert rule.state == "firing"
+    engine.reset()
+    assert rule.state == "inactive"
+    assert metrics.GLOBAL.gauges()["alerts_firing"] == 0
+
+
+def test_eval_thread_carries_watchdog_liveness_watch(store):
+    monitor = watchdog.MONITOR
+    monitor.reset()
+    monitor.configure(stall_s=30.0, action="log")
+    engine = alerts.AlertEngine(rules=[], interval_s=0.05, store=store)
+    try:
+        engine.start()
+        assert wait_for(
+            lambda: "alert-eval"
+            in [t["name"] for t in monitor.snapshot()["tasks"]]
+        )
+        engine.stop()
+        assert "alert-eval" not in [
+            t["name"] for t in monitor.snapshot()["tasks"]
+        ]
+    finally:
+        engine.reset()
+        monitor.reset()
+
+
+def test_default_rules_reference_catalogued_series():
+    for rule in alerts.default_rules():
+        assert rule.series in metrics.HELP, (
+            f"alert rule '{rule.name}' references uncatalogued "
+            f"series '{rule.series}'"
+        )
+
+
+def test_publisher_liveness_rule_wired_to_queue_client_gauge():
+    """The queue client maintains queue_publisher_alive; the stock
+    publisher-dead rule watches exactly that gauge with a dwell."""
+    rules = {r.name: r for r in alerts.default_rules()}
+    rule = rules["publisher-dead"]
+    assert rule.series == "queue_publisher_alive"
+    assert rule.op == "<=" and rule.threshold == 0.0
+    assert rule.for_s > 0  # reconnect blips must not page
+    token = CancelToken()
+    broker = MemoryBroker()
+    client = QueueClient(token, broker.connect, supervisor_interval=0.05)
+    try:
+        # seeded DOWN at construction: a publisher that never comes up
+        # (unreachable broker) must read as dead, not as "no data"
+        assert "queue_publisher_alive" in metrics.GLOBAL.gauges()
+        client.consume("t")
+        assert wait_for(
+            lambda: metrics.GLOBAL.gauges().get("queue_publisher_alive")
+            == 1
+        ), "publisher gauge never went up"
+    finally:
+        token.cancel()
+        client.done()
+    assert metrics.GLOBAL.gauges().get("queue_publisher_alive") == 0
+
+
+# -- the chaos smoke (named CI step) ------------------------------------------
+
+
+INTERACTIVE = b"i" * (8 * 1024)
+
+
+class SlowHandler(http.server.BaseHTTPRequestHandler):
+    """Every fetch dawdles past the (tiny) interactive SLO target —
+    the origin a bulk flood drags the whole worker onto."""
+
+    protocol_version = "HTTP/1.1"
+    delay_s = 0.15
+
+    def log_message(self, *args):
+        pass
+
+    def do_HEAD(self):
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(INTERACTIVE)))
+        self.send_header("Accept-Ranges", "bytes")
+        self.end_headers()
+
+    def do_GET(self):
+        time.sleep(SlowHandler.delay_s)
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(INTERACTIVE)))
+        self.end_headers()
+        self.wfile.write(INTERACTIVE)
+
+
+def test_bulk_flood_trips_interactive_burn_rate_within_fast_window(tmp_path):
+    """The chaos smoke: a bulk flood saturates the single worker, the
+    interactive tenant's completions blow their (tiny) SLO target, and
+    the interactive burn-rate rule fires within ONE fast window — with
+    /debug/alerts showing it firing and the auto-captured incident
+    naming the rule."""
+    incident.RECORDER.min_auto_interval = 0.0
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), SlowHandler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    token = CancelToken()
+    broker = MemoryBroker()
+    stub = S3Stub(credentials=Credentials("k", "s")).start()
+    config = Config(
+        broker="memory", base_dir=str(tmp_path), concurrency=1,
+        max_job_retries=0, retry_delay=0.05,
+    )
+    client = QueueClient(
+        token, broker.connect, supervisor_interval=0.05, drain_timeout=5
+    )
+    client.set_prefetch(32)
+    dispatcher = DispatchClient(
+        token, str(tmp_path),
+        [HTTPBackend(progress_interval=0.01, timeout=10)],
+    )
+    uploader = Uploader(
+        config.bucket, S3Client(stub.endpoint, Credentials("k", "s"))
+    )
+    daemon = Daemon(token, client, dispatcher, uploader, config)
+    runner = threading.Thread(target=daemon.run, daemon=True)
+
+    store = tsdb.TimeSeriesStore(interval_s=0.2, samples=256, downsample=8)
+    fast_window = 5.0
+    alerts.ENGINE.configure(
+        rules=alerts.default_rules(
+            slo_interactive_s=0.01,  # everything the slow origin serves burns
+            fast_window_s=fast_window,
+            slow_window_s=2 * fast_window,
+            factor=2.0,
+        ),
+        interval_s=0.2,
+        store=store,
+    )
+    health = HealthServer(daemon, client, 0).start()
+    producer = broker.connect().channel()
+    producer.declare_exchange("v1.download")
+    for i in range(2):
+        name = f"v1.download-{i}"
+        producer.declare_queue(name)
+        producer.bind_queue(name, "v1.download", name)
+
+    def enqueue(media_id, job_class):
+        body = Download(
+            media=Media(id=media_id, source_uri=f"{base}/{media_id}.mkv")
+        ).marshal()
+        producer.publish(
+            "v1.download", "v1.download-0", body,
+            headers={TENANT_HEADER: "t", CLASS_HEADER: job_class},
+        )
+
+    pre_existing = {b["id"] for b in incident.RECORDER.list_incidents()}
+    try:
+        runner.start()
+        store.start()
+        alerts.ENGINE.start()
+        assert wait_for(lambda: daemon.worker_count == 1)
+        # the flood: bulk jobs occupy the worker, interactive queued
+        # behind them — every interactive completion blows the target
+        for i in range(4):
+            enqueue(f"bulk-{i}", "bulk")
+        for i in range(4):
+            enqueue(f"vip-{i}", "interactive")
+        fired_at = time.monotonic()
+        assert wait_for(
+            lambda: any(
+                r.state == "firing"
+                and r.name == "interactive-latency-burn"
+                for r in alerts.ENGINE.rules()
+            ),
+            timeout=30.0,
+        ), "interactive burn-rate rule never fired"
+        # fired within one fast window of the burn being measurable
+        assert time.monotonic() - fired_at <= fast_window + 10.0
+        # /debug/alerts shows it firing
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{health.port}/debug/alerts"
+        ) as resp:
+            payload = json.loads(resp.read())
+        states = {r["name"]: r["state"] for r in payload["rules"]}
+        assert states["interactive-latency-burn"] == "firing"
+        assert payload["firing"] >= 1
+        # the auto-captured incident names the rule
+        def _fresh_alert_bundles():
+            return [
+                b for b in incident.RECORDER.list_incidents()
+                if b.get("trigger") == "alert"
+                and b["id"] not in pre_existing
+            ]
+
+        assert wait_for(
+            lambda: len(_fresh_alert_bundles()) > 0
+        ), "no alert incident captured"
+        bundles = [
+            incident.RECORDER.get(b["id"])
+            for b in _fresh_alert_bundles()
+        ]
+        named = [
+            b for b in bundles
+            if b and b["extra"]["rule"] == "interactive-latency-burn"
+        ]
+        assert named, "no incident names the burn-rate rule"
+        assert (
+            named[-1]["extra"]["series"]
+            == "slo_job_duration_seconds_interactive"
+        )
+    finally:
+        incident.RECORDER.min_auto_interval = (
+            incident.DEFAULT_MIN_AUTO_INTERVAL_S
+        )
+        alerts.ENGINE.reset()
+        store.reset()
+        health.stop()
+        token.cancel()
+        runner.join(timeout=15)
+        stub.stop()
+        httpd.shutdown()

@@ -258,6 +258,49 @@ def test_circleci_runs_overload_smoke():
     assert "test_admission_chaos.py" in commands
 
 
+def test_circleci_runs_burn_rate_smoke():
+    """The telemetry-plane chaos smoke (ISSUE 10 satellite): a bulk
+    flood must trip the interactive burn-rate rule within one fast
+    window, and the one-trace-id lifecycle walk must run — both as a
+    named CI step."""
+    yaml = pytest.importorskip("yaml")
+    ci = yaml.safe_load(CONFIG.read_text())
+    commands = " ".join(
+        s["run"]["command"]
+        for s in ci["jobs"]["tests"]["steps"]
+        if isinstance(s, dict) and "run" in s
+    )
+    assert "test_alerts.py" in commands
+    assert (
+        "test_bulk_flood_trips_interactive_burn_rate_within_fast_window"
+        in commands
+    )
+    assert "test_one_trace_id_across_cancel_retry_and_shed" in commands
+
+
+def test_bench_digest_picks_up_telemetry_overhead_arm():
+    """The telemetry_overhead ablation must survive into the digest
+    line, beside the watchdog arm it mirrors."""
+    import sys
+
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench_digest
+    finally:
+        sys.path.remove(str(REPO))
+
+    report = {
+        "value": 100.0,
+        "extra_metrics": [
+            {"metric": "watchdog_overhead", "delta_ms": 0.01},
+            {"metric": "telemetry_overhead", "delta_ms": 0.12},
+        ],
+    }
+    digest = bench_digest.digest_line(report)
+    assert digest["watchdog_ms"] == 0.01
+    assert digest["telemetry_ms"] == 0.12
+
+
 def test_circleci_runs_mirror_failover_smoke():
     """The multi-source acceptance scenario — primary killed
     mid-stream, job completes from the secondary with zero dangling

@@ -360,10 +360,10 @@ def test_healthz_answers_while_another_handler_is_blocked(harness):
     release = threading_mod.Event()
     real_trace = server._debug_trace
 
-    def wedged_trace():
+    def wedged_trace(query=None):
         entered.set()
         release.wait(15)  # hold the handler thread hostage
-        return real_trace()
+        return real_trace(query)
 
     server._debug_trace = wedged_trace
     server.start()

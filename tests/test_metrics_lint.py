@@ -230,6 +230,36 @@ def test_source_families_carry_catalogued_help(exposition):
         assert name in HELP, f"{name} missing from the HELP catalog"
 
 
+def test_alert_rules_reference_registered_families(exposition):
+    """The alert-catalog lint (ISSUE 10 satellite): every rule in the
+    stock alert set must reference a metric family that actually
+    exists — catalogued in metrics.HELP, and (for the burn rules) a
+    histogram the exposition seeds from the first scrape, so an alert
+    can never silently watch a series nobody emits."""
+    from downloader_tpu.utils import alerts
+
+    families, _ = _parse(exposition)
+    rules = alerts.default_rules()
+    assert rules, "stock alert rule set is empty"
+    seen_names = set()
+    for rule in rules:
+        assert rule.name not in seen_names, f"duplicate rule {rule.name}"
+        seen_names.add(rule.name)
+        assert rule.series in metrics.HELP, (
+            f"alert rule '{rule.name}' references series "
+            f"'{rule.series}' missing from the HELP catalog"
+        )
+        if isinstance(rule, alerts.BurnRateRule):
+            exported = f"downloader_{rule.series}"
+            assert exported in families, (
+                f"burn rule '{rule.name}' series {exported} not "
+                "seeded in the exposition"
+            )
+            assert families[exported]["type"] == "histogram", (
+                f"burn rule '{rule.name}' must watch a histogram"
+            )
+
+
 def test_expected_series_present(exposition):
     """The families the dashboards/alerts reference exist in one scrape
     of a populated registry."""

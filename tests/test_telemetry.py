@@ -1,0 +1,463 @@
+"""Telemetry plane end-to-end (ISSUE 10 acceptance): one logical job
+keeps ONE trace id across dequeue → watchdog cancel → retry republish
+→ DLQ shed, visible in /debug/trace lineage, the log ring, incident
+bundles, and the DLQ message headers; the Convert hand-off carries the
+context downstream; and the whole plane stays under the 0.5 ms/job
+cost guard."""
+
+import http.server
+import threading
+import time
+
+import pytest
+
+from downloader_tpu.daemon.app import Daemon, capture_stall_incident
+from downloader_tpu.daemon.config import Config
+from downloader_tpu.fetch import DispatchClient, HTTPBackend
+from downloader_tpu.queue import MemoryBroker, QueueClient
+from downloader_tpu.queue.delivery import (
+    CLASS_HEADER,
+    SHED_HEADER,
+    TENANT_HEADER,
+    dlq_name,
+)
+from downloader_tpu.store import Credentials, S3Client, Uploader
+from downloader_tpu.utils import admission, alerts, incident, metrics
+from downloader_tpu.utils import tracing, tsdb, watchdog
+from downloader_tpu.utils.cancel import CancelToken
+from downloader_tpu.utils.logging import ring_tail
+from downloader_tpu.wire import Download, Media
+
+MOVIE = b"\x1aFAKEMKV" * 1024
+
+
+def wait_for(predicate, timeout=20.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture(autouse=True)
+def clean_tracer():
+    tracing.TRACER.clear()
+    tracing.TRACER.enabled = True
+    tracing.TRACER.propagate = True
+    yield
+    tracing.TRACER.clear()
+    tracing.TRACER.enabled = True
+    tracing.TRACER.propagate = True
+
+
+# -- unit: the wire format and adoption ---------------------------------------
+
+
+def test_trace_context_roundtrip_and_tolerance():
+    ctx = tracing.TraceContext.mint()
+    parsed = tracing.TraceContext.parse(ctx.header_value())
+    assert parsed.trace_id == ctx.trace_id
+    assert parsed.parent_span_id == ""
+    assert parsed.attempt == 0
+    advanced = ctx.next_attempt("ab" * 8)
+    parsed = tracing.TraceContext.parse(advanced.header_value())
+    assert parsed.trace_id == ctx.trace_id
+    assert parsed.parent_span_id == "ab" * 8
+    assert parsed.attempt == 1
+    # garbage degrades to None (the consumer mints), never raises
+    for bad in (None, 7, "", "x-y", "nothex" * 8, "aa-bb-cc-dd",
+                f"{'a' * 32}-{'b' * 16}--1", b"\xff\xfe"):
+        assert tracing.TraceContext.parse(bad) is None
+
+
+def test_trace_adopts_context_and_outbound_advances():
+    ctx = tracing.TraceContext(("c" * 32), "d" * 16, attempt=3)
+    with tracing.TRACER.job("j-1", context=ctx):
+        header = tracing.outbound_header()
+        parsed = tracing.TraceContext.parse(header)
+        assert parsed.trace_id == "c" * 32
+        assert parsed.attempt == 4
+    (trace,) = tracing.TRACER.recent()
+    assert trace["trace_id"] == "c" * 32
+    assert trace["attempt"] == 3
+    assert trace["parent_span_id"] == "d" * 16
+    # the outbound parent link names THIS attempt's root span
+    assert parsed.parent_span_id == trace["span_id"]
+
+
+def test_propagation_gate_off_stamps_nothing():
+    tracing.TRACER.propagate = False
+    try:
+        with tracing.TRACER.job("j-2"):
+            assert tracing.outbound_header() is None
+        assert (
+            tracing.outbound_header(
+                fallback=tracing.TraceContext.mint()
+            )
+            is None
+        )
+    finally:
+        tracing.TRACER.propagate = True
+
+
+# -- e2e harness ---------------------------------------------------------------
+
+
+class WedgeHandler(http.server.BaseHTTPRequestHandler):
+    """First GET wedges (headers sent, then silence) until released;
+    later GETs serve normally — attempt 0 stalls, a retry would work."""
+
+    protocol_version = "HTTP/1.1"
+    release = threading.Event()
+    wedged_once = False
+
+    def log_message(self, *args):
+        pass
+
+    def do_HEAD(self):
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(MOVIE)))
+        self.send_header("Accept-Ranges", "bytes")
+        self.end_headers()
+
+    def do_GET(self):
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(MOVIE)))
+        self.end_headers()
+        if not WedgeHandler.wedged_once:
+            WedgeHandler.wedged_once = True
+            # half the payload, then silence with the socket open: the
+            # canonical wedge — no data, no error
+            self.wfile.write(MOVIE[: len(MOVIE) // 2])
+            self.wfile.flush()
+            WedgeHandler.release.wait(30.0)
+            return
+        self.wfile.write(MOVIE)
+
+
+class _QuietServer(http.server.ThreadingHTTPServer):
+    def handle_error(self, request, client_address):
+        pass
+
+
+@pytest.fixture
+def wedge_harness(tmp_path):
+    WedgeHandler.release = threading.Event()
+    WedgeHandler.wedged_once = False
+    httpd = _QuietServer(("127.0.0.1", 0), WedgeHandler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    token = CancelToken()
+    broker = MemoryBroker()
+    from downloader_tpu.store.stub import S3Stub
+
+    stub = S3Stub(credentials=Credentials("k", "s")).start()
+    config = Config(
+        broker="memory", base_dir=str(tmp_path), concurrency=1,
+        max_job_retries=2, retry_delay=0.05,
+    )
+    client = QueueClient(
+        token, broker.connect, supervisor_interval=0.05, drain_timeout=5
+    )
+    client.set_prefetch(8)
+    dispatcher = DispatchClient(
+        token, str(tmp_path),
+        [
+            HTTPBackend(
+                # socket timeout shorter than the wedge hold so the
+                # watchdog's cancel takes effect at the next read
+                progress_interval=0.01, timeout=2.0, zero_copy=False,
+                segments=1,
+            )
+        ],
+    )
+    uploader = Uploader(
+        config.bucket, S3Client(stub.endpoint, Credentials("k", "s"))
+    )
+    daemon = Daemon(token, client, dispatcher, uploader, config)
+    runner = threading.Thread(target=daemon.run, daemon=True)
+
+    monitor = watchdog.MONITOR
+    monitor.reset()
+    monitor.configure(
+        stall_s=0.6, action="cancel", stage_overrides={},
+        on_stall=capture_stall_incident,
+    )
+    monitor.start(poll_interval=0.1)
+    incident.RECORDER.min_auto_interval = 0.0
+
+    producer = broker.connect().channel()
+    producer.declare_exchange("v1.download")
+    for i in range(2):
+        name = f"v1.download-{i}"
+        producer.declare_queue(name)
+        producer.bind_queue(name, "v1.download", name)
+
+    class H:
+        pass
+
+    h = H()
+    h.daemon, h.broker, h.stub = daemon, broker, stub
+    h.base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def enqueue(media_id, url, headers=None):
+        body = Download(media=Media(id=media_id, source_uri=url)).marshal()
+        producer.publish(
+            "v1.download", "v1.download-0", body, headers=headers or {}
+        )
+
+    h.enqueue = enqueue
+    runner.start()
+    yield h
+    WedgeHandler.release.set()
+    token.cancel()
+    runner.join(timeout=15)
+    incident.RECORDER.min_auto_interval = (
+        incident.DEFAULT_MIN_AUTO_INTERVAL_S
+    )
+    monitor.reset()
+    stub.stop()
+    httpd.shutdown()
+
+
+def test_one_trace_id_across_cancel_retry_and_shed(wedge_harness):
+    """The acceptance walk: dequeued → wedged in fetch → watchdog
+    cancel → retry republish → ledger tripped → shed to DLQ. ONE trace
+    id on every surface."""
+    h = wedge_harness
+    ctx = tracing.TraceContext.mint()
+    trace_id = ctx.trace_id
+    pre_existing = {b["id"] for b in incident.RECORDER.list_incidents()}
+    h.enqueue(
+        "wedge-1", f"{h.base}/wedge-1.mkv",
+        headers={
+            tracing.TRACE_CONTEXT_HEADER: ctx.header_value(),
+            TENANT_HEADER: "t-wedge",
+            CLASS_HEADER: "bulk",
+        },
+    )
+    # attempt 0 is admitted and wedged once the origin sees its GET;
+    # trip the ledger NOW — before the watchdog cancel republishes —
+    # so the redelivered attempt meets the shed rung at admission
+    assert wait_for(lambda: WedgeHandler.wedged_once, timeout=10), (
+        "the wedge origin never saw the fetch"
+    )
+    admission.LEDGER.configure({"disk": 100})
+    admission.LEDGER.charge("disk", "telemetry-pressure", 100)
+    try:
+        # the watchdog cancels the wedged attempt into the retry path
+        assert wait_for(
+            lambda: h.daemon.stats.retried >= 1, timeout=15
+        ), "watchdog never cancelled the wedged attempt into retry"
+        dlq = dlq_name("v1.download")
+        assert wait_for(
+            lambda: h.broker.queue_depth(dlq) >= 1, timeout=15
+        ), "retried attempt was never shed to the DLQ"
+
+        # 1. the DLQ message carries the SAME trace id
+        body, headers, _, _, _ = list(h.broker._queues[dlq])[0]
+        dlq_ctx = tracing.TraceContext.parse(
+            headers[tracing.TRACE_CONTEXT_HEADER]
+        )
+        assert dlq_ctx is not None
+        assert dlq_ctx.trace_id == trace_id
+        assert dlq_ctx.attempt >= 2  # producer 0 → retry 1 → shed 2
+        assert headers[SHED_HEADER] == 1
+        assert Download.unmarshal(body).media.id == "wedge-1"
+
+        # 2. /debug/trace lineage links the attempt(s) under that id
+        attempts = tracing.TRACER.lineage(trace_id)
+        assert attempts, "no trace recorded for the propagated id"
+        assert attempts[0]["job_id"] == "wedge-1"
+        assert attempts[0]["attempt"] == 0
+        assert attempts[0]["status"] == "retried"
+
+        # 3. the log ring correlates records by the propagated id
+        assert any(
+            record.get("trace_id") == trace_id for record in ring_tail()
+        ), "no log-ring record carries the trace id"
+
+        # 4. incident bundles: the watchdog capture embeds the trace,
+        # the admission shed capture names the id in extra
+        def fresh(trigger):
+            return [
+                incident.RECORDER.get(b["id"])
+                for b in incident.RECORDER.list_incidents()
+                if b.get("trigger") == trigger
+                and b["id"] not in pre_existing
+            ]
+
+        assert wait_for(lambda: len(fresh("watchdog")) >= 1, timeout=10)
+        stall_bundles = [
+            b for b in fresh("watchdog")
+            if b and b.get("trace")
+            and b["trace"].get("trace_id") == trace_id
+        ]
+        assert stall_bundles, (
+            "watchdog incident does not embed the propagated trace"
+        )
+        assert wait_for(lambda: len(fresh("admission")) >= 1, timeout=10)
+        shed_bundles = [
+            b for b in fresh("admission")
+            if b and b.get("extra", {}).get("trace_id") == trace_id
+        ]
+        assert shed_bundles, (
+            "admission shed incident does not name the trace id"
+        )
+    finally:
+        admission.LEDGER.refund("telemetry-pressure")
+        WedgeHandler.release.set()
+
+
+def test_convert_handoff_carries_trace_context(wedge_harness):
+    """The pipeline hand-off: a successful job's Convert message rides
+    with the job's X-Trace-Context, parent-linked to the job's root
+    span — the Download → Convert pipeline is one trace."""
+    h = wedge_harness
+    WedgeHandler.wedged_once = True  # serve normally from the start
+    ctx = tracing.TraceContext.mint()
+    h.enqueue(
+        "smooth-1", f"{h.base}/smooth-1.mkv",
+        headers={tracing.TRACE_CONTEXT_HEADER: ctx.header_value()},
+    )
+    assert wait_for(lambda: h.daemon.stats.processed >= 1)
+
+    def convert_headers():
+        for shard in ("v1.convert-0", "v1.convert-1"):
+            for entry in list(h.broker._queues.get(shard, ())):
+                yield entry[1]
+
+    assert wait_for(lambda: any(True for _ in convert_headers()))
+    (headers,) = list(convert_headers())
+    out = tracing.TraceContext.parse(
+        headers[tracing.TRACE_CONTEXT_HEADER]
+    )
+    assert out is not None
+    assert out.trace_id == ctx.trace_id
+    trace = next(
+        t for t in tracing.TRACER.recent() if t["job_id"] == "smooth-1"
+    )
+    assert out.parent_span_id == trace["span_id"]
+
+
+def test_retried_attempts_link_parent_spans(wedge_harness):
+    """Transient-failure retry: both attempts share the trace id and
+    attempt N+1's parent_span_id is attempt N's root span — the
+    cross-attempt tree /debug/trace serves."""
+    h = wedge_harness
+    WedgeHandler.wedged_once = True  # no wedge; use a 404-once origin
+
+    class FlakyOnce(http.server.BaseHTTPRequestHandler):
+        served = {"fails": 1}
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):
+            pass
+
+        def do_HEAD(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(MOVIE)))
+            self.end_headers()
+
+        def do_GET(self):
+            if FlakyOnce.served["fails"] > 0:
+                FlakyOnce.served["fails"] -= 1
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(MOVIE)))
+            self.end_headers()
+            self.wfile.write(MOVIE)
+
+    flaky = http.server.ThreadingHTTPServer(("127.0.0.1", 0), FlakyOnce)
+    threading.Thread(target=flaky.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{flaky.server_address[1]}/flaky.mkv"
+        h.enqueue("flaky-1", url)
+        assert wait_for(lambda: h.daemon.stats.processed >= 1)
+        traces = [
+            t for t in tracing.TRACER.recent()
+            if t["job_id"] == "flaky-1"
+        ]
+        assert len(traces) == 2
+        first, second = sorted(traces, key=lambda t: t["attempt"])
+        assert first["trace_id"] == second["trace_id"]
+        assert (first["attempt"], second["attempt"]) == (0, 1)
+        assert second["parent_span_id"] == first["span_id"]
+        assert first["status"] == "retried"
+        assert second["status"] == "ok"
+        # the lineage view returns them linked, in attempt order
+        lineage = tracing.TRACER.lineage(first["trace_id"])
+        assert [t["attempt"] for t in lineage] == [0, 1]
+        # chrome export groups both attempts under ONE pid lane
+        events = tracing.TRACER.chrome_trace()["traceEvents"]
+        pids = {
+            e["pid"] for e in events
+            if e["ph"] == "X"
+            and e.get("args", {}).get("trace_id") == first["trace_id"]
+        }
+        assert len(pids) == 1
+    finally:
+        flaky.shutdown()
+
+
+# -- the cost guard ------------------------------------------------------------
+
+
+def test_telemetry_overhead_bounded():
+    """The ISSUE 10 satellite guard, same shape as the watchdog one: a
+    fully telemetered job — context parse + adoption, span tree (~10
+    spans), watch lifecycle with beats, outbound context stamp, trace
+    completion + histogram feed — with the TSDB scraper and alert
+    engine BOTH live, must cost <= 0.5 ms at the median."""
+    monitor = watchdog.Watchdog(stall_s=120.0)
+    store = tsdb.TimeSeriesStore(interval_s=0.05)
+    engine = alerts.AlertEngine(
+        rules=alerts.default_rules(), interval_s=0.05, store=store
+    )
+    store.start()
+    engine.start()
+    inbound = tracing.TraceContext.mint().next_attempt("ab" * 8)
+    inbound_header = inbound.header_value()
+
+    def one_job():
+        ctx = tracing.TraceContext.parse(inbound_header)
+        watch = monitor.job("bench", cancel=lambda: None)
+        with tracing.TRACER.job("bench", context=ctx) as root:
+            with watchdog.install(watch):
+                root.annotate(job_id="bench", tenant="t")
+                hb = watch.stage("fetch")
+                with tracing.span("fetch", url="http://x/y"):
+                    for _ in range(64):
+                        hb.beat(1024)
+                with tracing.span("scan"):
+                    watch.stage("scan")
+                with tracing.span("upload", files=1):
+                    watch.stage("upload")
+                with tracing.span("publish"):
+                    watch.stage("publish")
+                    assert tracing.outbound_header() is not None
+                with tracing.span("ack"):
+                    watch.stage("ack")
+            root.set_status("ok")
+        monitor.unregister(watch)
+
+    try:
+        one_job()  # warm
+        laps = []
+        for _ in range(200):
+            start = time.perf_counter()
+            one_job()
+            laps.append(time.perf_counter() - start)
+        laps.sort()
+        median_ms = laps[len(laps) // 2] * 1000
+        assert median_ms < 0.5, (
+            f"telemetry plane costs {median_ms:.3f} ms/job — over the "
+            "0.5 ms per-job budget (ISSUE 10 satellite)"
+        )
+    finally:
+        engine.reset()
+        store.reset()
+        monitor.reset()
+        tracing.TRACER.clear()

@@ -1,0 +1,302 @@
+"""Local time-series store (utils/tsdb.py): bounded rings, counter
+rates, histogram window deltas + quantile estimates, the /debug/tsdb
+view, and the scrape thread's watchdog liveness watch (ISSUE 10)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from downloader_tpu.daemon.health import HealthServer
+from downloader_tpu.utils import metrics, tsdb, watchdog
+
+
+def wait_for(predicate, timeout=5.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    metrics.GLOBAL.reset()
+    yield
+    metrics.GLOBAL.reset()
+
+
+@pytest.fixture
+def store():
+    s = tsdb.TimeSeriesStore(interval_s=0.05, samples=8, downsample=4)
+    yield s
+    s.reset()
+
+
+def test_quantile_interpolates_inside_bucket():
+    bounds = (0.1, 0.5, 1.0)
+    # cumulative: 10 at <=0.1, 30 at <=0.5, 40 at <=1.0
+    counts = [10, 30, 40]
+    p50 = tsdb.quantile(bounds, counts, 40, 0.50)
+    # rank 20 lands mid-bucket (0.1, 0.5]: 10 below, 20 in-bucket
+    assert 0.1 < p50 < 0.5
+    assert abs(p50 - (0.1 + 0.4 * (10 / 20))) < 1e-9
+    # empty histogram has no quantiles
+    assert tsdb.quantile(bounds, [0, 0, 0], 0, 0.5) is None
+    # mass beyond the top finite bucket clamps to the top bound
+    assert tsdb.quantile(bounds, [0, 0, 0], 5, 0.99) == 1.0
+
+
+def test_counter_rate_over_window(store):
+    metrics.GLOBAL.add("jobs_processed", 10)
+    store.sample(now=1000.0)
+    metrics.GLOBAL.add("jobs_processed", 20)
+    store.sample(now=1010.0)
+    rate = store.counter_rate("jobs_processed", 60.0, now=1010.0)
+    assert rate == pytest.approx(2.0)  # +20 over 10 s
+    # a registry reset (counter going backwards) clamps to zero
+    metrics.GLOBAL.reset()
+    metrics.GLOBAL.add("jobs_processed", 1)
+    store.sample(now=1020.0)
+    assert store.counter_rate("jobs_processed", 60.0, now=1020.0) >= 0.0
+
+
+def test_fine_ring_bounded_and_coarse_tier_fills(store):
+    for i in range(40):
+        metrics.GLOBAL.gauge_set("admission_pressure", float(i % 7))
+        store.sample(now=2000.0 + i)
+    snap = store.snapshot()
+    series = snap["series"]["admission_pressure"]
+    assert series["fine_samples"] <= 8  # maxlen respected
+    assert series["coarse_samples"] >= 1  # downsampled tier populated
+    # coarse gauge aggregates carry min/max so old spikes stay visible
+    result = store.query("admission_pressure", window_s=100.0)
+    for entry in result.get("downsampled", []):
+        assert entry["min"] <= entry["value"] <= entry["max"]
+
+
+def test_histogram_window_delta_and_quantiles(store):
+    # anchored near the real clock: query() derives its own now
+    t0 = time.time() - 10.0
+    for value in (0.05, 0.05, 0.05):
+        metrics.GLOBAL.observe("job_duration_seconds", value)
+    store.sample(now=t0)
+    for value in (0.3, 0.3, 8.0, 8.0):
+        metrics.GLOBAL.observe("job_duration_seconds", value)
+    store.sample(now=t0 + 10.0)
+    window = store.histogram_window(
+        "job_duration_seconds", 60.0, now=t0 + 10.0
+    )
+    assert window is not None
+    bounds, deltas, d_sum, d_count = window
+    assert d_count == 4  # only the post-first-sample observations
+    assert d_sum == pytest.approx(0.3 + 0.3 + 8.0 + 8.0)
+    result = store.query("job_duration_seconds", window_s=60.0)
+    quantiles = result["window"]
+    assert quantiles["count"] == 4
+    # two of four at ~0.3, two at ~8: p50 sits at/below the 0.5 bucket,
+    # p99 out in the coarse tail
+    assert quantiles["p50"] <= 0.5
+    assert quantiles["p99"] > 5.0
+
+
+def test_single_sample_window_measures_from_zero(store):
+    """A process younger than the alert window reports its whole short
+    life rather than claiming no data."""
+    metrics.GLOBAL.observe("job_duration_seconds", 0.2)
+    store.sample(now=4000.0)
+    window = store.histogram_window(
+        "job_duration_seconds", 300.0, now=4000.0
+    )
+    assert window is not None
+    assert window[3] == 1
+    # but callers that must not act on startup data (burn rules) get
+    # None until a second snapshot exists
+    assert store.histogram_window(
+        "job_duration_seconds", 300.0, now=4000.0, min_samples=2
+    ) is None
+
+
+def test_scrape_thread_carries_watchdog_liveness_watch(store):
+    """The satellite's analyzer-coverage half: the tsdb-scrape loop
+    registers a watchdog loop watch while running, so a wedged scrape
+    reads as a stalled loop."""
+    monitor = watchdog.MONITOR
+    monitor.reset()
+    monitor.configure(stall_s=30.0, action="log")
+    try:
+        store.start()
+        deadline = time.monotonic() + 5.0
+        names = []
+        while time.monotonic() < deadline:
+            names = [t["name"] for t in monitor.snapshot()["tasks"]]
+            if "tsdb-scrape" in names:
+                break
+            time.sleep(0.01)
+        assert "tsdb-scrape" in names
+        store.stop()
+        names = [t["name"] for t in monitor.snapshot()["tasks"]]
+        assert "tsdb-scrape" not in names  # watch released on stop
+    finally:
+        store.stop()
+        monitor.reset()
+
+
+def test_disabled_store_never_starts(store):
+    store.configure(interval_s=0.0)
+    assert not store.enabled
+    store.start()
+    assert store.snapshot()["running"] is False
+
+
+def test_live_disable_then_reenable_restarts_the_loop(store):
+    """configure(interval_s=0) on a RUNNING store exits the loop (no
+    busy-spin) and releases the thread slot, so a later re-enable's
+    start() spawns a fresh loop instead of no-opping forever."""
+    store.start()
+    assert store.snapshot()["running"] is True
+    store.configure(interval_s=0.0)
+    assert wait_for(lambda: store.snapshot()["running"] is False), (
+        "live-disabled loop never exited / released its slot"
+    )
+    store.configure(interval_s=0.05)
+    store.start()
+    assert store.snapshot()["running"] is True
+    before = store.snapshot()["scrapes"]
+    assert wait_for(lambda: store.snapshot()["scrapes"] > before), (
+        "re-enabled loop is not scraping"
+    )
+    store.stop()
+
+
+class _FakeDaemonStats:
+    processed = failed = retried = dropped = shed = 0
+
+
+class _FakeDaemon:
+    stats = _FakeDaemonStats()
+    worker_count = 1
+
+
+class _FakeQueueStats:
+    published = delivered = publish_retries = reconnects = 0
+    consumer_errors = 0
+
+
+class _FakeClient:
+    stats = _FakeQueueStats()
+
+    def connected(self):
+        return True
+
+
+def test_debug_tsdb_endpoint_serves_series_and_snapshot():
+    tsdb.STORE.reset()
+    metrics.GLOBAL.add("jobs_processed", 3)
+    tsdb.STORE.sample()
+    time.sleep(0.01)
+    metrics.GLOBAL.add("jobs_processed", 3)
+    tsdb.STORE.sample()
+    server = HealthServer(_FakeDaemon(), _FakeClient(), 0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/debug/tsdb") as resp:
+            snap = json.loads(resp.read())
+        assert "jobs_processed" in snap["series"]
+        assert snap["scrapes"] >= 2
+        with urllib.request.urlopen(
+            f"{base}/debug/tsdb?name=jobs_processed&window=60"
+        ) as resp:
+            series = json.loads(resp.read())
+        assert series["kind"] == "counter"
+        assert len(series["points"]) == 2
+        assert series["rate_per_s"] is not None
+        # unknown series answers 404, not 500
+        try:
+            urllib.request.urlopen(f"{base}/debug/tsdb?name=nope")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 404
+    finally:
+        server.stop()
+        tsdb.STORE.reset()
+
+
+def test_metrics_federate_labels_every_sample():
+    """/metrics/federate: own samples tagged instance=worker-0 (or
+    WORKER_INSTANCE), child sources merged under their own label,
+    family metadata declared once."""
+    metrics.FEDERATION.reset()
+    metrics.GLOBAL.add("jobs_processed", 1)
+    metrics.FEDERATION.register_source(
+        "w1",
+        lambda: (
+            "# HELP downloader_jobs_processed jobs completed end-to-end"
+            " (consume through ack)\n"
+            "# TYPE downloader_jobs_processed counter\n"
+            "downloader_jobs_processed 7\n"
+        ),
+    )
+    metrics.FEDERATION.register_source(
+        "w-broken", lambda: (_ for _ in ()).throw(RuntimeError("down"))
+    )
+    # a child that is ITSELF federating (samples pre-tagged), plus the
+    # parser hazards: a '}' inside a label value, and a label merely
+    # ENDING in "instance" (must still get tagged)
+    metrics.FEDERATION.register_source(
+        "w-nested",
+        lambda: (
+            'downloader_jobs_processed{instance="w2"} 9\n'
+            'downloader_http_errors{path="/v1/{id}"} 3\n'
+            'downloader_jobs_dropped{pod_instance="p1"} 2\n'
+        ),
+    )
+    server = HealthServer(_FakeDaemon(), _FakeClient(), 0).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics/federate"
+        ) as resp:
+            body = resp.read().decode()
+    finally:
+        server.stop()
+        metrics.FEDERATION.reset()
+    lines = body.splitlines()
+    samples = [l for l in lines if l and not l.startswith("#")]
+    assert samples, "no samples rendered"
+    for line in samples:
+        assert 'instance="' in line, f"unlabeled sample: {line}"
+    assert any(
+        l.startswith("downloader_jobs_processed{")
+        and 'instance="worker-0"' in l
+        for l in samples
+    )
+    assert any(
+        l == 'downloader_jobs_processed{instance="w1"} 7'
+        for l in samples
+    )
+    # pre-tagged child samples keep THEIR label (no duplicate names)
+    assert 'downloader_jobs_processed{instance="w2"} 9' in samples
+    # a '}' inside a quoted label value survives the parse
+    assert any(
+        l.startswith("downloader_http_errors{")
+        and 'path="/v1/{id}"' in l
+        and 'instance="w-nested"' in l
+        for l in samples
+    ), "brace-in-label-value sample was dropped"
+    # a label merely ending in 'instance' still gets tagged
+    assert any(
+        l.startswith("downloader_jobs_dropped{")
+        and 'instance="w-nested"' in l
+        and 'pod_instance="p1"' in l
+        for l in samples
+    )
+    # family metadata declared exactly once despite two workers
+    helps = [
+        l for l in lines
+        if l.startswith("# HELP downloader_jobs_processed ")
+    ]
+    assert len(helps) == 1
+    # the broken source cost a counter, not the scrape
+    assert metrics.GLOBAL.snapshot().get("federate_source_errors", 0) >= 1
