@@ -38,7 +38,7 @@ from pathlib import Path
 from . import engine
 from .core import Module, Violation
 
-CACHE_VERSION = 2
+CACHE_VERSION = 3  # v3: call sites, spawn roles, lock balance, shared decls
 
 
 def _sig(path: str) -> list[int] | None:
@@ -137,17 +137,46 @@ def _dump_scan(scan: engine.ModuleScan) -> dict:
                     for d in fa.double_releases
                 ],
                 "thread_spawns": [
-                    [t.line, t.target_name, t.kind] for t in fa.thread_spawns
+                    [t.line, t.target_name, t.kind, t.role, t.via]
+                    for t in fa.thread_spawns
+                ],
+                "call_sites": [
+                    [
+                        c.name,
+                        c.line,
+                        list(c.held),
+                        c.kind,
+                        c.recv,
+                        list(c.pos_names),
+                        [list(pair) for pair in c.kw_names],
+                    ]
+                    for c in fa.call_sites
+                ],
+                "borrow_escapes": [
+                    [
+                        b.protocol,
+                        b.var,
+                        b.line,
+                        list(b.release_names),
+                        [list(p) for p in b.passes],
+                    ]
+                    for b in fa.borrow_escapes
                 ],
                 "calls": sorted(fa.calls),
                 "has_settimeout": fa.has_settimeout,
                 "has_timeout_kwarg": fa.has_timeout_kwarg,
+                "exit_held": list(fa.exit_held),
+                "lock_releases": list(fa.lock_releases),
+                "lock_imbalances": [list(i) for i in fa.lock_imbalances],
             }
         )
     return {
         "functions": functions,
         "guards": [
             [g.attr, g.lock, g.line, g.class_name] for g in scan.guards
+        ],
+        "shared": [
+            [s.attr, s.reason, s.line, s.class_name] for s in scan.shared
         ],
         "env_reads": [[e.name, e.line] for e in scan.env_reads],
     }
@@ -200,17 +229,44 @@ def _load_scan(module: Module, data: dict) -> engine.ModuleScan | None:
             for proto, var, line, acq in record["double_releases"]
         ]
         fa.thread_spawns = [
-            engine.ThreadSpawn(line, target, kind, cls)
-            for line, target, kind in record["thread_spawns"]
+            engine.ThreadSpawn(line, target, kind, cls, role, via)
+            for line, target, kind, role, via in record["thread_spawns"]
+        ]
+        fa.call_sites = [
+            engine.CallSite(
+                name,
+                line,
+                tuple(held),
+                kind,
+                recv,
+                tuple(pos),
+                tuple(tuple(pair) for pair in kws),
+            )
+            for name, line, held, kind, recv, pos, kws in record["call_sites"]
+        ]
+        fa.borrow_escapes = [
+            engine.BorrowEscape(
+                proto, var, line, tuple(names), tuple(tuple(p) for p in passes)
+            )
+            for proto, var, line, names, passes in record["borrow_escapes"]
         ]
         fa.calls = set(record["calls"])
         fa.has_settimeout = record["has_settimeout"]
         fa.has_timeout_kwarg = record["has_timeout_kwarg"]
+        fa.exit_held = tuple(record["exit_held"])
+        fa.lock_releases = tuple(record["lock_releases"])
+        fa.lock_imbalances = tuple(
+            tuple(i) for i in record["lock_imbalances"]
+        )
         scan.functions.append(fa)
         scan.methods.setdefault((cls, node.name), fa)
     scan.guards = [
         engine.GuardDecl(attr, lock, line, cls)
         for attr, lock, line, cls in data["guards"]
+    ]
+    scan.shared = [
+        engine.SharedDecl(attr, reason, line, cls)
+        for attr, reason, line, cls in data["shared"]
     ]
     scan.env_reads = [
         engine.EnvRead(name, line) for name, line in data["env_reads"]
@@ -276,10 +332,18 @@ class ScanCache:
                 self.adopted += 1
 
     def update(
-        self, modules: list[Module], violations: list[Violation]
+        self,
+        modules: list[Module],
+        violations: list[Violation],
+        replayable: bool = True,
     ) -> None:
         """Refresh the cache from a completed run (every module carries
-        a scan by then — the deadline rule's prepare pass sees to it)."""
+        a scan by then — the interprocedural program build sees to it).
+        ``replayable=False`` (a ``--diff`` run, whose report is
+        filtered) refreshes the per-file scans but withholds the
+        replay tier, so a later full run can never adopt a truncated
+        violation list — that is what keeps diff and full runs
+        byte-for-byte identical on shared files."""
         files = {}
         for module in modules:
             scan = getattr(module, "_engine_scan", None)
@@ -287,13 +351,29 @@ class ScanCache:
             if scan is None or sig is None:
                 continue
             files[module.path] = {"sig": sig, "scan": _dump_scan(scan)}
+        old = self._data
         self._data = {
             "version": CACHE_VERSION,
             "vocab": _vocab_fingerprint(modules),
             "files": files,
             "readmes": _readme_sigs([m.path for m in modules]),
-            "violations": [v.to_dict() for v in violations],
         }
+        if replayable:
+            self._data["violations"] = [v.to_dict() for v in violations]
+        elif (
+            old.get("violations") is not None
+            and old.get("vocab") == self._data["vocab"]
+            and old.get("readmes") == self._data["readmes"]
+            and {
+                path: entry.get("sig")
+                for path, entry in old.get("files", {}).items()
+            }
+            == {path: entry["sig"] for path, entry in files.items()}
+        ):
+            # a --diff run on an otherwise-unchanged tree must not
+            # destroy the replay tier a prior full run built: the old
+            # verdict still describes these exact bytes, so carry it
+            self._data["violations"] = old["violations"]
         try:
             tmp = self.path.with_suffix(".tmp")
             tmp.write_text(json.dumps(self._data))

@@ -192,6 +192,69 @@ class Builder:
         for node, label in frontier:
             node.edge(label, target)
 
+    def _lock_method_calls(self, root: ast.AST):
+        """Explicit ``<lock>.acquire()`` / ``<lock>.release()`` calls
+        inside ``root`` (nested defs/lambdas excluded), in source
+        order — the non-``with`` spelling of lock state, modelled as
+        the same lock events so the dataflow sees both."""
+        out: list[tuple[str, str, ast.Call]] = []
+        stack: list[ast.AST] = [root]
+        while stack:
+            sub = stack.pop()
+            if isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in ("acquire", "release")
+            ):
+                path = self._lock_path(sub.func.value)
+                if path is not None:
+                    out.append((sub.func.attr, path, sub))
+            stack.extend(ast.iter_child_nodes(sub))
+        out.sort(key=lambda item: (item[2].lineno, item[2].col_offset))
+        return out
+
+    def _stmt_lock_events(self, node: Node, stmt: ast.stmt) -> None:
+        for verb, path, _ in self._lock_method_calls(stmt):
+            node.events.append(
+                ("lock_acquire" if verb == "acquire" else "lock_release", path)
+            )
+
+    def _test_lock_events(self, node: Node, test: ast.expr) -> None:
+        """Lock events for a branch condition. The guarded-acquire
+        idiom — ``if lock.acquire(timeout=t):`` / ``if not
+        lock.acquire(...):`` — acquires only on the matching branch;
+        any other acquire/release inside a test is unconditional."""
+        inner = test
+        negated = False
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            inner = test.operand
+            negated = True
+        if (
+            isinstance(inner, ast.BoolOp)
+            and isinstance(inner.op, ast.And)
+            and not negated
+        ):
+            # `if lock.acquire(timeout=t) and cond:` — the true branch
+            # definitely holds; the acquired-but-cond-false path goes
+            # untracked (false negatives over false positives)
+            inner = inner.values[0]
+        for verb, path, call in self._lock_method_calls(test):
+            if verb == "acquire" and call is inner:
+                node.events.append(
+                    ("lock_acquire_branch", (path, "false" if negated else "true"))
+                )
+            else:
+                node.events.append(
+                    (
+                        "lock_acquire" if verb == "acquire" else "lock_release",
+                        path,
+                    )
+                )
+
     def _may_raise(self, stmt: ast.stmt) -> bool:
         if isinstance(stmt, (ast.Raise, ast.Assert)):
             return True
@@ -343,6 +406,7 @@ class Builder:
             return []
         # plain statement
         node = self._node("stmt", stmt)
+        self._stmt_lock_events(node, stmt)
         self._connect(frontier, node)
         if self._may_raise(stmt):
             self._route_exc(node)
@@ -352,6 +416,7 @@ class Builder:
 
     def _if(self, stmt: ast.If, frontier):
         test = self._node("test", stmt.test)
+        self._test_lock_events(test, stmt.test)
         self._connect(frontier, test)
         if self._may_raise(ast.Expr(value=stmt.test)):
             self._route_exc(test)
@@ -368,6 +433,7 @@ class Builder:
 
     def _while(self, stmt: ast.While, frontier):
         head = self._node("test", stmt.test)
+        self._test_lock_events(head, stmt.test)
         self._connect(frontier, head)
         if self._may_raise(ast.Expr(value=stmt.test)):
             self._route_exc(head)

@@ -9,7 +9,7 @@ from __future__ import annotations
 import ast
 from pathlib import Path
 
-from . import engine, protocols
+from . import engine, protocols, summaries
 from .core import Checker, Module, Violation, find_cycles, register
 
 _BROAD_EXCEPTIONS = {"Exception", "BaseException"}
@@ -38,28 +38,107 @@ def _scan(module: Module) -> engine.ModuleScan:
     # sequence on the same thread, so a plain memo on the module works.
     # The protocol/resource prepare passes run before any check, so the
     # vocabulary tables are already pinned on the module by scan time.
-    cached = getattr(module, "_engine_scan", None)
-    if cached is None:
-        cached = engine.scan_module(module)
-        module._engine_scan = cached  # type: ignore[attr-defined]
-    return cached
+    return engine.scan_cached(module)
+
+
+class InterproceduralChecker(Checker):
+    """Base for rules that consume call-site summaries: ``prepare``
+    pins the module set, ``_program`` materializes the whole-program
+    view lazily — at first *check*, after every prepare pass (protocol
+    table, factory vocabulary) has pinned what the scans depend on."""
+
+    cross_module = True  # a summary can change from another module
+
+    def __init__(self) -> None:
+        self._modules: list[Module] = []
+
+    def prepare(self, modules: list[Module]) -> None:
+        self._modules = modules
+
+    def _program(self) -> summaries.Program:
+        return summaries.program_for(self._modules)
+
+
+def _judge_borrow_escapes(
+    checker: InterproceduralChecker,
+    module: Module,
+    fa: engine.FunctionAnalysis,
+    resource: bool,
+) -> list[Violation]:
+    """The interprocedural half of the escape analysis: an obligation
+    whose only escape evidence is argument passing is re-judged
+    against the callees' ownership summaries. Ownership moved if ANY
+    pass lands in a callee that releases/stores/returns the parameter
+    — or in one the call graph cannot resolve (unknowable, so the old
+    benefit of the doubt stands). But when EVERY pass is proven a pure
+    borrow, the obligation came straight back and the leak is real."""
+    program = checker._program()
+    out: list[Violation] = []
+    for escape in fa.borrow_escapes:
+        if (escape.protocol == "resource") is not resource:
+            continue
+        borrowers: list[str] = []
+        proven = True
+        for name, kind, recv, line, pos, kwarg in escape.passes:
+            site = engine.CallSite(name, line, (), kind, recv, (), ())
+            callee = program.graph.resolve(module.path, fa, site)
+            if callee is None:
+                proven = False  # unknown callee may take ownership
+                break
+            params = program.params_of(callee)
+            if kwarg is not None:
+                bound = kwarg if kwarg in params else None
+            elif pos is not None and pos < len(params):
+                bound = params[pos]
+            else:
+                bound = None
+            if bound is None:
+                proven = False  # un-bindable (*args, expression arg)
+                break
+            summary = program.summary(callee)
+            if summary is None or bound in summary.owns_params:
+                proven = False  # the callee takes the obligation over
+                break
+            borrowers.append(f"{name}()")
+        if not proven or not borrowers:
+            continue
+        releases = "/".join(escape.release_names) or "a release method"
+        what = (
+            f"'{escape.var}' from a resource factory"
+            if resource
+            else f"protocol {escape.protocol}: '{escape.var}' acquired here"
+        )
+        out.append(
+            Violation(
+                checker.rule,
+                module.path,
+                escape.line,
+                f"{what} is only ever lent out — every callee it reaches "
+                f"({', '.join(sorted(set(borrowers)))}) merely borrows it "
+                f"and never releases or keeps it; release via {releases} "
+                "on every path, or move ownership for real",
+            )
+        )
+    return out
 
 
 @register
-class ProtocolChecker(Checker):
+class ProtocolChecker(InterproceduralChecker):
     """Lifecycle typestate: a method annotated ``# protocol: <name>
     acquire`` opens an obligation the same function must close through
     a matching ``release`` method on EVERY control-flow path —
     branches, early returns, and the exception edges of ``try``
     blocks — unless ownership explicitly escapes (returned, stored on
-    an object, handed to another callable). The dual runtime half is
+    an object, handed to another callable that provably keeps it: a
+    callee summary showing the parameter is only borrowed hands the
+    obligation straight back). The dual runtime half is
     ``analysis.runtime.ProtocolRecorder``. A release the engine proves
     already-released on every incoming path is a double release."""
 
     rule = "protocol"
-    cross_module = True  # the vocabulary is declared in other modules
 
     def prepare(self, modules: list[Module]) -> None:
+        super().prepare(modules)
         table = protocols.collect_table(modules)
         for module in modules:
             module._protocol_table = table  # type: ignore[attr-defined]
@@ -67,6 +146,7 @@ class ProtocolChecker(Checker):
     def check(self, module: Module) -> list[Violation]:
         out: list[Violation] = []
         for fa in _scan(module).functions:
+            out.extend(_judge_borrow_escapes(self, module, fa, resource=False))
             for leak in fa.leaks:
                 if leak.protocol == "resource":
                     continue
@@ -106,16 +186,29 @@ class ProtocolChecker(Checker):
 
 
 @register
-class GuardedByChecker(Checker):
+class GuardedByChecker(InterproceduralChecker):
     """Attributes annotated ``# guarded-by: <lock>`` may only be
     touched while that lock is held (per the CFG lock-state analysis,
     or via a ``# holds:`` def annotation). ``__init__`` is exempt: no
-    other thread can hold a reference during construction."""
+    other thread can hold a reference during construction. The
+    ``# holds:`` contract is enforced at call sites too: calling an
+    annotated method through ``self`` without actually holding its
+    declared locks is the caller's violation, summary-checked."""
 
     rule = "guarded-by"
+    # guard declarations, accesses, and (self-call) holds contracts all
+    # live in one module, so per-file staleness stays decidable; the
+    # base-class-in-another-module holds residue is accepted
+    cross_module = False
 
     def check(self, module: Module) -> list[Violation]:
         scan = _scan(module)
+        out: list[Violation] = []
+        out.extend(self._check_accesses(module, scan))
+        out.extend(self._check_holds_contracts(module, scan))
+        return out
+
+    def _check_accesses(self, module, scan) -> list[Violation]:
         guards: dict[tuple[str | None, str], str] = {}
         for decl in scan.guards:
             guards[(decl.class_name, decl.attr)] = decl.lock
@@ -146,20 +239,67 @@ class GuardedByChecker(Checker):
                 )
         return out
 
+    def _check_holds_contracts(self, module, scan) -> list[Violation]:
+        """A ``# holds: <lock>`` def annotation is a contract the
+        CALLER must honor. Only ``self.`` calls are judged — the
+        callee's lock paths are spelled relative to the same object
+        the caller's held set uses, so the two are comparable."""
+        program = self._program()
+        out: list[Violation] = []
+        seen: set[tuple[int, str]] = set()
+        for fa in scan.functions:
+            if fa.class_name is None or fa.node.name == "__init__":
+                continue
+            for site in fa.call_sites:
+                if site.kind != "self":
+                    continue
+                callee = program.graph.resolve(module.path, fa, site)
+                if callee is None or callee[0] != module.path:
+                    # same-module callees only: it keeps this rule's
+                    # findings (and suppression staleness) decidable
+                    # per file, which cross_module=False promises
+                    continue
+                summary = program.summary(callee)
+                if summary is None or not summary.requires:
+                    continue
+                missing = sorted(summary.requires - set(site.held))
+                if not missing:
+                    continue
+                key = (site.line, site.name)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(
+                    Violation(
+                        self.rule,
+                        module.path,
+                        site.line,
+                        f"'{site.name}()' declares `# holds: "
+                        f"{', '.join(missing)}` but this call does not "
+                        f"hold it (held: {list(site.held) or 'none'})",
+                    )
+                )
+        return out
+
 
 @register
-class BlockingUnderLockChecker(Checker):
+class BlockingUnderLockChecker(InterproceduralChecker):
     """No sleeps, joins, socket I/O, or future/event waits while any
     lock is held: a blocked holder turns every other thread that needs
     the lock into a convoy, and a blocked holder that also waits on
-    one of those threads is a deadlock."""
+    one of those threads is a deadlock. Summary-checked through calls:
+    a helper that blocks three hops down is flagged at the call made
+    under the lock, with the transitive blocking site named."""
 
     rule = "no-blocking-under-lock"
 
     def check(self, module: Module) -> list[Violation]:
         out: list[Violation] = []
+        program = self._program()
         for func in _scan(module).functions:
             for call in func.blocking:
+                if not call.held:
+                    continue  # the bare fact only feeds summaries
                 out.append(
                     Violation(
                         self.rule,
@@ -169,20 +309,69 @@ class BlockingUnderLockChecker(Checker):
                         f"{list(call.held)}",
                     )
                 )
+            seen: set[tuple[int, str]] = set()
+            for site in func.call_sites:
+                if not site.held or site.name in engine.BLOCKING_NAMES:
+                    continue  # direct blocking is reported above
+                callee = program.graph.resolve(module.path, func, site)
+                if callee is None:
+                    continue
+                summary = program.summary(callee)
+                if summary is None:
+                    continue
+                for block_name, block_path, block_line in sorted(
+                    summary.blocked_suppressed
+                ):
+                    # anchored AT the suppressed leaf: the one written
+                    # reason there covers this caller too, and the
+                    # match keeps the suppression from reading stale
+                    out.append(
+                        Violation(
+                            self.rule,
+                            block_path,
+                            block_line,
+                            f"blocking call '{block_name}()' is reached "
+                            f"while holding {list(site.held)} (via "
+                            f"'{site.name}()' at {module.path}:{site.line})",
+                        )
+                    )
+                if summary.may_block is None:
+                    continue
+                key = (site.line, site.name)
+                if key in seen:
+                    continue
+                seen.add(key)
+                block_name, block_path, block_line = summary.may_block
+                out.append(
+                    Violation(
+                        self.rule,
+                        module.path,
+                        site.line,
+                        f"call to '{site.name}()' while holding "
+                        f"{list(site.held)} may block: reaches "
+                        f"'{block_name}()' at {block_path}:{block_line}",
+                    )
+                )
         return out
 
 
 @register
-class LockOrderChecker(Checker):
+class LockOrderChecker(InterproceduralChecker):
     """The static lock-acquisition graph must be cycle-free. Nodes are
     class-qualified lock paths; an edge A->B is recorded whenever
     ``with B:`` executes while the engine proves A held (nested
-    ``with`` blocks, or a ``# holds: A`` function acquiring B)."""
+    ``with`` blocks, or a ``# holds: A`` function acquiring B) — and,
+    summary-checked, whenever a call made while A is held reaches a
+    function that acquires B, however many hops away: the cross-class
+    orders only the runtime recorder used to see."""
 
     rule = "lock-order"
-    cross_module = True  # a cycle can close through another module
+    # a cycle introduced by a changed file can anchor at an OLD edge in
+    # an unchanged module — --diff must never filter these out
+    global_anchor = True
 
     def __init__(self) -> None:
+        super().__init__()
         # edge -> first (path, line) that exhibits it
         self._edges: dict[tuple[str, str], tuple[str, int]] = {}
 
@@ -192,6 +381,7 @@ class LockOrderChecker(Checker):
         return f"{owner}.{path}"
 
     def check(self, module: Module) -> list[Violation]:
+        program = self._program()
         for func in _scan(module).functions:
             for acq in func.acquires:
                 new = self._ident(acq.class_name, module, acq.path)
@@ -202,6 +392,23 @@ class LockOrderChecker(Checker):
                     self._edges.setdefault(
                         (src, new), (module.path, acq.line)
                     )
+            for site in func.call_sites:
+                if not site.held:
+                    continue
+                callee = program.graph.resolve(module.path, func, site)
+                if callee is None:
+                    continue
+                summary = program.summary(callee)
+                if summary is None or not summary.acquires:
+                    continue
+                for held in site.held:
+                    src = self._ident(func.class_name, module, held)
+                    for acquired in summary.acquires:
+                        if src == acquired:
+                            continue
+                        self._edges.setdefault(
+                            (src, acquired), (module.path, site.line)
+                        )
         return []
 
     def finalize(self) -> list[Violation]:
@@ -229,17 +436,93 @@ class LockOrderChecker(Checker):
 
 
 @register
-class ResourceFinalizationChecker(Checker):
+class LockBalanceChecker(InterproceduralChecker):
+    """Explicit ``.acquire()`` calls must balance. Intraprocedurally: a
+    lock acquired explicitly and released on only SOME paths is the
+    classic leak (``with`` cannot leak — its exits release by
+    construction). Interprocedurally: a helper may deliberately return
+    holding (lock chaining), but then every ``self.`` caller owes the
+    release — a caller that never releases the handed-over lock,
+    directly or through a releasing helper, leaks it for good."""
+
+    rule = "lock-balance"
+
+    def check(self, module: Module) -> list[Violation]:
+        program = self._program()
+        out: list[Violation] = []
+        for fa in _scan(module).functions:
+            for path, line in fa.lock_imbalances:
+                out.append(
+                    Violation(
+                        self.rule,
+                        module.path,
+                        line,
+                        f"'{path}' is explicitly acquired here but released "
+                        "on only some paths (early return, exception, or a "
+                        "skipped branch); use `with`, or release in a "
+                        "`finally`",
+                    )
+                )
+            if fa.class_name is None:
+                continue
+            caller_key = (module.path, fa.class_name, fa.node.name)
+            caller_summary = program.summary(caller_key)
+            releases = (
+                caller_summary.releases
+                if caller_summary is not None
+                else frozenset(fa.lock_releases)
+            )
+            if program.graph.reverse.get(caller_key):
+                # this caller propagates the hand-off upward (its own
+                # summary carries exit_held), and SOMEONE calls it —
+                # the judgment belongs at the top of the chain, where
+                # no caller is left to release. A mid-chain delegator
+                # above a releasing top caller is correct code.
+                continue
+            seen: set[tuple[int, str]] = set()
+            for site in fa.call_sites:
+                if site.kind != "self":
+                    continue
+                callee = program.graph.resolve(module.path, fa, site)
+                if callee is None:
+                    continue
+                summary = program.summary(callee)
+                if summary is None or not summary.exit_held:
+                    continue
+                leaked = sorted(summary.exit_held - releases)
+                if not leaked:
+                    continue
+                key = (site.line, site.name)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(
+                    Violation(
+                        self.rule,
+                        module.path,
+                        site.line,
+                        f"'{site.name}()' returns still holding "
+                        f"{leaked} and {fa.node.name}() never releases "
+                        "it — a cross-function lock leak",
+                    )
+                )
+        return out
+
+
+@register
+class ResourceFinalizationChecker(InterproceduralChecker):
     """A socket/file/tempfile created in a function must reach
     close/unlink on every CFG path — including the exception edges of
-    any enclosing ``try`` — unless ownership escapes. This is the
-    protocol typestate machinery applied to the builtin "resource"
-    protocol whose acquire set is the factory vocabulary."""
+    any enclosing ``try`` — unless ownership escapes (summary-checked:
+    handing the handle to a callee proven to only borrow it is not an
+    escape). This is the protocol typestate machinery applied to the
+    builtin "resource" protocol whose acquire set is the factory
+    vocabulary."""
 
     rule = "resource-finalization"
-    cross_module = True  # `# resource-factory` defs extend the rule remotely
 
     def prepare(self, modules: list[Module]) -> None:
+        super().prepare(modules)
         factories = set(_RESOURCE_FACTORIES)
         for module in modules:
             if not module.factory_lines:
@@ -263,6 +546,7 @@ class ResourceFinalizationChecker(Checker):
     def check(self, module: Module) -> list[Violation]:
         out: list[Violation] = []
         for fa in _scan(module).functions:
+            out.extend(_judge_borrow_escapes(self, module, fa, resource=True))
             for leak in fa.leaks:
                 if leak.protocol != "resource":
                     continue
@@ -358,6 +642,10 @@ class ExceptionHygieneChecker(Checker):
         out = []
         for fa in scan.functions:
             for spawn in fa.thread_spawns:
+                if spawn.via == "submit":
+                    # an executor captures the exception in its Future;
+                    # nothing dies silently — out of this rule's scope
+                    continue
                 resolved = self._resolve_target(
                     spawn.kind, spawn.target_name, scan.methods,
                     spawn.class_name,
@@ -465,14 +753,16 @@ class ExceptionHygieneChecker(Checker):
 
 
 @register
-class BlockingDeadlineChecker(Checker):
+class BlockingDeadlineChecker(InterproceduralChecker):
     """Every blocking call reachable from daemon/worker code — socket
     ops, ``wait()``/``join()``/``get()``/``result()``, explicit lock
     ``acquire()`` — must carry a finite deadline or a registered
-    cancel hook. Reachability is a name-based call-graph walk rooted
-    at the daemon package and every ``threading.Thread`` target; an
-    un-cancellable wait anywhere on those paths is exactly the wedged-
-    worker class the watchdog PRs spent review rounds hunting.
+    cancel hook. Reachability walks the RESOLVED call graph rooted at
+    the daemon package and every ``threading.Thread`` target (the old
+    name-based walk — any function sharing a name with anything a
+    worker called — is gone); an un-cancellable wait anywhere on those
+    paths is exactly the wedged-worker class the watchdog PRs spent
+    review rounds hunting.
 
     What satisfies the audit, per call shape:
 
@@ -491,44 +781,37 @@ class BlockingDeadlineChecker(Checker):
       reason is the review artifact, like suppressions)."""
 
     rule = "blocking-deadline"
-    cross_module = True  # reachability crosses modules
 
     _DAEMON_MARKERS = ("/daemon/", "\\daemon\\")
 
     def __init__(self) -> None:
-        self._reachable: set[int] = set()
+        super().__init__()
+        self._reachable: set[int] | None = None
 
     def prepare(self, modules: list[Module]) -> None:
-        by_name: dict[str, list[engine.FunctionAnalysis]] = {}
-        scans = []
-        for module in modules:
-            scan = _scan(module)
-            scans.append((module, scan))
-            for fa in scan.functions:
-                by_name.setdefault(fa.node.name, []).append(fa)
+        super().prepare(modules)
+        self._reachable = None
 
-        roots: list[engine.FunctionAnalysis] = []
-        for module, scan in scans:
-            is_daemon = any(
-                marker in module.path for marker in self._DAEMON_MARKERS
-            )
-            for fa in scan.functions:
-                if is_daemon:
-                    roots.append(fa)
-                for spawn in fa.thread_spawns:
-                    if spawn.target_name:
-                        roots.extend(by_name.get(spawn.target_name, ()))
-
-        work = list(roots)
-        while work:
-            fa = work.pop()
-            if id(fa) in self._reachable:
-                continue
-            self._reachable.add(id(fa))
-            for name in fa.calls:
-                for target in by_name.get(name, ()):
-                    if id(target) not in self._reachable:
-                        work.append(target)
+    def _reachable_ids(self) -> set[int]:
+        """ids of every FunctionAnalysis on a resolved call path from
+        a daemon function or a thread target (lazy: the program view
+        needs every other prepare pass done first)."""
+        if self._reachable is not None:
+            return self._reachable
+        program = self._program()
+        roots: list = []
+        for key, fa in program.graph.functions.items():
+            if any(marker in key[0] for marker in self._DAEMON_MARKERS):
+                roots.append(key)
+            for spawn in fa.thread_spawns:
+                target = program.graph.resolve_spawn(key[0], fa, spawn)
+                if target is not None:
+                    roots.append(target)
+        self._reachable = {
+            id(program.function(k))
+            for k in program.reachable_from(roots)
+        }
+        return self._reachable
 
     def _class_evidence(self, scan: engine.ModuleScan) -> set[str | None]:
         """Classes with any deadline discipline in view: a settimeout
@@ -562,9 +845,10 @@ class BlockingDeadlineChecker(Checker):
     def check(self, module: Module) -> list[Violation]:
         scan = _scan(module)
         evidence = self._class_evidence(scan)
+        reachable = self._reachable_ids()
         out: list[Violation] = []
         for fa in scan.functions:
-            if id(fa) not in self._reachable:
+            if id(fa) not in reachable:
                 continue
             for site in fa.deadline_sites:
                 complaint = self._judge(fa, site, evidence)

@@ -33,6 +33,16 @@ _PROTOCOL_RE = re.compile(
 # the wait is bounded — a cancel hook, a socket timeout set at
 # creation, a supervisor. The reason is REQUIRED, like suppressions.
 _DEADLINE_RE = re.compile(r"^deadline:\s*(.*)$")
+# `# thread-role: <name>` on a threading.Thread(...) spawn site names
+# the role of the spawned thread for the thread-role race rule
+# (analysis/races.py); functions reachable from the spawn target run
+# under that role.
+_ROLE_RE = re.compile(r"^thread-role:\s*([A-Za-z0-9_-]+)\s*$")
+# `# shared-by-design: <reason>` on a field's initialization declares
+# that multi-role access without a common lock is intentional (GIL-
+# atomic ops, monotonic flags, torn-read-tolerant diagnostics). The
+# reason is REQUIRED, like suppressions.
+_SHARED_RE = re.compile(r"^shared-by-design:\s*(.*)$")
 
 SUPPRESSION_RULE = "suppression"
 
@@ -90,6 +100,12 @@ class Module:
         # comment line also covers the following line, like suppressions
         self.deadline_lines: dict[int, str] = {}
         self._standalone_deadline_lines: set[int] = set()
+        # line -> role name from a `# thread-role:` spawn annotation;
+        # a standalone comment line also covers the following line
+        self.role_lines: dict[int, str] = {}
+        self._standalone_role_lines: set[int] = set()
+        # line -> reason from a `# shared-by-design:` field annotation
+        self.shared_lines: dict[int, str] = {}
         self._scan_comments()
 
     @classmethod
@@ -133,6 +149,14 @@ class Module:
                     self.deadline_lines[line] = match.group(1).strip()
                     if tok.line[: tok.start[1]].strip() == "":
                         self._standalone_deadline_lines.add(line)
+                match = _ROLE_RE.match(text)
+                if match:
+                    self.role_lines[line] = match.group(1)
+                    if tok.line[: tok.start[1]].strip() == "":
+                        self._standalone_role_lines.add(line)
+                match = _SHARED_RE.match(text)
+                if match:
+                    self.shared_lines[line] = match.group(1).strip()
         except (tokenize.TokenError, IndentationError):
             pass  # ast.parse already succeeded; treat as comment-free
 
@@ -144,6 +168,18 @@ class Module:
             return reason
         if line - 1 in self._standalone_deadline_lines:
             return self.deadline_lines.get(line - 1) or None
+        return None
+
+    def role_for(self, start: int, end: int) -> str | None:
+        """The `# thread-role:` name covering a spawn statement that
+        spans ``start``..``end``: on any of those lines, or on a
+        standalone comment line directly above."""
+        for line in range(start, end + 1):
+            role = self.role_lines.get(line)
+            if role:
+                return role
+        if start - 1 in self._standalone_role_lines:
+            return self.role_lines.get(start - 1)
         return None
 
     def holds_for(self, func: ast.AST) -> tuple[str, ...]:
@@ -281,7 +317,12 @@ class Analyzer:
         # it silences may need a module that is not being analyzed.
         self._full_scope = full_scope
 
-    def run(self, paths: list[str | Path], scan_cache=None) -> list[Violation]:
+    def run(
+        self,
+        paths: list[str | Path],
+        scan_cache=None,
+        report_paths: set[str] | None = None,
+    ) -> list[Violation]:
         """Analyze ``paths``; returns unsuppressed violations, plus a
         ``suppression`` violation per reasonless ignore and per stale
         ignore (one that matched no finding — judged for cross-module
@@ -289,7 +330,16 @@ class Analyzer:
 
         ``scan_cache`` (a ``cache.ScanCache``) lets unchanged files
         adopt their stored engine scans instead of rebuilding CFGs;
-        every checker still runs live, so results are identical."""
+        every checker still runs live, so results are identical.
+
+        ``report_paths`` (the ``--diff`` mode) restricts the REPORT to
+        those files while the analysis itself still runs over all of
+        ``paths`` — interprocedural judgments (summaries, reachability,
+        the lock-order graph) need the whole scope in view, which is
+        what makes a diff run agree byte-for-byte with a full run on
+        the files both report on. It may be a callable
+        ``(modules) -> set[str]`` evaluated after the checks, so the
+        caller can fold in reverse call-graph dependents."""
         modules: list[Module] = []
         violations: list[Violation] = []
         for path in paths:
@@ -303,6 +353,9 @@ class Analyzer:
                 )
         if scan_cache is not None:
             scan_cache.adopt(modules)
+        # exposed so the CLI can emit the call-graph/summary artifact
+        # from this run's memoized program instead of re-deriving it
+        self.last_modules = modules
         for checker in self._checkers:
             checker.prepare(modules)
         by_path = {m.path: m for m in modules}
@@ -311,6 +364,8 @@ class Analyzer:
                 violations.extend(checker.check(module))
         for checker in self._checkers:
             violations.extend(checker.finalize())
+        if callable(report_paths):
+            report_paths = report_paths(modules)
 
         kept: list[Violation] = []
         used: set[tuple[str, int, str]] = set()
@@ -335,6 +390,8 @@ class Analyzer:
             c.rule for c in self._checkers if c.cross_module
         }
         for module in modules:
+            if report_paths is not None and module.path not in report_paths:
+                continue
             for line, entries in sorted(module.suppressions.items()):
                 for rule, reason in entries:
                     if not reason:
@@ -363,7 +420,24 @@ class Analyzer:
                         )
         kept.sort(key=lambda v: (v.path, v.line, v.rule))
         if scan_cache is not None:
-            scan_cache.update(modules, kept)
+            # a filtered (--diff) report must never land in the replay
+            # tier: a later full run would adopt the truncated list
+            scan_cache.update(modules, kept, replayable=report_paths is None)
+        if report_paths is not None:
+            # rules whose violations anchor wherever the whole-program
+            # judgment lands (a lock-order cycle at an old edge, a race
+            # at a store in an unchanged module) are never filtered: a
+            # diff run that hid them would pass pre-commit and fail CI
+            global_rules = {
+                c.rule
+                for c in self._checkers
+                if getattr(c, "global_anchor", False)
+            }
+            kept = [
+                v
+                for v in kept
+                if v.path in report_paths or v.rule in global_rules
+            ]
         return kept
 
 

@@ -204,6 +204,54 @@ class ThreadSpawn:
     target_name: str | None  # terminal name of the target callable
     kind: str  # "self" (self.method) | "name" (bare identifier) | "other"
     class_name: str | None
+    role: str | None = None  # from a `# thread-role:` spawn annotation
+    # "thread" (threading.Thread/Timer: an escaped exception kills the
+    # thread silently) or "submit" (executor: the Future captures it)
+    via: str = "thread"
+
+
+@dataclass
+class CallSite:
+    """One call expression with the caller's solved lock state — the
+    seam every interprocedural rule consumes summaries through."""
+
+    name: str
+    line: int
+    held: tuple[str, ...]
+    # how the callee is spelled: "bare" (name()), "self" (self.m()),
+    # "cls" (cls.m()), "selfattr" (self._x.m(), recv = dotted path),
+    # "attr" (X.m(), recv = X), "dotted" (a.b.m(), recv = "a.b"),
+    # "other" (dynamic — out of static reach)
+    kind: str
+    recv: str | None
+    pos_names: tuple  # positional args that are plain Names (else None)
+    kw_names: tuple  # (kwarg, var-name) pairs for plain-Name kwargs
+
+
+@dataclass
+class SharedDecl:
+    """A `# shared-by-design: <reason>` field annotation."""
+
+    attr: str
+    reason: str
+    line: int
+    class_name: str | None
+
+
+@dataclass
+class BorrowEscape:
+    """An obligation whose only escape evidence is being passed as an
+    argument: the intraprocedural engine grants the escape (ownership
+    may have moved), and the interprocedural protocol pass re-judges
+    it against the callees' summaries — a callee proven to only BORROW
+    the value hands the obligation straight back."""
+
+    protocol: str
+    var: str
+    line: int  # acquisition site
+    release_names: tuple[str, ...]
+    # (name, kind, recv, line, pos_index | None, kwarg | None) per pass
+    passes: tuple = ()
 
 
 @dataclass
@@ -242,9 +290,18 @@ class FunctionAnalysis:
     leaks: list[ObligationLeak] = field(default_factory=list)
     double_releases: list[DoubleRelease] = field(default_factory=list)
     thread_spawns: list[ThreadSpawn] = field(default_factory=list)
+    call_sites: list[CallSite] = field(default_factory=list)
+    borrow_escapes: list[BorrowEscape] = field(default_factory=list)
     calls: set[str] = field(default_factory=set)
     has_settimeout: bool = False
     has_timeout_kwarg: bool = False
+    # explicit (.acquire()/.release()) lock balance facts: locks still
+    # held on EVERY normal exit (a deliberate hand-off to the caller),
+    # locks explicitly released anywhere, and (path, acquire-line)
+    # pairs held on only SOME exit — the intraprocedural lock leak
+    exit_held: tuple[str, ...] = ()
+    lock_releases: tuple[str, ...] = ()
+    lock_imbalances: tuple = ()
 
 
 @dataclass
@@ -252,6 +309,7 @@ class ModuleScan:
     module: Module
     functions: list[FunctionAnalysis] = field(default_factory=list)
     guards: list[GuardDecl] = field(default_factory=list)
+    shared: list[SharedDecl] = field(default_factory=list)
     env_reads: list[EnvRead] = field(default_factory=list)
     # (class_name | None, def name) -> FunctionAnalysis, for thread-
     # target resolution and the call-graph reachability pass
@@ -386,6 +444,18 @@ def scan_module(module: Module) -> ModuleScan:
 EMPTY_FACTORIES: frozenset[str] = frozenset()
 
 
+def scan_cached(module: Module) -> ModuleScan:
+    """The module's (memoized) engine scan — one per Analyzer run;
+    checkers run in sequence on one thread, so a plain memo works. The
+    protocol/resource prepare passes run before any check, so the
+    vocabulary tables are pinned on the module by scan time."""
+    cached = getattr(module, "_engine_scan", None)
+    if cached is None:
+        cached = scan_module(module)
+        module._engine_scan = cached  # type: ignore[attr-defined]
+    return cached
+
+
 def _lexical_aliases(func: ast.FunctionDef) -> dict[str, str]:
     """Final-state local alias map (``session = self._session``). The
     old walker resolved aliases incrementally; resolving against the
@@ -428,12 +498,31 @@ class _LockAnalysis(dataflow.Analysis):
         return out
 
     def transfer(self, node, state):
+        branch = None
         for verb, payload in node.events:
             if verb == "lock_acquire":
                 state = state | {payload}
             elif verb == "lock_release":
                 state = state - {payload}
+            elif verb == "lock_acquire_branch":
+                # `if lock.acquire(timeout=t):` — held on one branch only
+                branch = payload
+        if branch is not None:
+            path, label = branch
+            return {label: state | {path}, None: state}
         return state
+
+
+class _MayLockAnalysis(_LockAnalysis):
+    """May-held lock set (union at joins): the complement analysis the
+    explicit-acquire balance check needs — a lock in MAY-held but not
+    MUST-held at an exit was released on some paths only."""
+
+    def join(self, states):
+        out = frozenset()
+        for state in states:
+            out = out | state
+        return out
 
 
 @dataclass
@@ -594,6 +683,7 @@ def _scan_function(
         lock_paths=lock_path,
     ).build()
 
+    _refine_flag_acquires(graph)
     base_held = frozenset(module.holds_for(func))
     lock_in = dataflow.solve(graph, _LockAnalysis(base_held))
 
@@ -616,6 +706,9 @@ def _scan_function(
     fa.thread_spawns = _dedupe(
         fa.thread_spawns, lambda t: (t.line, t.target_name, t.kind)
     )
+    fa.call_sites = _dedupe(
+        fa.call_sites, lambda c: (c.name, c.line, c.kind, c.recv, c.held)
+    )
     # -- lock-order acquisition edges ----------------------------------
     for node in graph.nodes:
         state = lock_in.get(id(node))
@@ -633,10 +726,126 @@ def _scan_function(
                     )
                 )
                 state = state | {payload}
+            elif verb == "lock_acquire_branch":
+                fa.acquires.append(
+                    LockAcquire(
+                        payload[0],
+                        node.line,
+                        tuple(sorted(state)),
+                        func.name,
+                        class_name,
+                    )
+                )
+    fa.acquires = _dedupe(
+        fa.acquires, lambda a: (a.path, a.line, a.held)
+    )
+
+    # -- explicit-acquire lock balance ---------------------------------
+    _explicit_lock_balance(fa, graph, lock_in, base_held)
 
     # -- typestate ------------------------------------------------------
-    _run_typestate(fa, module, func, graph, table, factories)
+    _run_typestate(fa, module, func, graph, table, factories, aliases)
     return fa
+
+
+def _refine_flag_acquires(graph: cfglib.CFG) -> None:
+    """The assign-then-check spelling of a guarded acquire:
+    ``got = lock.acquire(timeout=t)`` followed by ``if got:`` /
+    ``if not got:``. The CFG builder records an unconditional acquire
+    on the assignment; when a test on the flag exists, move the
+    acquisition onto the matching branch — exactly what the inline
+    ``if lock.acquire(...):`` form gets. (The short window between
+    the assignment and the test goes untracked — false negatives over
+    false positives, as everywhere.) A flag nobody tests keeps the
+    unconditional event."""
+    # flag name -> assignments, in source order: a flag may be reused
+    # for sequential acquires; each test refines the nearest PRECEDING
+    # assignment (line-ordered — an approximation, like aliasing)
+    flags: dict[str, list] = {}
+    for node in graph.nodes:
+        stmt = node.ast_node
+        if (
+            node.kind == "stmt"
+            and isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and isinstance(stmt.value, ast.Call)
+            and isinstance(stmt.value.func, ast.Attribute)
+            and stmt.value.func.attr == "acquire"
+        ):
+            acquired = [p for v, p in node.events if v == "lock_acquire"]
+            if len(acquired) == 1:
+                flags.setdefault(stmt.targets[0].id, []).append(
+                    [node, acquired[0], stmt.lineno, False]
+                )
+    if not flags:
+        return
+    for entries in flags.values():
+        entries.sort(key=lambda e: e[2])
+    for node in graph.nodes:
+        if node.kind != "test":
+            continue
+        expr = node.ast_node
+        negated = isinstance(expr, ast.UnaryOp) and isinstance(
+            expr.op, ast.Not
+        )
+        inner = expr.operand if negated else expr
+        if not (isinstance(inner, ast.Name) and inner.id in flags):
+            continue
+        test_line = getattr(expr, "lineno", 0)
+        preceding = [
+            e for e in flags[inner.id] if e[2] <= test_line
+        ]
+        if not preceding:
+            continue
+        entry = preceding[-1]
+        node.events.append(
+            ("lock_acquire_branch", (entry[1], "false" if negated else "true"))
+        )
+        entry[3] = True
+    for entries in flags.values():
+        for node, path, _, moved in entries:
+            if moved:
+                node.events.remove(("lock_acquire", path))
+
+
+def _explicit_lock_balance(
+    fa: FunctionAnalysis,
+    graph: cfglib.CFG,
+    lock_in: dict,
+    base_held: frozenset[str],
+) -> None:
+    """Balance facts for locks acquired through explicit ``.acquire()``
+    calls (``with`` blocks release on every exit by construction, so
+    only explicit acquires can leak). MUST-held at the normal exit is
+    a deliberate hand-off the caller owes a release for; a path in
+    MAY-held but not MUST-held at either exit was released on some
+    paths only — the classic lock leak."""
+    explicit_sites: dict[str, int] = {}
+    releases: set[str] = set()
+    for node in graph.nodes:
+        if node.kind not in ("stmt", "test"):
+            continue
+        for verb, payload in node.events:
+            if verb == "lock_acquire":
+                explicit_sites.setdefault(payload, node.line)
+            elif verb == "lock_acquire_branch":
+                explicit_sites.setdefault(payload[0], node.line)
+            elif verb == "lock_release":
+                releases.add(payload)
+    fa.lock_releases = tuple(sorted(releases))
+    if not explicit_sites:
+        return
+    may_in = dataflow.solve(graph, _MayLockAnalysis(base_held))
+    must_exit = lock_in.get(id(graph.exit)) or frozenset()
+    may_exit = may_in.get(id(graph.exit)) or frozenset()
+    may_exc = may_in.get(id(graph.exit_exc)) or frozenset()
+    explicit = frozenset(explicit_sites)
+    fa.exit_held = tuple(sorted((must_exit & explicit) - base_held))
+    leaked = ((may_exit | may_exc) - must_exit) & explicit
+    fa.lock_imbalances = tuple(
+        sorted((path, explicit_sites[path]) for path in leaked)
+    )
 
 
 def _dedupe(items: list, key) -> list:
@@ -693,7 +902,11 @@ def _extract_facts(
                 if name is None:
                     continue
                 fa.calls.add(name)
-                if name in BLOCKING_NAMES and held:
+                fa.call_sites.append(_call_site(sub, name, held, aliases))
+                if name in BLOCKING_NAMES:
+                    # recorded even with no lock held: the bare fact
+                    # feeds may-block summaries; the under-lock rule
+                    # filters on `held` itself
                     fa.blocking.append(BlockingCall(name, sub.lineno, held))
                 if name == "settimeout" or name == "setdefaulttimeout":
                     fa.has_settimeout = True
@@ -703,6 +916,37 @@ def _extract_facts(
                     fa.deadline_sites.append(
                         _deadline_site(sub, name, aliases, node)
                     )
+                if name in ("submit", "_submit") and sub.args:
+                    # executor hand-off: the first positional arg runs
+                    # on a pool thread — a spawn site for role
+                    # purposes (`# thread-role:` applies here too)
+                    target = sub.args[0]
+                    kind = None
+                    if (
+                        isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                    ):
+                        kind = "self"
+                    elif isinstance(target, ast.Attribute):
+                        kind = "method"  # resolved by unique name
+                    elif isinstance(target, ast.Name):
+                        kind = "name"
+                    if kind is not None:
+                        fa.thread_spawns.append(
+                            ThreadSpawn(
+                                sub.lineno,
+                                terminal_name(target),
+                                kind,
+                                class_name,
+                                role=scan.module.role_for(
+                                    sub.lineno,
+                                    getattr(sub, "end_lineno", sub.lineno)
+                                    or sub.lineno,
+                                ),
+                                via="submit",
+                            )
+                        )
                 if name in ("Thread", "Timer"):
                     target = next(
                         (
@@ -733,8 +977,53 @@ def _extract_facts(
                                 else None,
                                 kind,
                                 class_name,
+                                role=scan.module.role_for(
+                                    sub.lineno,
+                                    getattr(sub, "end_lineno", sub.lineno)
+                                    or sub.lineno,
+                                ),
                             )
                         )
+
+
+def _call_site(
+    call: ast.Call, name: str, held: tuple[str, ...], aliases: dict[str, str]
+) -> CallSite:
+    func = call.func
+    kind = "other"
+    recv: str | None = None
+    if isinstance(func, ast.Name):
+        kind = "bare"
+    elif isinstance(func, ast.Attribute):
+        val = func.value
+        if isinstance(val, ast.Name) and val.id == "self":
+            kind = "self"
+        elif isinstance(val, ast.Name) and val.id == "cls":
+            kind = "cls"
+        else:
+            self_path = dotted_from_self(val, aliases)
+            if self_path is not None:
+                kind, recv = "selfattr", self_path
+            elif isinstance(val, ast.Name):
+                kind, recv = "attr", val.id
+            elif isinstance(val, ast.Attribute):
+                parts: list[str] = []
+                cur: ast.AST = val
+                while isinstance(cur, ast.Attribute):
+                    parts.append(cur.attr)
+                    cur = cur.value
+                if isinstance(cur, ast.Name):
+                    parts.append(cur.id)
+                    kind, recv = "dotted", ".".join(reversed(parts))
+    pos_names = tuple(
+        arg.id if isinstance(arg, ast.Name) else None for arg in call.args
+    )
+    kw_names = tuple(
+        (kw.arg, kw.value.id)
+        for kw in call.keywords
+        if kw.arg is not None and isinstance(kw.value, ast.Name)
+    )
+    return CallSite(name, call.lineno, held, kind, recv, pos_names, kw_names)
 
 
 def _deadline_site(
@@ -811,6 +1100,12 @@ def _note_guard_decl(
                     GuardDecl(target.attr, lock, stmt.lineno, class_name)
                 )
                 return
+            shared = module.shared_lines.get(line)
+            if shared is not None:
+                scan.shared.append(
+                    SharedDecl(target.attr, shared, stmt.lineno, class_name)
+                )
+                return
 
 
 # -- typestate wiring ---------------------------------------------------------
@@ -858,6 +1153,7 @@ def _run_typestate(
     graph: cfglib.CFG,
     table: ProtocolTable,
     factories: frozenset[str],
+    aliases: dict[str, str] | None = None,
 ) -> None:
     # 1. find acquisition sites and their bound locals
     acquired_vars: dict[tuple[str, str], list[int]] = {}  # (var, proto) -> sites
@@ -1052,11 +1348,31 @@ def _run_typestate(
 
     tracked_vars = {var for var, _ in acquired_vars}
     released_vars = {var for var, _ in has_release}
-    escaped = {
-        var
-        for var in tracked_vars
-        if _escapes(func, var, table, retained=var in released_vars)
-    }
+    escaped: set[str] = set()
+    for var in tracked_vars:
+        verdict, passes = _escape_verdict(
+            func, var, table, retained=var in released_vars, aliases=aliases
+        )
+        if verdict is None:
+            continue
+        escaped.add(var)
+        if verdict != "passes":
+            continue
+        # escape granted ONLY because the var was handed to callables:
+        # record the passes so the interprocedural protocol pass can
+        # re-judge against the callees' ownership summaries
+        for (v, proto), sites in sorted(acquired_vars.items()):
+            if v != var or (v, proto) in has_release:
+                continue
+            fa.borrow_escapes.append(
+                BorrowEscape(
+                    proto,
+                    var,
+                    min(sites),
+                    tuple(sorted(release_names_by_proto.get(proto, ()))),
+                    passes=tuple(passes),
+                )
+            )
 
     # drop escaped vars from the action stream entirely
     for node_id, acts in list(actions.items()):
@@ -1165,22 +1481,35 @@ def _escapes_at_use(node: cfglib.Node, call: ast.Call) -> bool:
     return not (isinstance(stmt, ast.Expr) and stmt.value is call)
 
 
-def _escapes(
-    func: ast.FunctionDef, var: str, table: ProtocolTable, retained: bool = False
-) -> bool:
-    """Function-wide ownership escape for ``var``: returned/yielded,
-    stored beyond a plain local, or handed to a callable that is not
-    part of the protocol's own acquire/release vocabulary. The last
-    form is a BORROW, not a move, when the function releases the var
-    itself somewhere (``retained``) — a worker passing its job token
-    into ``download(token=...)`` and detaching it on settle still owns
-    the obligation, and the rule must check every settle path."""
+def _escape_verdict(
+    func: ast.FunctionDef,
+    var: str,
+    table: ProtocolTable,
+    retained: bool = False,
+    aliases: dict[str, str] | None = None,
+) -> tuple[str | None, list]:
+    """Function-wide ownership escape for ``var``. Verdicts:
+
+    - ``"moved"`` — returned/yielded, stored beyond a plain local, or
+      handed to a constructor: ownership definitively left;
+    - ``"passes"`` — the ONLY escape evidence is being passed as an
+      argument to callables (listed in the second result): ownership
+      may have moved, but the interprocedural protocol pass re-judges
+      against the callees' summaries — a callee proven to only borrow
+      the value hands the obligation straight back;
+    - ``None`` — no escape. Argument passing is a BORROW, not a move,
+      when the function releases the var itself somewhere
+      (``retained``) — a worker passing its job token into
+      ``download(token=...)`` and detaching it on settle still owns
+      the obligation, and the rule must check every settle path."""
     vocab = set(table.by_callsite)
+    aliases = aliases or {}
+    passes: list = []
     for node in own_statements(func):
         if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
             value = getattr(node, "value", None)
             if value is not None and _mentions(value, var):
-                return True
+                return "moved", []
         if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
             targets = (
                 node.targets if isinstance(node, ast.Assign) else [node.target]
@@ -1190,11 +1519,11 @@ def _escapes(
             )
             value = getattr(node, "value", None)
             if stores_elsewhere and value is not None and _mentions(value, var):
-                return True
+                return "moved", []
         for sub in walk_pruned(node):
             if isinstance(sub, (ast.Yield, ast.YieldFrom)):
                 if sub.value is not None and _mentions(sub.value, var):
-                    return True
+                    return "moved", []
             if not isinstance(sub, ast.Call):
                 continue
             name = terminal_name(sub.func)
@@ -1216,13 +1545,37 @@ def _escapes(
                 # Wrapper(fh)) moves ownership into the built object —
                 # even when this function also releases on an early
                 # error path before the wrapper exists
-                return True
+                return "moved", []
             if retained:
                 continue  # argument passing is a borrow, not a move
-            for arg in list(sub.args) + [kw.value for kw in sub.keywords]:
+            if name is None:
+                # dynamic callee (handlers[0](sock), factory()(fh)):
+                # nothing to resolve a summary against, so the old
+                # benefit of the doubt stands — ownership moved
+                if any(
+                    _mentions(arg, var)
+                    for arg in list(sub.args)
+                    + [kw.value for kw in sub.keywords]
+                ):
+                    return "moved", []
+                continue
+            for index, arg in enumerate(sub.args):
                 if _mentions(arg, var):
-                    return True
-    return False
+                    site = _call_site(sub, name, (), aliases)
+                    pos = index if isinstance(arg, ast.Name) else None
+                    passes.append(
+                        (name, site.kind, site.recv, sub.lineno, pos, None)
+                    )
+            for kw in sub.keywords:
+                if _mentions(kw.value, var):
+                    site = _call_site(sub, name, (), aliases)
+                    kwarg = kw.arg if isinstance(kw.value, ast.Name) else None
+                    passes.append(
+                        (name, site.kind, site.recv, sub.lineno, None, kwarg)
+                    )
+    if passes:
+        return "passes", passes
+    return None, []
 
 
 # -- env reads ----------------------------------------------------------------

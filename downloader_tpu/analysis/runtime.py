@@ -81,12 +81,21 @@ class _RecordingLock:
         self._site = site
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        shaker = self._recorder._shaker
+        if shaker is not None:
+            # BEFORE the acquire: any lock this thread already holds
+            # stays held across the yield — the widened window is
+            # exactly where latent inversions interleave
+            shaker.perturb(self._site)
         got = self._inner.acquire(blocking, timeout)
         if got:
             self._recorder._note_acquire(self._site)
         return got
 
     def release(self) -> None:
+        shaker = self._recorder._shaker
+        if shaker is not None:
+            shaker.perturb(self._site)  # extend the hold: same reason
         self._inner.release()
         self._recorder._note_release(self._site)
 
@@ -140,7 +149,12 @@ class _RecordingLock:
 
 
 class LockOrderRecorder:
-    def __init__(self) -> None:
+    def __init__(self, shaker=None) -> None:
+        # optional analysis.schedules.ScheduleShaker: deterministic
+        # yields injected at every intercepted acquire/release, so the
+        # suites running under this recorder explore perturbed
+        # interleavings instead of only the scheduler's favorite one
+        self._shaker = shaker
         # (held_site, acquired_site) -> observation count
         self._edges: dict[tuple[str, str], int] = defaultdict(int)
         self._edges_lock = _REAL_LOCK()
@@ -283,8 +297,9 @@ class ProtocolRecorder:
     released (``complete_multipart``'s failure path must still reach
     ``abort_multipart``)."""
 
-    def __init__(self, protocols: dict | None = None):
+    def __init__(self, protocols: dict | None = None, shaker=None):
         self._protocols = RUNTIME_PROTOCOLS if protocols is None else protocols
+        self._shaker = shaker  # see LockOrderRecorder: same contract
         self._lock = _REAL_LOCK()
         # (protocol, key) -> {"site": file:line, "obj": strong ref}
         self._open: dict[tuple[str, object], dict] = {}
@@ -339,8 +354,12 @@ class ProtocolRecorder:
         skip_types = spec.get("skip_types", ())
         resolve = self._resolver(spec["key"], original)
 
+        site = f"{spec['class']}.{spec['name']}"
+
         @functools.wraps(original)
         def wrapper(self, *args, **kwargs):
+            if recorder._shaker is not None:
+                recorder._shaker.perturb(site)
             result = original(self, *args, **kwargs)
             value = resolve(self, args, kwargs, result)
             if value is None:

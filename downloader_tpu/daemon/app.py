@@ -1081,7 +1081,7 @@ class Daemon:
             self._config.dead_letter_queue
         )
         for index in range(max(1, self._config.concurrency)):
-            worker = threading.Thread(
+            worker = threading.Thread(  # thread-role: job-worker
                 target=self._worker,
                 args=(deliveries,),
                 name=f"job-worker-{index}",

@@ -132,7 +132,7 @@ class HealthServer:
                 self.wfile.write(body)
 
         self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
-        self._thread = threading.Thread(
+        self._thread = threading.Thread(  # thread-role: health-server
             target=self._httpd.serve_forever, name="health", daemon=True
         )
 

@@ -179,7 +179,7 @@ def initiate(
     back per policy).
     """
     private, public = _keypair()
-    sock.sendall(public + _pad())
+    sock.sendall(public + _pad())  # deadline: handshake sockets carry the caller's settimeout (peerwire dial, inbound listener 120s)
     yb = _recv_exact(sock, DH_KEY_BYTES)
     s = _secret(private, yb)
 
@@ -189,7 +189,7 @@ def initiate(
     req2_xor_req3 = _xor(_sha1(b"req2", info_hash), _sha1(b"req3", s))
     tail = VC + struct.pack(">I", crypto_provide) + struct.pack(">H", 0)
     tail += struct.pack(">H", len(ia)) + ia
-    sock.sendall(_sha1(b"req1", s) + req2_xor_req3 + tx.crypt(tail))
+    sock.sendall(_sha1(b"req1", s) + req2_xor_req3 + tx.crypt(tail))  # deadline: handshake sockets carry the caller's settimeout (peerwire dial, inbound listener 120s)
 
     # B's reply: sync on ENCRYPT_B(VC). VC is zeros, so its ciphertext
     # IS the first 8 keystream bytes of rx — a fixed marker.

@@ -794,7 +794,7 @@ class SwarmDownloader:
         # rarest-first/endgame coordination covers them, and a job with
         # zero reachable peers can still complete over HTTP
         web_workers = [
-            threading.Thread(
+            threading.Thread(  # thread-role: webseed-worker
                 target=self._web_seed_worker,
                 args=(url, swarm, token),
                 daemon=True,
@@ -836,7 +836,7 @@ class SwarmDownloader:
                     peers = []
             swarm.enqueue_discovered(peers)
             workers = [
-                threading.Thread(
+                threading.Thread(  # thread-role: peer-worker
                     target=self._peer_worker,
                     args=(swarm, token),
                     daemon=True,

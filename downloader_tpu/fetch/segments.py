@@ -1068,7 +1068,7 @@ class SegmentedFetcher:
                 if admitted:
                     metrics.GLOBAL.add("http_multi_source_fetches")
                 workers = [
-                    threading.Thread(
+                    threading.Thread(  # thread-role: segment-worker
                         target=self._worker, args=(state,),
                         name=f"http-seg-{i}", daemon=True,
                     )

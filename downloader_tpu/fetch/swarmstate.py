@@ -52,8 +52,8 @@ class _PieceBatch:
         self._max_bytes = max_bytes
         # the conn whose claims these pieces ride on (release scoping)
         self._owner = owner
-        self._items: list[tuple[int, bytes]] = []
-        self._bytes = 0
+        self._items: list[tuple[int, bytes]] = []  # shared-by-design: one _PieceBatch per worker thread (peer or webseed); instances never cross threads, only the swarm/store they flush into are shared (and those lock)
+        self._bytes = 0  # shared-by-design: same owner-scoping as _items — thread-confined per-worker tally
 
     def add(self, index: int, data: bytes) -> None:
         self._items.append((index, data))

@@ -227,6 +227,7 @@ class UTPSocket:
 
     def _send_raw(self, data: bytes) -> None:
         try:
+            # analysis: ignore[no-blocking-under-lock] UDP datagram send: the kernel queues or drops, it never parks on the remote; loss is the retransmit machinery's job
             self._mux.sock.sendto(data, self._wire_addr)
         except OSError:
             pass  # transient; retransmit machinery covers loss

@@ -184,6 +184,24 @@ class DigestEngine:
                     "initialisation failed earlier this process"
                 )
             return None
+        state = self._jax_state
+        if state is not None:
+            return state  # published whole under the lock; plain read is safe
+        # probe the device runtime BEFORE taking the state lock: a
+        # wedged backend parks the probe for DIGEST_INIT_TIMEOUT
+        # seconds, and holding _lock across that convoys every other
+        # digest path behind it (the interprocedural
+        # no-blocking-under-lock rule caught this; the probe latches
+        # process-wide, so concurrent callers dedupe on _probe_lock)
+        try:
+            devices = self._devices or _devices_with_timeout()
+        except Exception as exc:  # pragma: no cover - env-dependent
+            self._jax_failed = True
+            if self._backend == "jax":
+                raise
+            log.warning(f"jax digest path unavailable ({exc}); "
+                        "falling back to hashlib")
+            return None
         with self._lock:
             if self._jax_state is not None:
                 return self._jax_state
@@ -193,7 +211,6 @@ class DigestEngine:
                 from . import mesh as mesh_mod
                 from .sha1 import sha1_blocks_jit
 
-                devices = self._devices or _devices_with_timeout()
                 if len(devices) > 1:
                     device_mesh = mesh_mod.default_mesh(devices)
                     verify_fn = mesh_mod.sharded_verify_fn(device_mesh)
@@ -227,13 +244,25 @@ class DigestEngine:
                     "initialisation failed earlier this process"
                 )
             return None
+        fn = self._pallas_fn
+        if fn is not None:
+            return fn
+        # same hoist as _jax(): never hold the state lock across the
+        # (bounded but long) device probe
+        try:
+            devices = self._devices or _devices_with_timeout()
+        except Exception as exc:
+            self._pallas_failed = True
+            if self._backend == "pallas":
+                raise
+            log.debug(f"pallas digest path unavailable ({exc})")
+            return None
         with self._lock:
             if self._pallas_fn is not None:
                 return self._pallas_fn
             try:
                 import jax
 
-                devices = self._devices or _devices_with_timeout()
                 if len(devices) != 1 or devices[0].platform != "tpu":
                     raise RuntimeError(
                         "pallas digest path needs exactly one TPU device"
@@ -280,7 +309,7 @@ class DigestEngine:
         with self._calibrate_lock:
             if self._calibration is not None:
                 return self._calibration
-            calibration = self._measure_calibration()
+            calibration = self._measure_calibration()  # analysis: ignore[no-blocking-under-lock] single-flight gate: late callers must wait out the one calibration, bounded by the probe sizes + DIGEST_INIT_TIMEOUT
             log.with_fields(
                 hashlib_MBps=round(calibration[0] / 1e6),
                 transfer_MBps=round(calibration[1] / 1e6),

@@ -24,7 +24,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-shard_map = jax.shard_map
+try:
+    shard_map = jax.shard_map  # jax >= 0.4.35 top-level export
+except AttributeError:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map
 
 from .sha1 import sha1_blocks
 

@@ -116,7 +116,11 @@ class QueueClient:
         self._publish_confirm_timeout = publish_confirm_timeout
 
         self._lock = threading.RLock()
-        self._prefetch = DEFAULT_PREFETCH
+        # the admission ladder's worker thread shrinks/restores this
+        # while the supervisor thread reads it rebuilding channels —
+        # unguarded, a rebuild could pick up a stale window AND miss
+        # the live qos update (thread-role-race finding, ISSUE 11)
+        self._prefetch = DEFAULT_PREFETCH  # guarded-by: _lock
         self._connection: Connection | None = None  # guarded-by: _lock
         self._shards: dict[str, _Shard] = {}  # queue_name -> shard; guarded-by: _lock
         self._publish_buffer: "queue_mod.Queue[_PendingPublish]" = queue_mod.Queue()
@@ -142,7 +146,7 @@ class QueueClient:
         )
 
         self._create_connection()  # blocks with backoff, like NewClient
-        self._supervisor = threading.Thread(
+        self._supervisor = threading.Thread(  # thread-role: queue-supervisor
             target=self._supervise, name="queue-supervisor", daemon=True
         )
         self._supervisor.start()
@@ -171,17 +175,35 @@ class QueueClient:
             if self._connection is None or self._connection.is_closed():
                 raise BrokerError("connection is closed")
             channel = self._connection.channel()
-        channel.set_prefetch(self._prefetch)
+            prefetch = self._prefetch
+        channel.set_prefetch(prefetch)
         return channel
+
+    def _refresh_prefetch(self, channel: Channel) -> None:
+        """Close the rebuild/apply race's last window: a channel built
+        BEFORE an ``apply_prefetch`` write but registered on its shard
+        AFTER the snapshot got the old qos window and missed the live
+        update. Re-reading (and re-applying) after registration makes
+        the two orderings both safe: either this read sees the new
+        value, or — registration happening-before this lock
+        acquisition — the apply's snapshot saw the channel."""
+        with self._lock:
+            desired = self._prefetch
+        try:
+            channel.set_prefetch(desired)
+        except BrokerError:
+            pass  # channel already dead; the next rebuild reapplies
 
     # -- public API ------------------------------------------------------
 
     def set_prefetch(self, prefetch: int) -> None:
-        self._prefetch = prefetch
+        with self._lock:
+            self._prefetch = prefetch
 
     @property
     def prefetch(self) -> int:
-        return self._prefetch
+        with self._lock:
+            return self._prefetch
 
     def apply_prefetch(self, prefetch: int) -> None:
         """Change the unacked window NOW, on the live shard channels,
@@ -190,8 +212,12 @@ class QueueClient:
         stops amplifying its own backlog. A channel that refuses the
         qos update keeps its old window until the supervisor rebuilds
         it; new channels always pick up the latest value."""
-        self._prefetch = prefetch
         with self._lock:
+            # write + snapshot under ONE hold: a channel is either in
+            # the snapshot (gets the live update below) or created
+            # after the write (reads the new value in _channel) —
+            # never both stale
+            self._prefetch = prefetch
             channels = [
                 shard.channel
                 for shard in self._shards.values()
@@ -475,6 +501,7 @@ class QueueClient:
                     ),
                 )
                 shard.channel = channel
+                self._refresh_prefetch(channel)
                 log.info(f"worker on queue '{shard.queue_name}' started")
             except BrokerError as exc:
                 self.stats.consumer_errors += 1
@@ -513,7 +540,7 @@ class QueueClient:
                 # supervisor's rebuild wrote 1 and stick a false
                 # publisher-dead page until the next reconnect
                 metrics.GLOBAL.gauge_set("queue_publisher_alive", 1)
-            threading.Thread(
+            threading.Thread(  # thread-role: queue-publisher
                 target=self._publish_loop,
                 args=(channel,),
                 name="queue-publisher",

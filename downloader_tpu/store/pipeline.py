@@ -467,7 +467,7 @@ class PipelineSession:
             for number in ready:
                 if stream.first_part_at is None:
                     stream.first_part_at = time.monotonic()
-                stream.futures[number] = self._pipeline._submit(
+                stream.futures[number] = self._pipeline._submit(  # thread-role: part-uploader
                     stream.ship, number, self._token
                 )
 

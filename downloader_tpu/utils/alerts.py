@@ -644,7 +644,7 @@ class AlertEngine:
                 return self
             self._stop.clear()
             rule_count = len(self._rules)
-            thread = threading.Thread(
+            thread = threading.Thread(  # thread-role: alert-evaluator
                 target=self._run, name="alert-eval", daemon=True
             )
             self._thread = thread

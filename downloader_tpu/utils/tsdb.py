@@ -250,7 +250,7 @@ class TimeSeriesStore:
             if self._thread is not None:
                 return self
             self._stop.clear()
-            thread = threading.Thread(
+            thread = threading.Thread(  # thread-role: tsdb-scraper
                 target=self._run, name="tsdb-scrape", daemon=True
             )
             self._thread = thread

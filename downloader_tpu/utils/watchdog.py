@@ -420,7 +420,7 @@ class Watchdog:
                 deadlines.extend(self.stage_overrides.values())
                 floor = min(d for d in deadlines if d > 0)
                 interval = min(5.0, max(0.05, floor / 4.0))
-            thread = threading.Thread(
+            thread = threading.Thread(  # thread-role: watchdog-monitor
                 target=self._run, args=(interval,),
                 name="watchdog", daemon=True,
             )

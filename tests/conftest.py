@@ -46,7 +46,49 @@ _LOCK_ORDER_MODULES = {
     "test_queue",
 }
 
+# Schedule perturbation (analysis/schedules.py): these suites run with
+# deterministic pseudo-random yields injected at the recorders they
+# already run under — every recorded lock acquire/release (pipeline)
+# and protocol acquire/release (all three) — so tier-1 explores
+# perturbed interleavings instead of only the scheduler's favorite
+# one. The seed is pinned (SCHEDULE_SHAKE_SEED overrides — use the
+# seed a failure printed to reproduce it). Timing-measurement tests
+# opt out via the `schedule_shaker_paused` fixture.
+_SCHEDULE_SHAKE_MODULES = {
+    "test_pipeline",
+    "test_batch",
+    "test_admission",
+}
+
 import pytest  # noqa: E402
+
+
+# one shaker per shaken module, shared by the lock-order and protocol
+# guards (and findable by the pause fixture below)
+_ACTIVE_SHAKERS: dict = {}
+
+
+def _shaker_for(module: str):
+    if module not in _SCHEDULE_SHAKE_MODULES:
+        return None
+    shaker = _ACTIVE_SHAKERS.get(module)
+    if shaker is None:
+        from downloader_tpu.analysis.schedules import ScheduleShaker
+
+        shaker = _ACTIVE_SHAKERS[module] = ScheduleShaker.from_env()
+    return shaker
+
+
+@pytest.fixture
+def schedule_shaker_paused(request):
+    """Opt-out for timing-measurement tests (overhead guards): the
+    schedule shaker measures nothing and must not BE measured."""
+    shaker = _ACTIVE_SHAKERS.get(request.module.__name__)
+    if shaker is None:
+        yield
+        return
+    with shaker.paused():
+        yield
 
 
 @pytest.fixture(autouse=True)
@@ -75,14 +117,18 @@ def _runtime_lock_order_guard(request):
         return
     from downloader_tpu.analysis.runtime import LockOrderRecorder
 
-    recorder = LockOrderRecorder().install()
+    shaker = _shaker_for(module)
+    recorder = LockOrderRecorder(shaker=shaker).install()
     try:
         yield
     finally:
         recorder.uninstall()
         cycles = recorder.cycles()
+        seed = getattr(shaker, "seed", None)
         assert not cycles, (
-            f"lock-order cycles observed at runtime in {module}: {cycles}"
+            f"lock-order cycles observed at runtime in {module}"
+            + (f" (SCHEDULE_SHAKE_SEED={seed} reproduces)" if seed is not None else "")
+            + f": {cycles}"
         )
 
 
@@ -114,7 +160,7 @@ def _runtime_protocol_guard(request):
         return
     from downloader_tpu.analysis.runtime import ProtocolRecorder
 
-    recorder = ProtocolRecorder().install()
+    recorder = ProtocolRecorder(shaker=_shaker_for(module)).install()
     try:
         yield
         # brief settle window: worker/publisher threads release their

@@ -1,0 +1,26 @@
+"""Cross-function lock leak: ``_grab`` deliberately returns holding
+the lock (chaining), and ``insert`` — the caller who owes the release
+— never releases it; ``remove`` releases on only one path."""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+
+    def _grab(self):
+        self._lock.acquire()
+
+    def insert(self, key, value):
+        self._grab()
+        self._entries[key] = value
+        return True
+
+    def remove(self, key):
+        self._lock.acquire()
+        if key not in self._entries:
+            return False
+        del self._entries[key]
+        self._lock.release()
+        return True

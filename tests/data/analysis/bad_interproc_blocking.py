@@ -1,0 +1,20 @@
+"""Interprocedural blocking-under-lock: ``send`` holds the connection
+lock across a helper that blocks two hops down."""
+import threading
+import time
+
+
+class Conn:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._sock = sock
+
+    def _flush(self):
+        self._backoff()
+
+    def _backoff(self):
+        time.sleep(0.5)
+
+    def send(self, data):
+        with self._lock:
+            self._flush()

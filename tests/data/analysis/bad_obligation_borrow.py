@@ -1,0 +1,22 @@
+"""An obligation lent to a pure borrower is not an escape: ``_audit``
+only reads the lease, so ``run`` still owes the release it never
+performs — the summary-based half of the escape analysis."""
+
+
+class LeaseManager:
+    def acquire_lease(self):  # protocol: fixture-lease acquire
+        return object()
+
+    def release_lease(self, lease):  # protocol: fixture-lease release bind=lease
+        pass
+
+
+def _audit(lease):
+    if lease.closed:
+        raise ValueError("already closed")
+
+
+def run(manager):
+    lease = manager.acquire_lease()
+    _audit(lease)
+    return True

@@ -1,0 +1,25 @@
+"""Two thread roles share one unguarded field, and one of them writes:
+the static half of a race detector fires on the racing store."""
+import threading
+
+
+class Prefetcher:
+    def __init__(self):
+        self._window = 8
+
+    def _supervise(self):
+        try:
+            while self._window > 0:
+                pass
+        except Exception:
+            return
+
+    def _apply(self):
+        try:
+            self._window = 2
+        except Exception:
+            return
+
+    def start(self):
+        threading.Thread(target=self._supervise).start()  # thread-role: supervisor
+        threading.Thread(target=self._apply).start()  # thread-role: ladder

@@ -1,0 +1,24 @@
+"""Deliberate lock-free sharing, declared with a reason: the race
+rule stays quiet."""
+import threading
+
+
+class Telemetry:
+    def __init__(self):
+        self._beat = 0.0  # shared-by-design: monotonic float heartbeat; torn reads self-heal on the next tick
+
+    def _monitor(self):
+        try:
+            return self._beat
+        except Exception:
+            return None
+
+    def _work(self):
+        try:
+            self._beat = 1.0
+        except Exception:
+            return
+
+    def start(self):
+        threading.Thread(target=self._monitor).start()  # thread-role: monitor
+        threading.Thread(target=self._work).start()  # thread-role: worker

@@ -1,0 +1,35 @@
+"""Ownership escapes proven by callee summaries: ``adopt`` stores the
+lease on an object and ``_finish`` releases it — either way the
+acquiring function's responsibility ends."""
+
+
+class LeaseManager:
+    def acquire_lease(self):  # protocol: fixture-lease acquire
+        return object()
+
+    def release_lease(self, lease):  # protocol: fixture-lease release bind=lease
+        pass
+
+
+class Holder:
+    def __init__(self):
+        self._lease = None
+
+    def adopt(self, lease):
+        self._lease = lease
+
+
+def _finish(manager, lease):
+    manager.release_lease(lease)
+
+
+def run_store(manager, holder: Holder):
+    lease = manager.acquire_lease()
+    holder.adopt(lease)
+    return True
+
+
+def run_release(manager):
+    lease = manager.acquire_lease()
+    _finish(manager, lease)
+    return True

@@ -448,7 +448,7 @@ def _environmental_floor_ms(tmp_path) -> float:
     return laps[len(laps) // 2]
 
 
-def test_batched_per_job_overhead_guard(tmp_path):
+def test_batched_per_job_overhead_guard(tmp_path, schedule_shaker_paused):
     """ISSUE 6 acceptance: batched per-job framework overhead p50 <= 1 ms
     (or <= 3x this host's measured syscall floor where that floor alone
     exceeds the budget — the environmental escape the acceptance

@@ -26,11 +26,20 @@ RULES = (
     "no-blocking-under-lock",
     "resource-finalization",
     "lock-order",
+    "lock-balance",
     "exception-hygiene",
     "protocol",
     "blocking-deadline",
+    "thread-role-race",
     "env-knob-documented",
 )
+
+# The suppression budget: every `analysis: ignore` in the package,
+# counted by `--list-suppressions`. A PR that adds a REASONED
+# suppression must bump this pin in the same diff — the bump is the
+# review artifact; reasonless suppressions stay hard violations
+# regardless.
+SUPPRESSION_BUDGET = 11
 
 
 # -- the tier-1 gate ---------------------------------------------------------
@@ -74,6 +83,12 @@ def test_full_rule_catalog_registered():
         ("bad_double_release.py", "protocol", {17}),
         ("bad_source_retire_leak.py", "protocol", {16}),
         ("bad_blocking_deadline.py", "blocking-deadline", {19}),
+        # the interprocedural rules (ISSUE 11): each bad fixture is a
+        # shape the per-function engine was blind to
+        ("bad_cross_function_lock_leak.py", "lock-balance", {16, 21}),
+        ("bad_interproc_blocking.py", "no-blocking-under-lock", {20}),
+        ("bad_two_role_field.py", "thread-role-race", {19}),
+        ("bad_obligation_borrow.py", "protocol", {20}),
     ],
 )
 def test_rule_fires_on_fixture_with_location(fixture, rule, lines):
@@ -114,6 +129,197 @@ def test_ownership_escape_analyzes_clean():
     it — ownership moved, nothing to report. Guards the escape
     heuristic against regressing into leak-everything noise."""
     assert analyze_paths([FIXTURES / "good_ownership_escape.py"]) == []
+
+
+def test_shared_by_design_fixture_analyzes_clean():
+    """Declared lock-free sharing with a reason: the race rule stays
+    quiet, and nothing else fires on the fixture."""
+    assert analyze_paths([FIXTURES / "good_shared_by_design.py"]) == []
+
+
+def test_summary_ownership_escape_analyzes_clean():
+    """Passing an obligation to a callee whose summary proves it keeps
+    it (stores it on an object / releases it) is a real escape."""
+    assert analyze_paths([FIXTURES / "good_summary_escape.py"]) == []
+
+
+def test_obligation_borrow_names_the_borrower():
+    violations = analyze_paths([FIXTURES / "bad_obligation_borrow.py"])
+    assert any(
+        "_audit()" in v.message and "borrows" in v.message
+        for v in violations
+    ), violations
+
+
+def test_cross_function_lock_leak_names_the_helper():
+    violations = analyze_paths([FIXTURES / "bad_cross_function_lock_leak.py"])
+    messages = " | ".join(v.message for v in violations)
+    assert "_grab()" in messages and "never releases" in messages
+    assert "only some paths" in messages  # the intraprocedural half
+
+
+def test_interproc_blocking_names_the_transitive_site():
+    violations = analyze_paths([FIXTURES / "bad_interproc_blocking.py"])
+    hits = [v for v in violations if v.rule == "no-blocking-under-lock"]
+    assert len(hits) == 1
+    assert "sleep()" in hits[0].message  # the leaf, two hops down
+    assert "bad_interproc_blocking.py:16" in hits[0].message
+
+
+def test_race_rule_requires_a_reason_on_shared_by_design(tmp_path):
+    """A reasonless `# shared-by-design:` must be flagged at the
+    declaration, exactly like a reasonless suppression."""
+    source = (FIXTURES / "good_shared_by_design.py").read_text()
+    stripped = source.replace(
+        "# shared-by-design: monotonic float heartbeat; torn reads "
+        "self-heal on the next tick",
+        "# shared-by-design:",
+    )
+    target = tmp_path / "noreason.py"
+    target.write_text(stripped)
+    violations = analyze_paths([target])
+    assert [v.rule for v in violations] == ["thread-role-race"], violations
+    assert "no reason" in violations[0].message
+    assert violations[0].line == 8  # the declaration, not the store
+
+
+def test_holds_contract_enforced_at_call_sites(tmp_path):
+    """A `# holds:` def annotation is a caller contract: a `self.`
+    call without the lock is flagged at the call site; the locked
+    caller is clean."""
+    target = tmp_path / "contract.py"
+    target.write_text(
+        "import threading\n"
+        "\n"
+        "\n"
+        "class Board:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._slots = {}\n"
+        "\n"
+        "    def _evict_locked(self, key):  # holds: _lock\n"
+        "        self._slots.pop(key, None)\n"
+        "\n"
+        "    def good(self, key):\n"
+        "        with self._lock:\n"
+        "            self._evict_locked(key)\n"
+        "\n"
+        "    def bad(self, key):\n"
+        "        self._evict_locked(key)\n"
+    )
+    violations = [
+        v for v in analyze_paths([target]) if v.rule == "guarded-by"
+    ]
+    assert [v.line for v in violations] == [17], violations
+    assert "_evict_locked()" in violations[0].message
+
+
+def test_transitive_blocking_report_anchors_at_suppressed_leaf(tmp_path):
+    """One reasoned suppression at the blocking site covers every
+    lock-holding caller (anchored reporting marks it used — a leaf
+    suppression must never read as stale), while removing the callers
+    turns it stale again."""
+    tree = tmp_path / "pkg"
+    tree.mkdir()
+    (tree / "wire.py").write_text(
+        "def push(sock, frame):\n"
+        "    sock.sendall(frame)  # analysis: ignore[no-blocking-under-lock] dedicated write lock; a wedged peer is torn down by the heartbeat\n"
+    )
+    (tree / "conn.py").write_text(
+        "import threading\n"
+        "\n"
+        "from wire import push\n"
+        "\n"
+        "\n"
+        "class Conn:\n"
+        "    def __init__(self, sock):\n"
+        "        self._write_lock = threading.Lock()\n"
+        "        self._sock = sock\n"
+        "\n"
+        "    def send(self, frame):\n"
+        "        with self._write_lock:\n"
+        "            push(self._sock, frame)\n"
+    )
+    assert analyze_paths([tree]) == []
+    # drop the caller: the suppression now matches nothing -> stale
+    (tree / "conn.py").write_text("def nothing():\n    return 1\n")
+    stale = analyze_paths([tree])
+    assert [v.rule for v in stale] == ["suppression"]
+    assert "stale" in stale[0].message
+
+
+def test_blocking_deadline_name_reachability_hack_is_gone(tmp_path):
+    """Reachability now walks the RESOLVED call graph: a function that
+    merely shares a name with a thread target in an unrelated module
+    is no longer reachable, so its unbounded wait stays out of scope
+    (the old name-based walk flagged it)."""
+    tree = tmp_path / "pkg"
+    tree.mkdir()
+    (tree / "spawner.py").write_text(
+        "import threading\n"
+        "\n"
+        "\n"
+        "def pump():\n"
+        "    try:\n"
+        "        return 1\n"
+        "    except Exception:\n"
+        "        return None\n"
+        "\n"
+        "\n"
+        "def run():\n"
+        "    threading.Thread(target=pump).start()\n"
+    )
+    (tree / "unrelated.py").write_text(
+        "def pump(event):\n"
+        "    event.wait()\n"  # unbounded, but nothing reaches it
+        "    return None\n"
+    )
+    violations = [
+        v
+        for v in analyze_paths([tree])
+        if v.rule == "blocking-deadline"
+    ]
+    assert violations == [], violations
+
+
+def test_lock_order_summary_edges_close_cross_class_cycles(tmp_path):
+    """The caller-held -> callee-acquired summary edge: two classes
+    acquiring each other's locks through method calls — invisible to
+    the per-function graph — now close a static cycle."""
+    target = tmp_path / "crossclass.py"
+    target.write_text(
+        "import threading\n"
+        "\n"
+        "\n"
+        "class Pool:\n"
+        "    def __init__(self, board: \"Board\"):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._board = board\n"
+        "\n"
+        "    def take(self):\n"
+        "        with self._lock:\n"
+        "            self._board.note()\n"
+        "\n"
+        "\n"
+        "class Board:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._pool = Pool(self)\n"
+        "\n"
+        "    def note(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+        "\n"
+        "    def rebalance(self):\n"
+        "        with self._lock:\n"
+        "            self._pool.take()\n"
+    )
+    violations = [
+        v for v in analyze_paths([target]) if v.rule == "lock-order"
+    ]
+    assert violations, "cross-class cycle not detected"
+    assert "Pool._lock" in violations[0].message
+    assert "Board._lock" in violations[0].message
 
 
 def test_lock_order_cycle_names_both_locks():
@@ -271,14 +477,17 @@ def test_unsuppressed_copy_of_round_trip_fixture_fires(tmp_path):
 def test_full_tree_analyze_stays_within_budget():
     """The CFG/dataflow engine must not silently make `make analyze`
     unusably slow: a full uncached tree analysis (the worst case — the
-    cache serves warm runs in ~0.2s) stays under a generous budget on
-    this host. Measured ~2s on the 1-vCPU CI VM; the 20s ceiling is
+    cache serves warm runs in well under a second) stays under a
+    generous budget on this host. Re-pinned for the interprocedural
+    pass (ISSUE 11): ~6s measured uncached on the CI-class host — the
+    call graph, SCC summary fixpoint, and the second (may-held) lock
+    solve roughly triple the old ~2s bound; the 30s ceiling is
     headroom for host noise, not a target. One remeasure absorbs a
     noisy-neighbor burst (a guard asks whether the analyzer CAN hit
     budget)."""
     import time
 
-    budget_s = 20.0
+    budget_s = 30.0
     for _ in range(2):
         start = time.monotonic()
         Analyzer(full_scope=True).run(
@@ -291,6 +500,188 @@ def test_full_tree_analyze_stays_within_budget():
         f"full-tree analyze took {elapsed:.1f}s (budget {budget_s:.0f}s); "
         "the engine has regressed into unusable territory"
     )
+
+
+def test_cached_replay_stays_subsecond(tmp_path):
+    """The replay tier must stay sub-second however heavy the
+    interprocedural pass gets: a no-change re-run serves the stored
+    verdict without parsing, scanning, or building the program."""
+    import time
+
+    from downloader_tpu.analysis.cache import ScanCache
+
+    files = iter_package_files(REPO / "downloader_tpu")
+    cache_path = tmp_path / "cache.json"
+    cache = ScanCache(cache_path)
+    Analyzer(full_scope=True).run(list(files), scan_cache=cache)
+
+    start = time.monotonic()
+    replayed = ScanCache(cache_path).replay(list(files))
+    elapsed = time.monotonic() - start
+    assert replayed is not None, "warm cache refused to replay"
+    assert elapsed < 1.0, f"cached replay took {elapsed:.2f}s"
+
+
+# -- --diff mode -------------------------------------------------------------
+
+
+def test_diff_report_filter_agrees_with_full_run(tmp_path):
+    """--diff keeps the analysis whole-program and filters only the
+    report: on the files both report on, a diff-filtered run is
+    byte-for-byte the full run — including a finding in a CALLER of
+    the changed helper, which rides in as a reverse dependent."""
+    from downloader_tpu.analysis.__main__ import _with_reverse_dependents
+
+    tree = tmp_path / "pkg"
+    tree.mkdir()
+    helper = tree / "helper.py"
+    helper.write_text(
+        "import time\n"
+        "\n"
+        "\n"
+        "def pump():\n"
+        "    time.sleep(0.1)\n"
+    )
+    (tree / "caller.py").write_text(
+        "import threading\n"
+        "\n"
+        "from helper import pump\n"
+        "\n"
+        "\n"
+        "class Conn:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "\n"
+        "    def send(self):\n"
+        "        with self._lock:\n"
+        "            pump()\n"
+    )
+    files = sorted(tree.rglob("*.py"))
+    full = Analyzer(full_scope=True).run(list(files))
+    assert any(
+        v.rule == "no-blocking-under-lock" and v.path.endswith("caller.py")
+        for v in full
+    ), full
+
+    # "only helper.py changed": the caller must ride in as a reverse
+    # call-graph dependent, and its finding must match the full run's
+    diff = Analyzer(full_scope=True).run(
+        list(files),
+        report_paths=_with_reverse_dependents({str(helper)}),
+    )
+    assert [str(v) for v in diff] == [
+        str(v) for v in full if v.path.endswith(("helper.py", "caller.py"))
+    ]
+
+
+def test_cli_diff_mode_smoke():
+    """`--diff <ref>` runs end to end against the real repo: exit
+    status matches the full gate (clean tree -> 0) and the output is
+    well-formed JSON."""
+    result = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "downloader_tpu.analysis",
+            "--diff",
+            "HEAD",
+            "--json",
+            "--no-cache",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode in (0, 1), result.stderr
+    payload = json.loads(result.stdout)
+    assert payload["count"] == len(payload["violations"])
+
+
+def test_cli_emit_summary_writes_callgraph_artifact(tmp_path):
+    """--emit-summary lands the call graph + effect summary table as
+    JSON: the CI artifact review tooling reads."""
+    out = tmp_path / "summary.json"
+    result = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "downloader_tpu.analysis",
+            str(FIXTURES / "bad_interproc_blocking.py"),
+            "--emit-summary",
+            str(out),
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 1, result.stdout + result.stderr
+    payload = json.loads(out.read_text())
+    assert payload["functions"] >= 4
+    edges = {tuple(edge) for edge in payload["edges"]}
+    assert any("send" in src and "_flush" in dst for src, dst in edges)
+    blocking = [
+        entry
+        for entry in payload["summaries"].values()
+        if entry.get("may_block")
+    ]
+    assert blocking, "summary table lost the may-block verdicts"
+
+
+# -- regression tests for the findings this PR fixed -------------------------
+
+
+def test_regression_device_probe_runs_outside_state_lock():
+    """ISSUE 11 real finding #1 (no-blocking-under-lock,
+    interprocedural): DigestEngine._jax/_pallas held self._lock across
+    _devices_with_timeout(), whose probe thread join can park for
+    DIGEST_INIT_TIMEOUT (30s default) on a wedged device runtime —
+    convoying every digest path behind the state lock. The probe now
+    runs before the lock; this pins it."""
+    module = Module.load(
+        REPO / "downloader_tpu" / "parallel" / "engine.py"
+    )
+    from downloader_tpu.analysis.engine import scan_cached
+
+    scan = scan_cached(module)
+    probed = 0
+    for fa in scan.functions:
+        for site in fa.call_sites:
+            if site.name == "_devices_with_timeout":
+                probed += 1
+                assert site.held == (), (
+                    f"{fa.node.name}() calls the device probe while "
+                    f"holding {site.held} (line {site.line})"
+                )
+    assert probed >= 3  # _jax, _pallas, _measure_calibration, ...
+
+
+def test_regression_queue_prefetch_is_guarded():
+    """ISSUE 11 real finding #2 (thread-role-race): the admission
+    ladder's worker thread writes QueueClient._prefetch while the
+    supervisor thread reads it rebuilding channels — it now lives
+    under _lock with a guarded-by declaration, so the guarded-by rule
+    (not just this test) keeps it locked."""
+    module = Module.load(REPO / "downloader_tpu" / "queue" / "client.py")
+    from downloader_tpu.analysis.engine import scan_cached
+
+    scan = scan_cached(module)
+    assert any(
+        decl.attr == "_prefetch" and decl.lock == "_lock"
+        for decl in scan.guards
+    ), "the guarded-by declaration on _prefetch is gone"
+    accesses = [
+        (fa.node.name, access)
+        for fa in scan.functions
+        if fa.node.name != "__init__"
+        for access in fa.accesses
+        if access.attr == "_prefetch"
+    ]
+    assert accesses, "no _prefetch accesses found (rename?)"
+    for func_name, access in accesses:
+        assert "_lock" in access.held, (
+            f"{func_name}() touches _prefetch without _lock "
+            f"(line {access.line})"
+        )
 
 
 # -- scan cache --------------------------------------------------------------
@@ -527,6 +918,33 @@ def test_conditional_acquire_refines_through_assigned_flag(tmp_path):
     )
     leaks = [v for v in analyze_paths([leaky]) if v.rule == "protocol"]
     assert len(leaks) == 1 and leaks[0].line == 10, leaks
+
+
+def test_suppression_budget_is_pinned():
+    """Tier-1 suppression-budget guard: the package-wide suppression
+    count is pinned at SUPPRESSION_BUDGET. Adding a reasoned
+    suppression requires bumping the pin in the same diff — silently
+    accreting ignores is how analyzers die. (Reasonless suppressions
+    never count toward the budget: they are hard violations.)"""
+    result = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "downloader_tpu.analysis",
+            "--list-suppressions",
+            "--json",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stderr
+    payload = json.loads(result.stdout)
+    assert payload["count"] == SUPPRESSION_BUDGET, (
+        f"suppression count {payload['count']} != pinned "
+        f"{SUPPRESSION_BUDGET}; if the new suppression carries a real "
+        "reason, bump SUPPRESSION_BUDGET in this same diff"
+    )
 
 
 def test_cli_list_suppressions_inventories_reasons():
