@@ -20,6 +20,7 @@ shape. bench.py embeds :func:`measure` in its ``extra_metrics``.
 from __future__ import annotations
 
 import json
+import re
 import sys
 import time
 
@@ -90,6 +91,18 @@ def digest_line(report: dict) -> dict:
         elif metric == "digest_kernel":
             out["hashlib_GBps"] = extra.get("hashlib_GBps")
             out["pallas_GBps"] = extra.get("pallas_GBps")
+            # why the device numbers are missing, when they are — and
+            # the incident bundle holding the wedge's evidence
+            if extra.get("device_reason"):
+                out["device_reason"] = extra["device_reason"]
+            if extra.get("device_incident"):
+                out["device_incident"] = extra["device_incident"]
+        elif metric == "profile_attribution":
+            out["profile_attributed_pct"] = extra.get("attributed_pct")
+            out["profile_top_cpu_role"] = extra.get("top_cpu_role")
+            stages = extra.get("stage_cpu_pct") or {}
+            for stage, pct in stages.items():
+                out[f"profile_cpu_{stage}_pct"] = pct
     return out
 
 
@@ -282,6 +295,13 @@ def measure(
         # with the reason explaining the missing kernel numbers
         result.setdefault("device", "unavailable")
         result["device_reason"] = f"{type(exc).__name__}: {exc}"
+        # a wedged-init timeout stitches its incident bundle id into
+        # the error (parallel/engine.py captures stacks + profile tail
+        # at the moment of the wedge); surface it as its own field so
+        # the digest line points straight at the diagnosable evidence
+        match = re.search(r"\[incident=([\w.:-]+)\]", str(exc))
+        if match:
+            result["device_incident"] = match.group(1)
         if "hashlib_GBps" not in result:
             return None
     return result

@@ -37,7 +37,7 @@ from ..queue.delivery import Delivery, ack_batch
 from ..scan import scan_dir
 from ..store import Uploader, UploadError
 from ..utils import metrics, configure_from_env, get_logger, tracing
-from ..utils import admission, incident, watchdog
+from ..utils import admission, incident, profiling, watchdog
 from ..utils.cancel import Cancelled, CancelToken
 from ..wire import Convert, Download, WireError
 from .config import Config
@@ -1088,6 +1088,9 @@ class Daemon:
                 daemon=True,
             )
             worker.start()
+            # profile attribution: samples of this thread read as the
+            # job-worker role, not an anonymous Thread-N
+            profiling.ROLES.register_thread(worker, "job-worker")
             self._workers.append(worker)
         log.with_field("workers", len(self._workers)).info("job loop running")
 
@@ -1227,9 +1230,25 @@ def serve(
         stage_overrides=config.watchdog_stages,
         on_stall=capture_stall_incident,
     )
+    # continuous profiling plane: the sampler attributes every thread
+    # stack to its registered role (the spawn surfaces below register
+    # as they start), lock-wait histograms accrue on /metrics, and
+    # /debug/profile serves flamegraphs — PROFILE=0 turns all of it
+    # into no-op stubs
+    profiling.configure(
+        enabled=config.profile,
+        interval_ms=config.profile_interval_ms,
+        ring=config.profile_ring,
+        heap_interval_s=config.profile_heap_s,
+        heap_top=config.profile_heap_top,
+        heap_frames=config.profile_heap_frames,
+    )
+    profiling.ROLES.register_current("daemon-main")
+
     watchdog.MONITOR.start()
     tsdb.STORE.start()
     alerts.ENGINE.start()
+    profiling.PROFILER.start()
 
     token = token or CancelToken()
     if install_signal_handlers:
@@ -1291,6 +1310,7 @@ def serve(
     try:
         daemon.run()
     finally:
+        profiling.PROFILER.stop()
         alerts.ENGINE.stop()
         tsdb.STORE.stop()
         watchdog.MONITOR.stop()

@@ -69,6 +69,15 @@ class Config:
     alert_slo_interactive_s: float = 1.0
     alert_slo_bulk_s: float = 60.0
     instance: str = ""
+    # continuous profiling plane (utils/profiling.py): thread-role-
+    # attributed stack sampling + named-lock wait timing (always on,
+    # fixed overhead) and opt-in tracemalloc heap snapshots
+    profile: bool = True
+    profile_interval_ms: float = 50.0
+    profile_ring: int = 16384
+    profile_heap_s: float = 0.0
+    profile_heap_top: int = 20
+    profile_heap_frames: int = 5
     # segmented HTTP fetch (fetch/segments.py): max concurrent ranges
     # per object (1 = single-stream only) and the per-host keep-alive
     # pool bounds (fetch/connpool.py)
@@ -189,6 +198,14 @@ class Config:
             config.alert_slo_bulk_s,
         ) = alerts.slo_targets_from_env(env)
         config.instance = metrics.instance_from_env(env)
+        from ..utils import profiling
+
+        config.profile = profiling.enabled_from_env(env)
+        config.profile_interval_ms = profiling.interval_from_env(env)
+        config.profile_ring = profiling.ring_from_env(env)
+        config.profile_heap_s = profiling.heap_interval_from_env(env)
+        config.profile_heap_top = profiling.heap_top_from_env(env)
+        config.profile_heap_frames = profiling.heap_frames_from_env(env)
         from ..fetch.connpool import (
             pool_idle_from_env,
             pool_per_host_from_env,

@@ -30,6 +30,13 @@ server exposes:
 - ``GET /debug/alerts`` — the alert engine's rules, states, and recent
   transitions (utils/alerts.py). ``GET /debug/trace?trace_id=`` links
   every attempt of one logical job into a single lineage view.
+- ``GET /debug/profile`` — the continuous profiling plane
+  (utils/profiling.py): collapsed-stack text (default), a
+  self-contained SVG flamegraph (``format=svg``), or JSON with
+  role attribution (``format=json``); ``mode=cpu|wait|heap`` picks
+  on-CPU samples, off-CPU waits (lock/io/queue, named locks
+  included), or tracemalloc allocation sites; ``role=`` filters to
+  one thread role, ``window=`` seconds bounds the sample window.
 - ``GET /metrics/federate`` — this worker's exposition merged with
   every registered child-worker source, per-sample ``instance``
   labels (the fleet-aggregation groundwork for ROADMAP item 1).
@@ -53,8 +60,8 @@ import threading
 import urllib.parse
 
 from ..utils import (
-    admission, alerts, get_logger, incident, metrics, tracing, tsdb,
-    watchdog,
+    admission, alerts, get_logger, incident, metrics, profiling,
+    tracing, tsdb, watchdog,
 )
 from ..utils.logging import ring_tail
 
@@ -72,6 +79,10 @@ class HealthServer:
                 pass
 
             def do_GET(self):
+                # ThreadingHTTPServer runs each request on its own
+                # short-lived thread; claim the role here so a sampled
+                # mid-request handler attributes to health-server
+                profiling.ROLES.register_current("health-server")
                 try:
                     parsed = urllib.parse.urlsplit(self.path)
                     path = parsed.path
@@ -90,6 +101,8 @@ class HealthServer:
                         code, body, ctype = health._debug_tsdb(query)
                     elif path == "/debug/alerts":
                         code, body, ctype = health._debug_alerts()
+                    elif path == "/debug/profile":
+                        code, body, ctype = health._debug_profile(query)
                     elif path == "/debug/watchdog":
                         code, body, ctype = health._debug_watchdog()
                     elif path == "/debug/admission":
@@ -112,6 +125,7 @@ class HealthServer:
                 self._reply(code, body, ctype)
 
             def do_POST(self):
+                profiling.ROLES.register_current("health-server")
                 try:
                     if self.path == "/debug/incident":
                         code, body, ctype = health._capture_incident()
@@ -142,6 +156,7 @@ class HealthServer:
 
     def start(self) -> "HealthServer":
         self._thread.start()
+        profiling.ROLES.register_thread(self._thread, "health-server")
         log.with_field("port", self.port).info("health endpoint listening")
         return self
 
@@ -250,6 +265,76 @@ class HealthServer:
             200,
             (json.dumps(payload, indent=1) + "\n").encode(),
             "application/json",
+        )
+
+    def _debug_profile(
+        self, query: dict | None = None
+    ) -> tuple[int, bytes, str]:
+        """The profiling plane's flamegraph/collapsed-stack view:
+        ``mode=cpu|wait|heap`` (+ ``role=``, ``window=`` seconds),
+        rendered as collapsed-stack text (default), a self-contained
+        SVG flamegraph (``format=svg``), or JSON carrying the plane
+        snapshot, role attribution, and the aggregated stacks."""
+        query = query or {}
+        mode = query.get("mode", ["cpu"])[0]
+        if mode not in ("cpu", "wait", "heap"):
+            return 400, b"mode must be cpu|wait|heap\n", "text/plain"
+        fmt = query.get("format", ["collapsed"])[0]
+        if fmt not in ("collapsed", "svg", "json"):
+            return (
+                400, b"format must be collapsed|svg|json\n", "text/plain"
+            )
+        role = query.get("role", [""])[0] or None
+        window = None
+        raw_window = query.get("window", [""])[0]
+        if raw_window:
+            try:
+                window = max(1.0, float(raw_window))
+            except ValueError:
+                return 400, b"window must be seconds\n", "text/plain"
+        profiler = profiling.PROFILER
+        stacks = profiler.collapsed(
+            mode=mode, role=role, window_s=window
+        )
+        if fmt == "svg":
+            title = f"{mode} profile"
+            if role:
+                title += f" role={role}"
+            if window:
+                title += f" window={window:g}s"
+            body = profiling.flamegraph_svg(stacks, title).encode()
+            return 200, body, "image/svg+xml"
+        if fmt == "json":
+            payload = {
+                "mode": mode,
+                "role": role,
+                "window_s": window,
+                "profiler": profiler.snapshot(),
+                "attribution": profiler.attribution(window_s=window),
+                "stacks": {
+                    stack: stacks[stack]
+                    for stack in sorted(
+                        stacks, key=lambda s: -stacks[s]
+                    )[:200]
+                },
+            }
+            if mode == "heap":
+                payload["heap"] = profiler.heap_report()
+            return (
+                200,
+                (json.dumps(payload, indent=1) + "\n").encode(),
+                "application/json",
+            )
+        lines = [
+            f"{stack} {count}"
+            for stack, count in sorted(
+                stacks.items(), key=lambda kv: (-kv[1], kv[0])
+            )
+        ]
+        return (
+            200,
+            ("\n".join(lines) + "\n").encode() if lines else b"\n",
+            "text/plain",
         )
 
     def _debug_watchdog(self) -> tuple[int, bytes, str]:

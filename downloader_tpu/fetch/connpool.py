@@ -32,7 +32,7 @@ import threading
 import time
 from collections import deque
 
-from ..utils import get_logger, incident, metrics
+from ..utils import get_logger, incident, metrics, profiling
 from ..utils.netio import create_connection
 
 log = get_logger("fetch.connpool")
@@ -108,7 +108,9 @@ class ConnectionPool:
         )
         self._timeout = timeout
         self._clock = clock
-        self._lock = threading.Lock()
+        # named for lock-wait profiling (utils/profiling.py): every
+        # segment/job acquire crosses this shelf lock
+        self._lock = profiling.named_lock("connpool", threading.Lock())
         self._idle: dict[tuple, deque[PooledConnection]] = {}  # guarded-by: _lock
         self._closed = False  # guarded-by: _lock
         # incident-bundle introspection: which hosts hold how many

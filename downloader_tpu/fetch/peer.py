@@ -43,7 +43,7 @@ import struct
 import threading
 import time
 
-from ..utils import get_logger, metrics, tracing
+from ..utils import get_logger, metrics, profiling, tracing
 from ..utils.cancel import Cancelled, CancelToken
 from . import bencode, utp
 from .http import TransferError
@@ -804,6 +804,7 @@ class SwarmDownloader:
         ]
         for worker in web_workers:
             worker.start()
+            profiling.ROLES.register_thread(worker, "webseed-worker")
 
         # count CONSECUTIVE fruitless rounds: a round that completed
         # pieces proves the swarm is alive, so the budget resets — a
@@ -846,6 +847,7 @@ class SwarmDownloader:
             ]
             for worker in workers:
                 worker.start()
+                profiling.ROLES.register_thread(worker, "peer-worker")
             for worker in workers:
                 # deadline: each PeerConnection registers a cancel hook that closes its socket, so a cancel unblocks every worker promptly and they exit
                 worker.join()

@@ -61,7 +61,10 @@ import time
 import urllib.parse
 import urllib.request
 
-from ..utils import admission, get_logger, incident, metrics, tracing, watchdog
+from ..utils import (
+    admission, get_logger, incident, metrics, profiling, tracing,
+    watchdog,
+)
 from ..utils.cancel import Cancelled, CancelToken
 from . import progress as transfer_progress
 from . import sources as source_accounting
@@ -395,7 +398,11 @@ class _FetchState:
         # trace_parent); segment workers bump it per received chunk —
         # a plain counter add, safe from any thread
         self.fetch_hb = watchdog.current().heartbeat("fetch")
-        self._lock = threading.Lock()
+        # named for lock-wait profiling: segment workers contend here
+        # per claimed range, so waits land in lock_wait_seconds_*
+        self._lock = profiling.named_lock(
+            "segment_state", threading.Lock()
+        )
         # the racing sources: primary first, then every admitted mirror
         # (probes already vetted by fetch() — same total, compatible
         # validator). The board owns rates/demotions; each source's
@@ -800,7 +807,9 @@ class SegmentedFetcher:
         # None records "HEAD answered but unusable" (redirect, no
         # length); connection-level failures are NOT cached (transient).
         self._probes: dict[str, tuple[_Probe | None, float]] = {}  # guarded-by: _probes_lock
-        self._probes_lock = threading.Lock()
+        self._probes_lock = profiling.named_lock(
+            "probe_cache", threading.Lock()
+        )
 
     @property
     def enabled(self) -> bool:
@@ -1076,6 +1085,9 @@ class SegmentedFetcher:
                 ]
                 for worker in workers:
                     worker.start()
+                    profiling.ROLES.register_thread(
+                        worker, "segment-worker"
+                    )
                 for worker in workers:
                     # deadline: segment workers run on sockets with finite timeouts and the fetch cancel hook shuts their sockets down, so each join is bounded
                     worker.join()

@@ -33,7 +33,7 @@ import os
 import threading
 import time
 
-from ..utils import get_logger, metrics
+from ..utils import get_logger, metrics, profiling
 
 log = get_logger("fetch.sources")
 
@@ -273,7 +273,11 @@ class SourceBoard:
             retire_errors_from_env() if retire_errors is None
             else retire_errors
         )
-        self._lock = threading.Lock()
+        # named for lock-wait profiling: every span claim/completion
+        # from every racing worker serializes on the board
+        self._lock = profiling.named_lock(
+            "source_board", threading.Lock()
+        )
         self._sources: list[Source] = []  # guarded-by: _lock
         self._last_rebalance = clock()  # guarded-by: _lock
         self._closed = False  # guarded-by: _lock

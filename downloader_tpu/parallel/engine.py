@@ -65,6 +65,7 @@ _probe_state: "tuple[str, object] | None" = None  # ("ok", devices)|("err", exc)
 
 def _devices_with_timeout():
     global _probe_state
+    wedged_timeout = None
     with _probe_lock:
         if _probe_state is None:
             timeout = float(os.environ.get("DIGEST_INIT_TIMEOUT", "30"))
@@ -89,6 +90,7 @@ def _devices_with_timeout():
             elif error:
                 _probe_state = ("err", (type(error[0]), error[0].args))
             else:
+                wedged_timeout = timeout
                 _probe_state = (
                     "err",
                     (
@@ -99,6 +101,29 @@ def _devices_with_timeout():
                         ),
                     ),
                 )
+    if wedged_timeout is not None:
+        # BENCH_r05 follow-up: a wedged device runtime used to leave
+        # only a one-line reason in the bench JSON. Capture the
+        # diagnosable evidence NOW — all-thread stacks (including the
+        # parked probe thread) plus the profile ring tail — and stitch
+        # the bundle id into the latched error, so bench_digest's
+        # `device_reason` names the incident to open. Outside the
+        # probe lock: the flight recorder walks probes and persists to
+        # disk, and concurrent digest callers must not convoy on that.
+        bundle_id = _capture_init_wedge(wedged_timeout)
+        if bundle_id is not None:
+            with _probe_lock:
+                kind, (exc_type, exc_args) = _probe_state
+                if kind == "err" and exc_type is TimeoutError:
+                    _probe_state = (
+                        "err",
+                        (
+                            exc_type,
+                            (
+                                f"{exc_args[0]} [incident={bundle_id}]",
+                            ),
+                        ),
+                    )
     kind, value = _probe_state
     if kind == "err":
         # a FRESH instance per raise: re-raising one latched object
@@ -107,6 +132,27 @@ def _devices_with_timeout():
         exc_type, exc_args = value  # type: ignore[misc]
         raise exc_type(*exc_args)
     return value
+
+
+def _capture_init_wedge(timeout: float) -> str | None:
+    """One rate-limited incident bundle for a wedged device runtime
+    (the recorder's shared auto-trigger limit applies; a suppressed or
+    failed capture costs nothing — the TimeoutError still latches)."""
+    try:
+        from ..utils import incident
+
+        bundle = incident.RECORDER.capture(
+            reason=(
+                f"accelerator device init exceeded {timeout:g}s "
+                "(wedged device runtime)"
+            ),
+            trigger="device-init",
+            extra={"timeout_s": timeout},
+        )
+        return bundle["id"] if bundle else None
+    except Exception as exc:  # never let diagnostics block fallback
+        log.debug(f"device-init incident capture failed ({exc})")
+        return None
 
 
 def _reset_device_probe() -> None:

@@ -33,7 +33,7 @@ import threading
 import time
 from typing import Callable
 
-from ..utils import get_logger
+from ..utils import get_logger, profiling
 from . import amqp_wire as wire
 from .broker import BrokerError, Message
 
@@ -553,7 +553,7 @@ class AmqpConnection:
         self._heartbeat_deadline = 0.0  # inbound idle limit (2x wire value)
         self.server_properties: dict = {}  # connection.start field table
         self.negotiated_heartbeat = 0  # tune-ok wire seconds (0 = off)
-        self._last_recv = time.monotonic()
+        self._last_recv = time.monotonic()  # shared-by-design: monotonic idle clock; reader writes, heartbeat monitor reads — a torn read mis-times one deadline check and self-heals on the next frame
 
     # -- dial ------------------------------------------------------------
 
@@ -603,23 +603,30 @@ class AmqpConnection:
         # truly dead also goes silent inbound, so the heartbeat monitor
         # (which never blocks on the write lock) tears down and closes
         # the socket, waking any sendall stuck behind a full buffer.
-        conn._reader_thread = threading.Thread(
+        conn._reader_thread = threading.Thread(  # thread-role: amqp-reader
             target=conn._read_loop, name="amqp-reader", daemon=True
         )
-        conn._dispatcher_thread = threading.Thread(
+        conn._dispatcher_thread = threading.Thread(  # thread-role: amqp-dispatcher
             target=conn._dispatch_loop, name="amqp-dispatch", daemon=True
         )
         conn._reader_thread.start()
         conn._dispatcher_thread.start()
+        profiling.ROLES.register_thread(conn._reader_thread, "amqp-reader")
+        profiling.ROLES.register_thread(
+            conn._dispatcher_thread, "amqp-dispatcher"
+        )
         if conn._heartbeat > 0:
             # the handshake reads bypass _read_loop, so the idle clock
             # still holds its construction-time value; a slow handshake
             # must not count against the first deadline window
             conn._last_recv = time.monotonic()
-            conn._heartbeat_thread = threading.Thread(
+            conn._heartbeat_thread = threading.Thread(  # thread-role: amqp-heartbeat
                 target=conn._heartbeat_loop, name="amqp-heartbeat", daemon=True
             )
             conn._heartbeat_thread.start()
+            profiling.ROLES.register_thread(
+                conn._heartbeat_thread, "amqp-heartbeat"
+            )
         return conn
 
     def _handshake(

@@ -38,7 +38,7 @@ import time
 from dataclasses import dataclass, field
 
 from ..utils import get_logger, metrics
-from ..utils import incident, tracing, watchdog
+from ..utils import incident, profiling, tracing, watchdog
 from ..utils.cancel import CancelToken
 from .broker import BrokerError, Channel, Connection, ConnectionFactory, Message
 from .delivery import Delivery
@@ -115,7 +115,11 @@ class QueueClient:
         self._drain_timeout = drain_timeout
         self._publish_confirm_timeout = publish_confirm_timeout
 
-        self._lock = threading.RLock()
+        # named for lock-wait profiling: workers, the publisher, and
+        # the supervisor all serialize on this one client lock
+        self._lock = profiling.named_lock(
+            "queue_client", threading.RLock()
+        )
         # the admission ladder's worker thread shrinks/restores this
         # while the supervisor thread reads it rebuilding channels —
         # unguarded, a rebuild could pick up a stale window AND miss
@@ -150,6 +154,7 @@ class QueueClient:
             target=self._supervise, name="queue-supervisor", daemon=True
         )
         self._supervisor.start()
+        profiling.ROLES.register_thread(self._supervisor, "queue-supervisor")
 
     # -- connection ------------------------------------------------------
 
@@ -540,12 +545,14 @@ class QueueClient:
                 # supervisor's rebuild wrote 1 and stick a false
                 # publisher-dead page until the next reconnect
                 metrics.GLOBAL.gauge_set("queue_publisher_alive", 1)
-            threading.Thread(  # thread-role: queue-publisher
+            publisher = threading.Thread(  # thread-role: queue-publisher
                 target=self._publish_loop,
                 args=(channel,),
                 name="queue-publisher",
                 daemon=True,
-            ).start()
+            )
+            publisher.start()
+            profiling.ROLES.register_thread(publisher, "queue-publisher")
             log.info("publisher created")
 
     def _supervise(self) -> None:

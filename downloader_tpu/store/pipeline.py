@@ -45,7 +45,10 @@ from concurrent.futures import Future, ThreadPoolExecutor
 
 from ..fetch.progress import SpanSet  # noqa: F401  (re-export: span math lives with the writers)
 from ..scan import MEDIA_EXTENSIONS
-from ..utils import admission, get_logger, incident, metrics, tracing, watchdog
+from ..utils import (
+    admission, get_logger, incident, metrics, profiling, tracing,
+    watchdog,
+)
 from ..utils.cancel import Cancelled, CancelToken
 from .s3 import S3Client, S3Error
 from .uploader import object_key
@@ -378,7 +381,11 @@ class PipelineSession:
         self._bucket = pipeline._bucket
         self._media_id = media_id
         self._token = token
-        self._lock = threading.Lock()
+        # named for lock-wait profiling: the fetch thread feeding
+        # spans and every part worker shipping them meet here
+        self._lock = profiling.named_lock(
+            "pipeline_session", threading.Lock()
+        )
         # a None value marks the path ineligible for streaming
         self._files: dict[str, _FileStream | None] = {}  # guarded-by: _lock
         self._trace_parent = tracing.current_span()
@@ -600,7 +607,15 @@ class StreamingPipeline:
                     max_workers=self._part_workers,
                     thread_name_prefix="stream-part",
                 )
-            return self._pool.submit(fn, *args)
+            return self._pool.submit(self._run_part, fn, *args)
+
+    @staticmethod
+    def _run_part(fn, *args):
+        # pool threads spawn lazily inside the executor, so the role
+        # registration rides the task instead of the spawn surface
+        # (idempotent after the first task on each worker)
+        profiling.ROLES.register_current("part-uploader")
+        return fn(*args)
 
     def close(self) -> None:
         with self._pool_lock:

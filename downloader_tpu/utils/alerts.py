@@ -35,7 +35,7 @@ import threading
 import time
 from collections import deque
 
-from . import metrics, tsdb, watchdog
+from . import metrics, profiling, tsdb, watchdog
 from .logging import get_logger
 
 log = get_logger("alerts")
@@ -649,6 +649,7 @@ class AlertEngine:
             )
             self._thread = thread
         thread.start()
+        profiling.ROLES.register_thread(thread, "alert-evaluator")
         log.with_fields(
             interval_s=self.interval_s, rules=rule_count
         ).info("alert engine running")

@@ -17,6 +17,9 @@ module snapshots a bounded JSON bundle:
   streaming-pipeline part states, segment fetch progress, queue client
   buffer depth),
 - the tail of the in-memory structured-log ring (utils/logging.py),
+- the profiling plane's ring tail (utils/profiling.py): top on-CPU
+  and off-CPU-wait stacks with per-role shares — where the fleet was
+  spending time in the window leading up to the wedge,
 - the watchdog's own registry snapshot.
 
 Bundles persist under ``INCIDENT_DIR`` (unset: memory only) with
@@ -235,7 +238,7 @@ class IncidentRecorder:
         stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime(now))
         bundle_id = f"incident-{stamp}-{seq:04d}"
 
-        from . import tracing, watchdog
+        from . import profiling, tracing, watchdog
 
         counters = metrics.GLOBAL.snapshot()
         deltas = {
@@ -262,6 +265,11 @@ class IncidentRecorder:
             "trace": tracing.TRACER.find(job_id) if job_id else None,
             "traces_in_flight": len(tracing.TRACER.in_flight()),
             "locks": _lock_state(),
+            # where the fleet was SPENDING time while this wedged:
+            # top cpu/wait stacks + per-role shares from the profile
+            # ring's tail (utils/profiling.py) — stacks say where
+            # threads ARE, the profile says where they have BEEN
+            "profile": profiling.PROFILER.incident_tail(),
             "watchdog": watchdog.MONITOR.snapshot(),
             "metrics": {
                 "counters": dict(sorted(counters.items())),

@@ -46,6 +46,13 @@ COUNT_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0)
 # overlap improves, not tail latency
 RATIO_BUCKETS = (0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0)
 
+# buckets (seconds) for lock-wait times (utils/profiling.py named
+# locks): contention on a hot lock shows up as µs-to-ms waits long
+# before it becomes a visible stall, so the fine end sits at 10 µs —
+# the job-scale layouts would fold every real wait into one bucket
+LOCK_WAIT_BUCKETS = (0.00001, 0.00005, 0.0001, 0.0005, 0.001, 0.005,
+                     0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+
 # `# HELP` text for the best-known series on /metrics; anything not
 # listed gets a derived one-liner from help_text() so every exported
 # family still carries a well-formed HELP line (the exposition lint in
@@ -167,6 +174,30 @@ HELP = {
     "incident_captures": "incident bundles captured",
     "incident_captures_suppressed": (
         "watchdog-triggered captures suppressed by rate limiting"
+    ),
+    # continuous profiling plane (utils/profiling.py)
+    "profile_ticks": "sampling-profiler walks over all thread stacks",
+    "profile_samples": "thread stack samples taken into the profile ring",
+    "profile_threads": "threads seen by the last profiler tick",
+    "profile_heap_snapshots": "tracemalloc heap snapshots taken",
+    "lock_wait_seconds_queue_client": (
+        "acquire wait on the queue client's state lock (contended "
+        "waits always observed; uncontended sampled as zeros)"
+    ),
+    "lock_wait_seconds_connpool": (
+        "acquire wait on the HTTP keep-alive pool's shelf lock"
+    ),
+    "lock_wait_seconds_pipeline_session": (
+        "acquire wait on a streaming-pipeline session's span/part lock"
+    ),
+    "lock_wait_seconds_segment_state": (
+        "acquire wait on a segmented fetch's shared range-queue lock"
+    ),
+    "lock_wait_seconds_probe_cache": (
+        "acquire wait on the HEAD-probe cache lock"
+    ),
+    "lock_wait_seconds_source_board": (
+        "acquire wait on a job's multi-source scheduling board lock"
     ),
 }
 

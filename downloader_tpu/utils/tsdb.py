@@ -41,7 +41,7 @@ import threading
 import time
 from collections import deque
 
-from . import metrics, watchdog
+from . import metrics, profiling, watchdog
 from .logging import get_logger
 
 log = get_logger("tsdb")
@@ -255,6 +255,7 @@ class TimeSeriesStore:
             )
             self._thread = thread
         thread.start()
+        profiling.ROLES.register_thread(thread, "tsdb-scraper")
         log.with_fields(
             interval_s=self.interval_s, samples=self._samples
         ).info("tsdb scrape thread running")

@@ -43,7 +43,7 @@ import os
 import threading
 import time
 
-from . import metrics
+from . import metrics, profiling
 from .logging import get_logger
 
 log = get_logger("watchdog")
@@ -426,6 +426,7 @@ class Watchdog:
             )
             self._thread = thread
         thread.start()
+        profiling.ROLES.register_thread(thread, "watchdog-monitor")
         log.with_fields(
             stall_s=self.stall_s, action=self.action
         ).info("stall watchdog running")

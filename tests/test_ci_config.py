@@ -301,6 +301,98 @@ def test_bench_digest_picks_up_telemetry_overhead_arm():
     assert digest["telemetry_ms"] == 0.12
 
 
+def test_circleci_runs_profiling_smoke_and_artifacts():
+    """The profiling plane's CI surface (ISSUE 13): the e2e smoke +
+    overhead guard run as a named step, the /metrics/federate first
+    consumer runs as a named step, and a bench-run flamegraph (SVG +
+    collapsed stacks) is produced and uploaded beside the analyze
+    artifacts."""
+    yaml = pytest.importorskip("yaml")
+    ci = yaml.safe_load(CONFIG.read_text())
+    steps = ci["jobs"]["tests"]["steps"]
+    commands = " ".join(
+        s["run"]["command"]
+        for s in steps
+        if isinstance(s, dict) and "run" in s
+    )
+    assert "test_profiling.py::test_e2e_profiled_small_job_wave" in commands
+    assert "test_profiling.py::test_profiler_overhead_bounded" in commands
+    assert "test_federate.py" in commands
+    assert "hack/profile_artifacts.py" in commands
+    assert (REPO / "hack" / "profile_artifacts.py").exists()
+    artifact_paths = [
+        s["store_artifacts"]["path"]
+        for s in steps
+        if isinstance(s, dict) and "store_artifacts" in s
+    ]
+    assert "/tmp/profile" in artifact_paths
+
+
+def test_bench_digest_picks_up_profile_attribution_arm():
+    """The profiling arm's acceptance numbers — attributed share,
+    top CPU role, per-stage CPU attribution — must survive into the
+    digest line beside watchdog_ms/telemetry_ms."""
+    import sys
+
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench_digest
+    finally:
+        sys.path.remove(str(REPO))
+
+    report = {
+        "value": 100.0,
+        "extra_metrics": [
+            {
+                "metric": "profile_attribution",
+                "attributed_pct": 93.5,
+                "top_cpu_role": "job-worker",
+                "stage_cpu_pct": {"fetch": 61.0, "upload": 20.5},
+            }
+        ],
+    }
+    digest = bench_digest.digest_line(report)
+    assert digest["profile_attributed_pct"] == 93.5
+    assert digest["profile_top_cpu_role"] == "job-worker"
+    assert digest["profile_cpu_fetch_pct"] == 61.0
+    assert digest["profile_cpu_upload_pct"] == 20.5
+
+
+def test_bench_digest_picks_up_device_incident():
+    """A wedged device init must surface BOTH the reason and the
+    incident bundle id through the digest line (the BENCH_r05
+    follow-up: a skipped device arm has to be diagnosable)."""
+    import sys
+
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench_digest
+    finally:
+        sys.path.remove(str(REPO))
+
+    report = {
+        "value": 100.0,
+        "extra_metrics": [
+            {
+                "metric": "digest_kernel",
+                "hashlib_GBps": 1.4,
+                "pallas_GBps": None,
+                "device_reason": (
+                    "TimeoutError: accelerator backend init exceeded "
+                    "30s (wedged device runtime?) "
+                    "[incident=incident-20260804T000000-0001]"
+                ),
+                "device_incident": "incident-20260804T000000-0001",
+            }
+        ],
+    }
+    digest = bench_digest.digest_line(report)
+    assert "wedged device runtime" in digest["device_reason"]
+    assert digest["device_incident"] == (
+        "incident-20260804T000000-0001"
+    )
+
+
 def test_circleci_runs_mirror_failover_smoke():
     """The multi-source acceptance scenario — primary killed
     mid-stream, job completes from the secondary with zero dangling

@@ -1,0 +1,158 @@
+"""First consumer for ``/metrics/federate`` (ISSUE 13 satellite).
+
+The endpoint and the ``instance`` label dimension shipped in PR 10 as
+fleet groundwork — and then nothing consumed them, so nothing proved
+the merge actually round-trips. This suite is that consumer: a stub
+child worker registers its exposition as a federation source, a real
+HTTP scrape hits ``/metrics/federate``, and a TSDB-scraper-shaped
+parser on the far side recovers every sample — parent and child —
+keyed by its ``instance`` label, values intact, family metadata
+declared exactly once. The named CI step runs this file, so the
+endpoint can no longer silently rot.
+"""
+
+import re
+import urllib.request
+
+import pytest
+
+from downloader_tpu.daemon.health import HealthServer
+from downloader_tpu.utils import metrics
+
+SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r'(\{(?:[^"}]|"(?:[^"\\]|\\.)*")*\})? (.+)$'
+)
+LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+class _FakeDaemonStats:
+    processed = 7
+    failed = retried = dropped = shed = 0
+
+
+class _FakeDaemon:
+    stats = _FakeDaemonStats()
+    worker_count = 2
+
+
+class _FakeQueueStats:
+    published = delivered = publish_retries = 0
+    reconnects = consumer_errors = 0
+
+
+class _FakeClient:
+    stats = _FakeQueueStats()
+
+    def connected(self):
+        return True
+
+
+# the stub child worker: the exposition another downloader process
+# would serve, including a family the parent also has (jobs_processed)
+# and one only the child has
+CHILD_EXPOSITION = "\n".join(
+    [
+        "# HELP downloader_jobs_processed jobs completed end-to-end "
+        "(consume through ack)",
+        "# TYPE downloader_jobs_processed counter",
+        "downloader_jobs_processed 41",
+        "# HELP downloader_child_only_total a child-only family",
+        "# TYPE downloader_child_only_total counter",
+        "downloader_child_only_total 5",
+        "# HELP downloader_admission_pressure utilization",
+        "# TYPE downloader_admission_pressure gauge",
+        "downloader_admission_pressure 0.25",
+    ]
+) + "\n"
+
+
+def scrape_side_parse(text):
+    """The TSDB-scraper side of the round trip: exposition text back
+    into ``{(family, instance): value}`` plus declared metadata —
+    exactly what a fleet-level store would ingest per worker."""
+    samples: dict[tuple, float] = {}
+    declared: dict[tuple, int] = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# "):
+            parts = line.split(" ", 3)
+            key = (parts[1], parts[2])
+            declared[key] = declared.get(key, 0) + 1
+            continue
+        match = SAMPLE_RE.match(line)
+        assert match, f"scraper could not parse: {line!r}"
+        name, labels, value = match.groups()
+        label_map = dict(LABEL_RE.findall(labels or ""))
+        assert "instance" in label_map, (
+            f"unlabeled sample leaked through the merge: {line!r}"
+        )
+        samples[(name, label_map["instance"])] = float(value)
+    return samples, declared
+
+
+@pytest.fixture
+def server():
+    metrics.GLOBAL.reset()
+    metrics.FEDERATION.reset()
+    metrics.FEDERATION.instance = "parent-0"
+    health = HealthServer(_FakeDaemon(), _FakeClient(), 0)
+    health.start()
+    yield health
+    health.stop()
+    metrics.FEDERATION.reset()
+    metrics.GLOBAL.reset()
+
+
+def test_child_source_round_trips_through_the_scraper(server):
+    metrics.FEDERATION.register_source(
+        "child-1", lambda: CHILD_EXPOSITION
+    )
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/metrics/federate", timeout=5
+    ).read().decode()
+    samples, declared = scrape_side_parse(body)
+
+    # the child's values arrive intact under ITS instance label
+    assert samples[("downloader_jobs_processed", "child-1")] == 41.0
+    assert samples[("downloader_child_only_total", "child-1")] == 5.0
+    assert samples[("downloader_admission_pressure", "child-1")] == 0.25
+    # the parent's own samples ride under the parent's label
+    assert samples[("downloader_jobs_processed", "parent-0")] == 7.0
+    # shared families declare HELP/TYPE exactly once (a duplicate
+    # declaration is a hard parse error for real scrapers)
+    for key, count in declared.items():
+        assert count == 1, f"{key} declared {count} times"
+    # the scrape counter proves the render went through the endpoint
+    assert metrics.GLOBAL.snapshot().get("federate_scrapes", 0) >= 1
+
+
+def test_failing_child_source_costs_its_samples_not_the_scrape(server):
+    metrics.FEDERATION.register_source(
+        "child-ok", lambda: CHILD_EXPOSITION
+    )
+
+    def broken():
+        raise ConnectionError("child worker down")
+
+    metrics.FEDERATION.register_source("child-down", broken)
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/metrics/federate", timeout=5
+    ).read().decode()
+    samples, _ = scrape_side_parse(body)
+    assert samples[("downloader_child_only_total", "child-ok")] == 5.0
+    assert not any(inst == "child-down" for _, inst in samples)
+    assert metrics.GLOBAL.snapshot().get("federate_source_errors") == 1
+
+
+def test_unregistered_source_disappears(server):
+    metrics.FEDERATION.register_source(
+        "child-1", lambda: CHILD_EXPOSITION
+    )
+    metrics.FEDERATION.unregister_source("child-1")
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/metrics/federate", timeout=5
+    ).read().decode()
+    samples, _ = scrape_side_parse(body)
+    assert not any(inst == "child-1" for _, inst in samples)

@@ -80,6 +80,21 @@ def exposition():
         "pipeline_overlap_ratio", 0.7, buckets=metrics.RATIO_BUCKETS
     )
     metrics.GLOBAL.observe("pipeline_overlap_ratio", 1.5)  # over-bound tail
+    # the profiling plane's families (utils/profiling.py): the
+    # sampler's counters/gauge and one lock-wait histogram per named
+    # lock, so the lint walks the real exposition each would get
+    metrics.GLOBAL.add("profile_ticks", 3)
+    metrics.GLOBAL.add("profile_samples", 30)
+    metrics.GLOBAL.add("profile_heap_snapshots", 1)
+    metrics.GLOBAL.gauge_set("profile_threads", 10)
+    for lock_name in (
+        "queue_client", "connpool", "pipeline_session",
+        "segment_state", "probe_cache", "source_board",
+    ):
+        metrics.GLOBAL.observe(
+            f"lock_wait_seconds_{lock_name}", 0.0005,
+            buckets=metrics.LOCK_WAIT_BUCKETS,
+        )
     server = HealthServer(_FakeDaemon(), _FakeClient(), 0)
     try:
         code, body, ctype = server._metrics()
@@ -226,6 +241,31 @@ def test_source_families_carry_catalogued_help(exposition):
         "http_multi_source_fetches",
         "http_source_failovers",
         "http_mirror_rejects",
+    ):
+        assert name in HELP, f"{name} missing from the HELP catalog"
+
+
+def test_profiling_families_carry_catalogued_help(exposition):
+    """Every lock-wait histogram and profiler family must have a
+    CATALOGUED HELP line (metrics.HELP), not the derived word-swap
+    fallback — the contention dashboards key on these, and the lock
+    names ARE the guarded-by identities."""
+    from downloader_tpu.utils.metrics import HELP
+
+    families, _ = _parse(exposition)
+    for lock_name in (
+        "queue_client", "connpool", "pipeline_session",
+        "segment_state", "probe_cache", "source_board",
+    ):
+        name = f"lock_wait_seconds_{lock_name}"
+        assert name in HELP, f"{name} missing from the HELP catalog"
+        exported = f"downloader_{name}"
+        assert exported in families, f"{exported} not exported"
+        assert families[exported]["type"] == "histogram"
+        assert families[exported]["help"] == HELP[name]
+    for name in (
+        "profile_ticks", "profile_samples", "profile_threads",
+        "profile_heap_snapshots",
     ):
         assert name in HELP, f"{name} missing from the HELP catalog"
 

@@ -1,0 +1,1013 @@
+"""Continuous profiling plane (ISSUE 13): thread-role-attributed
+CPU/wall sampling, named-lock wait timing, heap snapshots, and the
+/debug/profile flamegraph surface.
+
+- role registry: spawn-surface registration, ident pruning, reuse
+  safety,
+- leaf-frame classification: parked waiters vs spinners vs queue
+  parks, and contended named locks reported BY NAME in the wait
+  profile (the guarded-by identity, not "a lock"),
+- the named-lock wrapper: contended waits always observed into the
+  per-lock histogram, uncontended acquires sampled, RLock reentrancy
+  preserved, PROFILE=0 handing back the bare stdlib lock,
+- the sampler: bounded ring, window/role filters, attribution math,
+- heap snapshots: tracemalloc lifecycle owned (started only when
+  enabled, stopped on reset), top-site reports with deltas,
+- /debug/profile: all three modes as collapsed text, self-contained
+  SVG flamegraphs, and JSON with attribution,
+- the overhead guard (satellite): profiler-on vs profiler-off within
+  0.5 ms/job,
+- e2e: a wave of small jobs through the full hermetic daemon with the
+  sampler live — >=90% of samples attributed to named roles, a real
+  guarded-by lock named in the wait profile, every mode served, and
+  the incident bundle embedding the profile tail.
+"""
+
+import http.server
+import threading
+import time
+import tracemalloc
+
+import pytest
+
+from downloader_tpu.daemon.health import HealthServer
+from downloader_tpu.utils import metrics, profiling, watchdog
+from downloader_tpu.utils.profiling import (
+    NamedLock,
+    RoleRegistry,
+    SamplingProfiler,
+    flamegraph_svg,
+    named_lock,
+)
+
+
+def wait_for(predicate, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def plane():
+    """Fresh plane state per test: the process-wide profiler stopped
+    and cleared, the role registry forgotten, the enabled flag
+    restored (tests flip it to exercise the PROFILE=0 stubs)."""
+    was_enabled = profiling._ENABLED
+    yield profiling
+    profiling.PROFILER.reset()
+    profiling.PROFILER.configure(
+        interval_ms=profiling.DEFAULT_INTERVAL_MS,
+        heap_interval_s=profiling.DEFAULT_HEAP_S,
+    )
+    profiling.ROLES.reset()
+    profiling._ENABLED = was_enabled
+
+
+# ---------------------------------------------------------------------------
+# env parsers
+
+
+class TestEnvKnobs:
+    def test_defaults(self):
+        assert profiling.enabled_from_env({}) is True
+        assert profiling.interval_from_env({}) == (
+            profiling.DEFAULT_INTERVAL_MS
+        )
+        assert profiling.ring_from_env({}) == profiling.DEFAULT_RING
+        assert profiling.heap_interval_from_env({}) == 0.0
+        assert profiling.heap_top_from_env({}) == 20
+        assert profiling.heap_frames_from_env({}) == 5
+        assert profiling.lock_sample_from_env({}) == 64
+
+    def test_disable_and_overrides(self):
+        assert profiling.enabled_from_env({"PROFILE": "0"}) is False
+        assert profiling.enabled_from_env({"PROFILE": "off"}) is False
+        assert profiling.interval_from_env(
+            {"PROFILE_INTERVAL_MS": "7.5"}
+        ) == 7.5
+        assert profiling.interval_from_env(
+            {"PROFILE_INTERVAL_MS": "0.01"}
+        ) == 1.0  # floored
+        assert profiling.ring_from_env({"PROFILE_RING": "256"}) == 256
+        assert profiling.heap_interval_from_env(
+            {"PROFILE_HEAP_S": "off"}
+        ) == 0.0
+        assert profiling.heap_interval_from_env(
+            {"PROFILE_HEAP_S": "30"}
+        ) == 30.0
+
+    def test_garbage_falls_back(self):
+        assert profiling.interval_from_env(
+            {"PROFILE_INTERVAL_MS": "fast"}
+        ) == profiling.DEFAULT_INTERVAL_MS
+        assert profiling.ring_from_env(
+            {"PROFILE_RING": "many"}
+        ) == profiling.DEFAULT_RING
+        assert profiling.heap_interval_from_env(
+            {"PROFILE_HEAP_S": "sometimes"}
+        ) == profiling.DEFAULT_HEAP_S
+
+    def test_config_wires_every_profile_knob(self, plane):
+        """Every documented PROFILE_* knob must actually reach the
+        profiler through Config + serve()'s configure() call — a
+        parsed-but-unwired knob is README fiction (review finding:
+        PROFILE_HEAP_TOP/PROFILE_HEAP_FRAMES were exactly that)."""
+        from downloader_tpu.daemon.config import Config
+
+        config = Config.from_env(
+            {
+                "PROFILE": "on",
+                "PROFILE_INTERVAL_MS": "7",
+                "PROFILE_RING": "128",
+                "PROFILE_HEAP_S": "12",
+                "PROFILE_HEAP_TOP": "33",
+                "PROFILE_HEAP_FRAMES": "9",
+            }
+        )
+        assert config.profile is True
+        assert config.profile_interval_ms == 7.0
+        assert config.profile_ring == 128
+        assert config.profile_heap_s == 12.0
+        assert config.profile_heap_top == 33
+        assert config.profile_heap_frames == 9
+        profiler = SamplingProfiler()
+        profiler.configure(
+            enabled=config.profile,
+            interval_ms=config.profile_interval_ms,
+            ring=config.profile_ring,
+            heap_interval_s=config.profile_heap_s,
+            heap_top=config.profile_heap_top,
+            heap_frames=config.profile_heap_frames,
+        )
+        assert profiler.interval_ms == 7.0
+        assert profiler.heap_interval_s == 12.0
+        assert profiler.heap_top == 33
+        assert profiler.heap_frames == 9
+
+
+# ---------------------------------------------------------------------------
+# role registry
+
+
+class TestRoleRegistry:
+    def test_register_and_lookup(self):
+        registry = RoleRegistry()
+        done = threading.Event()
+        thread = threading.Thread(target=done.wait, args=(5,), daemon=True)
+        thread.start()
+        try:
+            registry.register_thread(thread, "test-waiter")
+            assert registry.role_of(thread.ident) == "test-waiter"
+            assert registry.role_of(123456789) is None
+        finally:
+            done.set()
+            thread.join()
+
+    def test_register_current_idempotent(self):
+        registry = RoleRegistry()
+        registry.register_current("worker")
+        registry.register_current("worker")
+        assert registry.role_of(threading.get_ident()) == "worker"
+        # latest wins: a pool thread re-purposed re-registers
+        registry.register_current("other")
+        assert registry.role_of(threading.get_ident()) == "other"
+
+    def test_prune_forgets_dead_idents(self):
+        registry = RoleRegistry()
+        registry.register_current("live")
+        registry._roles[999999999] = "dead"
+        registry.prune({threading.get_ident()})
+        assert registry.role_of(999999999) is None
+        assert registry.role_of(threading.get_ident()) == "live"
+
+    def test_unstarted_thread_is_a_noop(self):
+        registry = RoleRegistry()
+        thread = threading.Thread(target=lambda: None)
+        registry.register_thread(thread, "never")  # ident is None
+        assert registry.snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# named locks
+
+
+class TestNamedLock:
+    def test_disabled_plane_hands_back_the_bare_lock(self, plane):
+        plane._ENABLED = False
+        inner = threading.Lock()
+        assert named_lock("connpool", inner) is inner
+
+    def test_enabled_plane_wraps(self, plane):
+        plane._ENABLED = True
+        lock = named_lock("connpool", threading.Lock())
+        assert isinstance(lock, NamedLock)
+        assert lock.name == "connpool"
+
+    def test_contended_wait_observed_and_named(self, plane):
+        plane._ENABLED = True
+        metrics.GLOBAL.reset()
+        lock = NamedLock("connpool", threading.Lock())
+        lock.acquire()
+        seen_name = []
+        entered = threading.Event()
+
+        def contend():
+            entered.set()
+            with lock:
+                pass
+
+        thread = threading.Thread(target=contend, daemon=True)
+        thread.start()
+        entered.wait(5)
+        # while blocked, the waiter is named for the sampler
+        assert wait_for(
+            lambda: profiling.waiting_on(thread.ident) == "connpool"
+        )
+        seen_name.append(profiling.waiting_on(thread.ident))
+        time.sleep(0.02)
+        lock.release()
+        thread.join(5)
+        assert seen_name == ["connpool"]
+        assert profiling.waiting_on(thread.ident) is None
+        hists = metrics.GLOBAL.histograms()
+        bounds, counts, total, count = hists["lock_wait_seconds_connpool"]
+        assert count >= 1
+        assert total > 0  # a real wait, not the sampled zero
+        assert bounds == metrics.LOCK_WAIT_BUCKETS
+        metrics.GLOBAL.reset()
+
+    def test_uncontended_zero_waits_sampled(self, plane):
+        plane._ENABLED = True
+        metrics.GLOBAL.reset()
+        lock = NamedLock("probe_cache", threading.Lock())
+        for _ in range(profiling._LOCK_SAMPLE * 2):
+            with lock:
+                pass
+        hists = metrics.GLOBAL.histograms()
+        _, _, total, count = hists["lock_wait_seconds_probe_cache"]
+        assert count == 2  # exactly the 1-in-N samples, not all
+        assert total == 0.0
+        metrics.GLOBAL.reset()
+
+    def test_rlock_reentrancy_preserved(self, plane):
+        plane._ENABLED = True
+        lock = NamedLock("queue_client", threading.RLock())
+        with lock:
+            with lock:  # re-entry must not deadlock or mis-time
+                assert True
+        assert lock.acquire(blocking=False)
+        lock.release()
+
+    def test_locked_works_over_rlock(self, plane):
+        """RLock has no locked() before Python 3.14 — the wrapper's
+        probe fallback must answer instead of raising AttributeError
+        (review finding)."""
+        plane._ENABLED = True
+        lock = NamedLock("queue_client", threading.RLock())
+        assert lock.locked() is False
+        held = threading.Event()
+        release = threading.Event()
+
+        def hold():
+            with lock:
+                held.set()
+                release.wait(5)
+
+        thread = threading.Thread(target=hold, daemon=True)
+        thread.start()
+        assert held.wait(5)
+        assert lock.locked() is True  # held by ANOTHER thread
+        release.set()
+        thread.join(5)
+        assert lock.locked() is False
+        # plain Lock keeps the native fast path
+        assert NamedLock("connpool", threading.Lock()).locked() is False
+
+    def test_nonblocking_contended_returns_false(self, plane):
+        plane._ENABLED = True
+        lock = NamedLock("segment_state", threading.Lock())
+        lock.acquire()
+        outcome = []
+        thread = threading.Thread(
+            target=lambda: outcome.append(lock.acquire(blocking=False)),
+            daemon=True,
+        )
+        thread.start()
+        thread.join(5)
+        assert outcome == [False]
+        lock.release()
+
+
+# ---------------------------------------------------------------------------
+# classification + sampling
+
+
+class TestSampler:
+    def _sampled(self, profiler, predicate, ticks=50):
+        """Drive synchronous sample() ticks until a ring entry matches
+        (the test thread itself is excluded from its own samples)."""
+        for _ in range(ticks):
+            profiler.sample()
+            with profiler._lock:
+                entries = list(profiler._ring)
+            for entry in entries:
+                if predicate(entry):
+                    return entry
+            time.sleep(0.005)
+        return None
+
+    def test_parked_waiter_classifies_wait(self, plane):
+        profiler = SamplingProfiler()
+        done = threading.Event()
+        thread = threading.Thread(target=done.wait, args=(10,), daemon=True)
+        thread.start()
+        profiling.ROLES.register_thread(thread, "test-waiter")
+        try:
+            entry = self._sampled(
+                profiler,
+                lambda e: e[1] == "test-waiter" and e[2] == "wait",
+            )
+            assert entry is not None
+            assert entry[3] == "park"
+            assert entry[4].endswith(";wait:park")
+        finally:
+            done.set()
+            thread.join()
+
+    def test_queue_park_refines_to_queue_kind(self, plane):
+        import queue as queue_mod
+
+        profiler = SamplingProfiler()
+        q: "queue_mod.Queue" = queue_mod.Queue()
+        thread = threading.Thread(
+            target=lambda: q.get(timeout=10), daemon=True
+        )
+        thread.start()
+        profiling.ROLES.register_thread(thread, "test-getter")
+        try:
+            entry = self._sampled(
+                profiler,
+                lambda e: e[1] == "test-getter" and e[2] == "wait",
+            )
+            assert entry is not None
+            assert entry[3] == "queue"
+        finally:
+            q.put(None)
+            thread.join()
+
+    def test_spinner_classifies_cpu(self, plane):
+        profiler = SamplingProfiler()
+        stop = threading.Event()
+
+        def spin():
+            while not stop.is_set():
+                sum(range(200))
+
+        thread = threading.Thread(target=spin, daemon=True)
+        thread.start()
+        profiling.ROLES.register_thread(thread, "test-spinner")
+        try:
+            entry = self._sampled(
+                profiler,
+                lambda e: e[1] == "test-spinner" and e[2] == "cpu",
+            )
+            assert entry is not None
+            assert "spin" in entry[4]
+        finally:
+            stop.set()
+            thread.join()
+
+    def test_blocked_named_lock_stack_names_the_lock(self, plane):
+        plane._ENABLED = True
+        profiler = SamplingProfiler()
+        lock = NamedLock("source_board", threading.Lock())
+        lock.acquire()
+        thread = threading.Thread(
+            target=lambda: (lock.acquire(), lock.release()), daemon=True
+        )
+        thread.start()
+        profiling.ROLES.register_thread(thread, "test-blocked")
+        try:
+            entry = self._sampled(
+                profiler,
+                lambda e: e[1] == "test-blocked" and e[2] == "wait",
+            )
+            assert entry is not None
+            assert entry[3] == "lock:source_board"
+            assert entry[4].endswith(";wait:lock:source_board")
+        finally:
+            lock.release()
+            thread.join()
+
+    def test_collapsed_filters_role_window_and_mode(self, plane):
+        profiler = SamplingProfiler()
+        now = time.time()
+        with profiler._lock:
+            profiler._ring.append(
+                (now - 100, "old-role", "cpu", "", "a:b;c:d")
+            )
+            profiler._ring.append((now, "role-1", "cpu", "", "a:b;c:d"))
+            profiler._ring.append((now, "role-1", "cpu", "", "a:b;c:d"))
+            profiler._ring.append(
+                (now, "role-2", "wait", "park", "x:y;wait:park")
+            )
+        assert profiler.collapsed(mode="cpu", now=now) == {
+            "a:b;c:d": 3
+        }
+        assert profiler.collapsed(
+            mode="cpu", window_s=30, now=now
+        ) == {"a:b;c:d": 2}
+        assert profiler.collapsed(
+            mode="cpu", role="role-1", now=now
+        ) == {"a:b;c:d": 2}
+        assert profiler.collapsed(
+            mode="cpu", role="role-2", now=now
+        ) == {}
+        assert profiler.collapsed(mode="wait", now=now) == {
+            "x:y;wait:park": 1
+        }
+
+    def test_attribution_math(self, plane):
+        profiler = SamplingProfiler()
+        now = time.time()
+        with profiler._lock:
+            profiler._ring.append((now, "role-1", "cpu", "", "s"))
+            profiler._ring.append((now, "role-1", "wait", "park", "s"))
+            profiler._ring.append((now, None, "cpu", "", "s"))
+            profiler._ring.append((now, None, "cpu", "", "s"))
+        attribution = profiler.attribution(now=now)
+        assert attribution["samples"] == 4
+        assert attribution["attributed"] == 2
+        assert attribution["attributed_pct"] == 50.0
+        assert attribution["by_role"]["role-1"] == {
+            "cpu": 1, "wait": 1
+        }
+        assert attribution["by_role"]["unattributed"]["cpu"] == 2
+
+    def test_ring_is_bounded(self, plane):
+        profiler = SamplingProfiler(ring=64)
+        now = time.time()
+        with profiler._lock:
+            for i in range(500):
+                profiler._ring.append((now, None, "cpu", "", f"s{i}"))
+        assert len(profiler._ring) == 64
+
+    def test_own_thread_excluded(self, plane):
+        profiler = SamplingProfiler()
+        profiling.ROLES.register_current("test-self")
+        profiler.sample()
+        assert profiler.collapsed(role="test-self") == {}
+
+    def test_thread_lifecycle_and_snapshot(self, plane):
+        plane._ENABLED = True
+        profiler = SamplingProfiler(interval_ms=5)
+        profiler.start()
+        try:
+            assert profiler.running
+            assert wait_for(
+                lambda: profiler.snapshot()["ring_samples"] > 0
+            )
+            snap = profiler.snapshot()
+            assert snap["enabled"] and snap["running"]
+            assert snap["ticks"] > 0
+            assert "profile-sampler" in snap["roles"]
+        finally:
+            profiler.reset()
+        assert not profiler.running
+        assert profiler.snapshot()["ring_samples"] == 0
+
+    def test_disabled_start_is_a_noop(self, plane):
+        plane._ENABLED = False
+        profiler = SamplingProfiler(interval_ms=5)
+        profiler.start()
+        assert not profiler.running
+        profiler.reset()
+
+
+# ---------------------------------------------------------------------------
+# heap snapshots
+
+
+class TestHeapSnapshots:
+    def test_heap_reports_and_collapsed(self, plane):
+        plane._ENABLED = True
+        started_before = tracemalloc.is_tracing()
+        profiler = SamplingProfiler(
+            interval_ms=50, heap_interval_s=0.1, heap_top=10
+        )
+        profiler.start()
+        hoard = []
+        try:
+            for _ in range(50):
+                hoard.append(bytearray(64 * 1024))
+            assert wait_for(
+                lambda: profiler.heap_report() is not None, timeout=15
+            )
+            report = profiler.heap_report()
+            assert report["total_kb"] > 0
+            assert report["sites"] > 0
+            assert report["top"]
+            entry = report["top"][0]
+            assert {"site", "stack", "size_kb", "count", "delta_kb"} <= (
+                set(entry)
+            )
+            stacks = profiler.collapsed(mode="heap")
+            assert stacks
+            assert all(weight >= 1 for weight in stacks.values())
+        finally:
+            del hoard
+            profiler.reset()
+        # the plane owns the tracemalloc lifecycle it started
+        assert tracemalloc.is_tracing() == started_before
+
+    def test_heap_off_serves_empty(self, plane):
+        profiler = SamplingProfiler()
+        assert profiler.heap_report() is None
+        assert profiler.collapsed(mode="heap") == {}
+
+
+# ---------------------------------------------------------------------------
+# flamegraph SVG
+
+
+class TestFlamegraph:
+    def test_structure_and_weights(self):
+        svg = flamegraph_svg(
+            {"a:main;b:fetch": 70, "a:main;c:upload": 30}, "test"
+        )
+        assert svg.startswith("<svg")
+        assert svg.endswith("</svg>")
+        assert "a:main" in svg and "b:fetch" in svg
+        assert "100 samples" in svg
+        # the shared root spans (almost) the full width; children split it
+        assert svg.count("<rect") >= 4  # background + 3 frames
+
+    def test_escaping(self):
+        svg = flamegraph_svg({'m:<evil>&"x': 1}, 'ti<tle>&"')
+        assert "<evil>" not in svg
+        assert "&lt;evil&gt;" in svg
+        assert "ti&lt;tle&gt;" in svg
+
+    def test_empty(self):
+        svg = flamegraph_svg({}, "idle")
+        assert svg.startswith("<svg")
+        assert "no samples in window" in svg
+
+    def test_tiny_frames_elided(self):
+        stacks = {"root:big;leaf:hot": 10000}
+        stacks.update({f"root:big;noise:n{i}": 1 for i in range(50)})
+        svg = flamegraph_svg(stacks, "elide")
+        assert "leaf" in svg
+        assert "noise:n0" not in svg  # under the 0.1% cutoff
+
+
+# ---------------------------------------------------------------------------
+# the /debug/profile view
+
+
+class _FakeDaemonStats:
+    processed = failed = retried = dropped = shed = 0
+
+
+class _FakeDaemon:
+    stats = _FakeDaemonStats()
+    worker_count = 1
+
+
+class _FakeQueueStats:
+    published = delivered = publish_retries = 0
+    reconnects = consumer_errors = 0
+
+
+class _FakeClient:
+    stats = _FakeQueueStats()
+
+    def connected(self):
+        return True
+
+
+@pytest.fixture
+def health():
+    server = HealthServer(_FakeDaemon(), _FakeClient(), 0)
+    yield server
+    server._httpd.server_close()
+
+
+class TestDebugProfileView:
+    def _seed(self):
+        now = time.time()
+        with profiling.PROFILER._lock:
+            profiling.PROFILER._ring.append(
+                (now, "job-worker", "cpu", "", "m:f;m:g")
+            )
+            profiling.PROFILER._ring.append(
+                (
+                    now, "job-worker", "wait",
+                    "lock:connpool", "m:f;wait:lock:connpool",
+                )
+            )
+
+    def test_collapsed_default(self, plane, health):
+        self._seed()
+        code, body, ctype = health._debug_profile({})
+        assert code == 200 and ctype == "text/plain"
+        assert body.decode().splitlines() == ["m:f;m:g 1"]
+
+    def test_wait_mode_names_lock(self, plane, health):
+        self._seed()
+        code, body, _ = health._debug_profile({"mode": ["wait"]})
+        assert code == 200
+        assert "wait:lock:connpool 1" in body.decode()
+
+    def test_svg_format(self, plane, health):
+        self._seed()
+        code, body, ctype = health._debug_profile(
+            {"mode": ["cpu"], "format": ["svg"]}
+        )
+        assert code == 200 and ctype == "image/svg+xml"
+        assert body.startswith(b"<svg")
+
+    def test_json_format_carries_attribution(self, plane, health):
+        import json
+
+        self._seed()
+        code, body, ctype = health._debug_profile(
+            {"format": ["json"], "role": ["job-worker"]}
+        )
+        assert code == 200 and ctype == "application/json"
+        payload = json.loads(body)
+        assert payload["role"] == "job-worker"
+        assert payload["attribution"]["samples"] == 2
+        assert payload["stacks"] == {"m:f;m:g": 1}
+        assert payload["profiler"]["enabled"] in (True, False)
+
+    def test_heap_mode(self, plane, health):
+        code, body, _ = health._debug_profile(
+            {"mode": ["heap"], "format": ["json"]}
+        )
+        assert code == 200
+        import json
+
+        assert json.loads(body)["heap"] is None
+
+    def test_bad_params_400(self, plane, health):
+        assert health._debug_profile({"mode": ["gpu"]})[0] == 400
+        assert health._debug_profile({"format": ["pdf"]})[0] == 400
+        assert health._debug_profile({"window": ["soon"]})[0] == 400
+
+
+# ---------------------------------------------------------------------------
+# the overhead guard (satellite)
+
+
+def test_profiler_overhead_bounded(plane):
+    """Profiler-on vs profiler-off <= 0.5 ms/job (same pattern as the
+    watchdog/telemetry guards): a job-shaped loop — watch lifecycle,
+    stage beats, 40 named-lock crossings — with the sampler live at a
+    production-tight 5 ms tick against the same loop with the plane
+    dark. The job path's only profiling cost is the named-lock
+    try-acquire; the sampler runs off-thread."""
+    plane._ENABLED = True
+    monitor = watchdog.Watchdog(stall_s=120.0)
+    locks = [
+        NamedLock("pipeline_session", threading.Lock()),
+        NamedLock("queue_client", threading.RLock()),
+    ]
+
+    def one_job():
+        watch = monitor.job("bench")
+        with watchdog.install(watch):
+            hb = watch.stage("fetch")
+            for _ in range(32):
+                hb.beat(1024)
+                with locks[0]:
+                    pass
+            watch.stage("upload")
+            for _ in range(8):
+                with locks[1]:
+                    pass
+            watch.stage("publish")
+        monitor.unregister(watch)
+
+    def median_ms(reps=200):
+        laps = []
+        for _ in range(reps):
+            start = time.perf_counter()
+            one_job()
+            laps.append(time.perf_counter() - start)
+        laps.sort()
+        return laps[len(laps) // 2] * 1000
+
+    profiler = SamplingProfiler(interval_ms=5)
+    delta = None
+    try:
+        for _ in range(3):  # remeasure: shared 1-vCPU hosts burst
+            one_job()  # warm
+            off_ms = median_ms()
+            profiler.start()
+            time.sleep(0.02)  # the sampler is genuinely ticking
+            on_ms = median_ms()
+            profiler.stop()
+            delta = on_ms - off_ms
+            if delta <= 0.5:
+                break
+    finally:
+        profiler.reset()
+        monitor.reset()
+    assert delta is not None and delta <= 0.5, (
+        f"profiler adds {delta:.3f} ms/job — over the 0.5 ms budget "
+        "(ISSUE 13 satellite)"
+    )
+
+
+# ---------------------------------------------------------------------------
+# device-init wedge observability (satellite)
+
+
+def test_device_init_wedge_captures_incident(plane, monkeypatch):
+    """BENCH_r05 follow-up: when the accelerator device probe exceeds
+    DIGEST_INIT_TIMEOUT, ONE rate-limited incident bundle is captured
+    (all-thread stacks + profile tail) and its id rides the latched
+    TimeoutError — the string bench_digest surfaces as
+    ``device_reason``/``device_incident`` — so a wedged runtime is
+    diagnosable, not just skipped."""
+    import re
+
+    jax = pytest.importorskip("jax")
+    from downloader_tpu.parallel import engine
+    from downloader_tpu.utils import incident
+
+    incident.RECORDER.reset()
+    engine._reset_device_probe()
+    monkeypatch.setenv("DIGEST_INIT_TIMEOUT", "0.05")
+    # the wedge is releasable: the parked probe thread must not
+    # outlive this test (a lingering anonymous thread would pollute
+    # the e2e attribution run that samples every thread)
+    release = threading.Event()
+    monkeypatch.setattr(jax, "devices", lambda: release.wait(10))
+    try:
+        with pytest.raises(TimeoutError) as excinfo:
+            engine._devices_with_timeout()
+        message = str(excinfo.value)
+        assert "exceeded 0.05s" in message
+        match = re.search(r"\[incident=([\w.:-]+)\]", message)
+        assert match, message
+        bundle = incident.RECORDER.get(match.group(1))
+        assert bundle is not None
+        assert bundle["trigger"] == "device-init"
+        assert bundle["extra"]["timeout_s"] == 0.05
+        assert "profile" in bundle  # the ring tail rides along
+        assert any(
+            "digest-device-probe" in dump["name"]
+            for dump in bundle["threads"]
+        )
+        # the verdict is LATCHED: later callers re-raise the same
+        # message (incident id included) without capturing again
+        with pytest.raises(TimeoutError) as again:
+            engine._devices_with_timeout()
+        assert str(again.value) == message
+        assert len(incident.RECORDER.list_incidents()) == 1
+
+        # and bench_digest surfaces the id beside the reason
+        import sys as sys_mod
+        from pathlib import Path
+
+        repo = str(Path(__file__).resolve().parent.parent)
+        sys_mod.path.insert(0, repo)
+        try:
+            import bench_digest
+        finally:
+            sys_mod.path.remove(repo)
+        result = bench_digest.measure(piece_kb=1, batch=2)
+        assert result is not None
+        assert result["device"] == "unavailable"
+        assert match.group(1) in result["device_reason"]
+        assert result["device_incident"] == match.group(1)
+    finally:
+        release.set()
+        engine._reset_device_probe()
+        incident.RECORDER.reset()
+
+
+# ---------------------------------------------------------------------------
+# e2e: the acceptance shape on a hermetic daemon
+
+
+SMALL = b"x" * (16 * 1024)
+
+
+class _PayloadHandler(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):
+        pass
+
+    def do_HEAD(self):
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(SMALL)))
+        self.send_header("Accept-Ranges", "bytes")
+        self.end_headers()
+
+    def do_GET(self):
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(SMALL)))
+        self.end_headers()
+        self.wfile.write(SMALL)
+
+
+class _ProfiledServer(http.server.ThreadingHTTPServer):
+    """Registers its per-request handler threads so the e2e's
+    attribution covers the test rig the way bench's out-of-process
+    servers simply aren't sampled at all."""
+
+    def handle_error(self, request, client_address):
+        pass
+
+    def process_request_thread(self, request, client_address):
+        profiling.ROLES.register_current("test-origin")
+        super().process_request_thread(request, client_address)
+
+
+def test_e2e_profiled_small_job_wave(plane, tmp_path):
+    """The acceptance criteria, tier-1 sized: a wave of small jobs
+    through the full daemon with the sampler at 2 ms — samples
+    attribute >=90% to named roles, cpu/wait/heap modes all serve
+    collapsed + SVG, the wait profile names a real guarded-by lock,
+    and an incident bundle embeds the profile tail."""
+    from downloader_tpu.daemon.app import Daemon
+    from downloader_tpu.daemon.config import Config
+    from downloader_tpu.fetch import DispatchClient, HTTPBackend
+    from downloader_tpu.queue import MemoryBroker, QueueClient
+    from downloader_tpu.store import Credentials, S3Client, Uploader
+    from downloader_tpu.store.stub import S3Stub
+    from downloader_tpu.utils import incident
+    from downloader_tpu.utils.cancel import CancelToken
+    from downloader_tpu.wire import Download, Media
+
+    plane._ENABLED = True
+    profiling.PROFILER.configure(
+        interval_ms=2.0, heap_interval_s=0.2
+    )
+    # threads left running by EARLIER suites (lingering daemon
+    # threads, jax pools) are environment, not the system under
+    # measurement: register them up front so the >=90% bar judges the
+    # plane's spawn-surface coverage, exactly as serve() would have
+    # registered them at their real spawn sites
+    for alive in threading.enumerate():
+        if alive.ident is not None:
+            profiling.ROLES.register_thread(alive, "preexisting")
+    profiling.PROFILER.start()
+    profiling.ROLES.register_current("test-harness")
+
+    httpd = _ProfiledServer(("127.0.0.1", 0), _PayloadHandler)
+    accept_thread = threading.Thread(
+        target=httpd.serve_forever, daemon=True
+    )
+    accept_thread.start()
+    profiling.ROLES.register_thread(accept_thread, "test-origin")
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    token = CancelToken()
+    broker = MemoryBroker()
+    stub = S3Stub(credentials=Credentials("k", "s")).start()
+    # the stub's accept thread + per-request threads are test rig;
+    # register them like the origin's so the >=90% bar measures the
+    # plane, not the harness (production spawn surfaces register
+    # themselves)
+    profiling.ROLES.register_thread(stub._thread, "test-stub")
+    real_process = type(stub._server).process_request_thread
+
+    def stub_process(request, client_address):
+        profiling.ROLES.register_current("test-stub")
+        real_process(stub._server, request, client_address)
+
+    stub._server.process_request_thread = stub_process
+    config = Config(
+        broker="memory",
+        base_dir=str(tmp_path),
+        concurrency=2,
+        max_job_retries=1,
+        retry_delay=0.05,
+    )
+    config.batch_jobs = 8
+    config.batch_wait_ms = 50.0
+    config.batch_max_bytes = 64 * 1024
+    client = QueueClient(
+        token, broker.connect, supervisor_interval=0.05, drain_timeout=5
+    )
+    client.set_prefetch(32)
+    dispatcher = DispatchClient(
+        token,
+        str(tmp_path),
+        [HTTPBackend(progress_interval=0.01, timeout=5)],
+    )
+    uploader = Uploader(
+        config.bucket, S3Client(stub.endpoint, Credentials("k", "s"))
+    )
+    daemon = Daemon(token, client, dispatcher, uploader, config)
+    runner = threading.Thread(target=daemon.run, daemon=True)
+
+    producer = broker.connect().channel()
+    producer.declare_exchange("v1.download")
+    for i in range(2):
+        name = f"v1.download-{i}"
+        producer.declare_queue(name)
+        producer.bind_queue(name, "v1.download", name)
+
+    jobs = 40
+    incident.RECORDER.reset()
+    try:
+        for i in range(jobs):
+            body = Download(
+                media=Media(id=f"prof-{i}", source_uri=f"{base}/s.mkv")
+            ).marshal()
+            producer.publish("v1.download", "v1.download-0", body)
+        runner.start()
+        profiling.ROLES.register_thread(runner, "test-harness")
+        assert wait_for(
+            lambda: daemon.stats.processed >= jobs, timeout=30
+        ), f"only {daemon.stats.processed}/{jobs} jobs completed"
+
+        # deterministic contention on a REAL production named lock
+        # (the queue client's guarded-by: _lock identity) so the wait
+        # profile provably names it even on a fast host where organic
+        # waits fall between 2 ms ticks
+        assert isinstance(client._lock, NamedLock)
+        assert client._lock.name == "queue_client"
+        with client._lock:
+            blocked = threading.Thread(
+                target=lambda: (
+                    client._lock.acquire(), client._lock.release()
+                ),
+                daemon=True,
+            )
+            blocked.start()
+            profiling.ROLES.register_thread(blocked, "test-contender")
+            assert wait_for(
+                lambda: profiling.PROFILER.collapsed(
+                    mode="wait", role="test-contender"
+                ),
+                timeout=5,
+            )
+        blocked.join(5)
+        # heap snapshots have had >= one 0.2 s interval by now
+        assert wait_for(
+            lambda: profiling.PROFILER.heap_report() is not None,
+            timeout=10,
+        )
+
+        attribution = profiling.PROFILER.attribution()
+        assert attribution["samples"] > 100
+        assert attribution["attributed_pct"] >= 90.0, attribution
+        assert "job-worker" in attribution["by_role"]
+
+        # the wait profile names the real lock by its guarded-by name
+        wait_stacks = profiling.PROFILER.collapsed(mode="wait")
+        assert any(
+            stack.endswith(";wait:lock:queue_client")
+            for stack in wait_stacks
+        ), sorted(wait_stacks)[:10]
+
+        # all three modes serve as collapsed text AND svg through the
+        # health view (the /debug/profile surface)
+        server = HealthServer(daemon, client, 0)
+        try:
+            for mode in ("cpu", "wait", "heap"):
+                code, body_bytes, ctype = server._debug_profile(
+                    {"mode": [mode]}
+                )
+                assert code == 200 and ctype == "text/plain"
+                if mode != "heap":
+                    assert body_bytes.strip()
+                code, body_bytes, ctype = server._debug_profile(
+                    {"mode": [mode], "format": ["svg"]}
+                )
+                assert code == 200 and ctype == "image/svg+xml"
+                assert body_bytes.startswith(b"<svg")
+        finally:
+            server._httpd.server_close()
+
+        # lock-wait histograms accrued on /metrics for real locks
+        waited = [
+            name for name, (_, _, _, count)
+            in metrics.GLOBAL.histograms().items()
+            if name.startswith("lock_wait_seconds_") and count
+        ]
+        assert "lock_wait_seconds_queue_client" in waited
+
+        # incident bundles carry the ring tail
+        bundle = incident.RECORDER.capture("profiling e2e")
+        assert bundle["profile"]["attribution"]["samples"] > 0
+        assert bundle["profile"]["cpu_top"] or (
+            bundle["profile"]["wait_top"]
+        )
+    finally:
+        token.cancel()
+        if runner.ident is not None:
+            runner.join(timeout=10)
+        stub.stop()
+        httpd.shutdown()
+        incident.RECORDER.reset()
