@@ -50,7 +50,13 @@ interactive probes, BENCH_OVERLOAD_BULK bulk flood jobs),
 BENCH_PROFILE=0 to skip the continuous-profiling attribution arm
 (BENCH_PROFILE_JOBS small jobs, default 1000, run with the sampler +
 heap snapshots live; BENCH_PROFILE_DIR additionally writes the
-collapsed-stack + SVG flamegraph artifacts CI uploads).
+collapsed-stack + SVG flamegraph artifacts CI uploads),
+BENCH_FLEET=0 to skip the crash-only fleet chaos arm (BENCH_FLEET_JOBS
+multipart jobs drained by BENCH_FLEET_WORKERS real worker processes
+over a TCP broker stub, one worker SIGKILLed mid-drain, seeded
+failpoints from BENCH_FLEET_SPEC injected throughout; reports drain
+time, restart latency, redeliveries, and the dangling-multipart count,
+which must be zero).
 
 On the measurement noise: this box's absolute throughput swings ~3x on
 multi-second timescales (the same configuration has measured 85 and 580
@@ -66,9 +72,11 @@ pair cannot set the contract number.
 
 from __future__ import annotations
 
+import http.server
 import json
 import os
 import shutil
+import signal
 import subprocess
 import sys
 import tempfile
@@ -1449,6 +1457,252 @@ def run_profile_arm(
     }
 
 
+def run_fleet_chaos_arm(
+    jobs: int = 12, workers: int = 2, spec: str = ""
+) -> dict:
+    """The crash-only fleet proof as a measured arm (ISSUE 14): K real
+    worker processes drain N multipart jobs from a TCP AMQP broker
+    stub while seeded failpoints (``spec``) inject faults; one worker
+    is SIGKILLed mid-drain. Reports whether every job completed under
+    its original trace id, the drain wall time, the supervisor's
+    restart latency for the killed worker, and the dangling-multipart
+    count after the drain — the number that must be zero."""
+    import threading as threading_mod
+
+    from downloader_tpu.daemon.fleet import FleetConfig, FleetSupervisor
+    from downloader_tpu.queue.amqp_server import AmqpServerStub
+    from downloader_tpu.store.credentials import Credentials
+    from downloader_tpu.store.stub import S3Stub
+    from downloader_tpu.utils import metrics as metrics_mod
+    from downloader_tpu.utils import tracing as tracing_mod
+    from downloader_tpu.wire import Convert, Download, Media
+
+    creds = Credentials(access_key="bench-ak", secret_key="bench-sk")
+    bucket = "bench-fleet"
+    payloads = {
+        f"/movie{index}.mp4": os.urandom(512 * 1024)
+        for index in range(jobs)
+    }
+
+    class _Origin(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):
+            pass
+
+        def _serve(self, head: bool) -> None:
+            payload = payloads.get(self.path)
+            if payload is None:
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            if not head:
+                self.wfile.write(payload)
+
+        def do_HEAD(self):
+            self._serve(head=True)
+
+        def do_GET(self):
+            self._serve(head=False)
+
+    origin = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Origin)
+    origin_thread = threading_mod.Thread(
+        target=origin.serve_forever, daemon=True
+    )
+    origin_thread.start()
+    origin_url = f"http://127.0.0.1:{origin.server_address[1]}"
+
+    site = tempfile.mkdtemp(prefix="bench-fleet-", dir=_bench_root())
+    s3 = S3Stub(creds).start()
+    broker = AmqpServerStub().start()
+    converts: "list[tuple[str, str]]" = []
+    converts_lock = threading_mod.Lock()
+    supervisor = None
+    restarts_before = metrics_mod.GLOBAL.snapshot().get(
+        "fleet_worker_restarts", 0
+    )
+    try:
+        # topology + the convert sink BEFORE any worker exists, so no
+        # publish can be lost to a missing queue
+        sink_conn = broker.broker.connect()
+        sink_channel = sink_conn.channel()
+        sink_channel.set_prefetch(max(100, jobs * 4))
+        for topic in ("v1.download", "v1.convert"):
+            sink_channel.declare_exchange(topic)
+            for index in range(2):
+                name = f"{topic}-{index}"
+                sink_channel.declare_queue(name)
+                sink_channel.bind_queue(name, topic, name)
+
+        def on_convert(message, ch=sink_channel):
+            convert = Convert.unmarshal(message.body)
+            context = tracing_mod.TraceContext.parse(
+                message.headers.get(tracing_mod.TRACE_CONTEXT_HEADER)
+            )
+            with converts_lock:
+                converts.append(
+                    (
+                        convert.media.id if convert.media else "",
+                        context.trace_id if context else "",
+                    )
+                )
+            ch.ack(message.delivery_tag)
+
+        for index in range(2):
+            sink_channel.consume(f"v1.convert-{index}", on_convert)
+
+        contexts: "dict[str, str]" = {}
+        for index, path in enumerate(sorted(payloads)):
+            context = tracing_mod.TraceContext.mint()
+            contexts[f"fleet-{index}"] = context.trace_id
+            sink_channel.publish(
+                "v1.download",
+                "v1.download-0",
+                Download(
+                    media=Media(
+                        id=f"fleet-{index}",
+                        source_uri=f"{origin_url}{path}",
+                    )
+                ).marshal(),
+                headers={
+                    tracing_mod.TRACE_CONTEXT_HEADER: context.header_value()
+                },
+                persistent=True,
+            )
+
+        supervisor = FleetSupervisor(
+            FleetConfig(
+                workers=workers,
+                heartbeat_s=0.2,
+                stall_s=2.0,
+                restart_backoff_s=0.1,
+                restart_backoff_cap_s=0.5,
+                start_grace_s=60.0,
+                drain_s=15.0,
+            ),
+            worker_env={
+                "BROKER": "amqp",
+                "RABBITMQ_ENDPOINT": broker.endpoint,
+                "RABBITMQ_USERNAME": "",
+                "RABBITMQ_PASSWORD": "",
+                "S3_ENDPOINT": f"http://{s3.endpoint}",
+                "S3_ACCESS_KEY": creds.access_key,
+                "S3_SECRET_KEY": creds.secret_key,
+                "BUCKET": bucket,
+                "DOWNLOAD_DIR": site,
+                "JOB_CONCURRENCY": "2",
+                "PREFETCH": "4",
+                "BATCH_JOBS": "1",
+                "HTTP_SEGMENTS": "1",
+                "S3_MULTIPART_THRESHOLD": str(128 * 1024),
+                "S3_PART_SIZE": str(128 * 1024),
+                "PROFILE": "0",
+                "TSDB_INTERVAL": "off",
+                "ALERT_INTERVAL": "off",
+                "LSD": "off",
+                "DHT_BOOTSTRAP": "off",
+                "MAX_JOB_RETRIES": "8",
+                "RETRY_DELAY": "0.1",
+                "RETRY_DELAY_CAP": "0.5",
+                "FAILPOINT_SPEC": spec,
+                "LOG_LEVEL": "error",
+            },
+        )
+        started = time.monotonic()
+        supervisor.start()
+
+        def completed() -> int:
+            with converts_lock:
+                done = {
+                    media_id
+                    for media_id, trace_id in converts
+                    if contexts.get(media_id) == trace_id
+                }
+            return len(done)
+
+        # SIGKILL one worker once the drain is demonstrably mid-flight
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline and completed() < max(
+            1, jobs // 4
+        ):
+            time.sleep(0.1)
+        victim = next(
+            (
+                slot
+                for slot in supervisor.snapshot()["slots"]
+                if slot["pid"] and slot["state"] == "ready"
+            ),
+            None,
+        )
+        restart_s = None
+        if victim is not None:
+            killed_at = time.monotonic()
+            try:
+                os.kill(victim["pid"], signal.SIGKILL)
+            except ProcessLookupError:
+                # the armed failpoints (or a crash of its own) beat us
+                # to it: the restart machinery still gets exercised,
+                # only the latency measurement is forfeit
+                victim = None
+        if victim is not None:
+            # observe the dip first (poll() flips fast on SIGKILL) so a
+            # sub-poll-interval respawn doesn't read as restart_s=0
+            while (
+                time.monotonic() - killed_at < 5.0
+                and supervisor.snapshot()["workers_alive"] >= workers
+            ):
+                time.sleep(0.02)
+            while (
+                time.monotonic() - killed_at < 60.0
+                and supervisor.snapshot()["workers_alive"] < workers
+            ):
+                time.sleep(0.1)
+            if supervisor.snapshot()["workers_alive"] >= workers:
+                restart_s = time.monotonic() - killed_at
+        while time.monotonic() < deadline and completed() < jobs:
+            time.sleep(0.2)
+        elapsed = time.monotonic() - started
+        with converts_lock:
+            total_converts = len(converts)
+        dangling_deadline = time.monotonic() + 20.0
+        while time.monotonic() < dangling_deadline and (
+            s3.list_multipart_uploads()
+        ):
+            time.sleep(0.2)
+        dangling = len(s3.list_multipart_uploads())
+        return {
+            "metric": "fleet_chaos",
+            "jobs": jobs,
+            "workers": workers,
+            "failpoint_spec": spec,
+            "completed": completed(),
+            "elapsed_s": round(elapsed, 2),
+            "restart_s": None if restart_s is None else round(restart_s, 2),
+            "restarts": metrics_mod.GLOBAL.snapshot().get(
+                "fleet_worker_restarts", 0
+            )
+            - restarts_before,
+            "duplicate_converts": total_converts - completed(),
+            "dangling_multiparts": dangling,
+        }
+    finally:
+        if supervisor is not None:
+            supervisor.drain()
+        try:
+            sink_conn.close()
+        except Exception:
+            _log("bench: fleet sink close failed (already gone)")
+        broker.stop()
+        s3.stop()
+        origin.shutdown()
+        origin.server_close()
+        shutil.rmtree(site, ignore_errors=True)
+
+
 def main() -> None:
     jobs = int(os.environ.get("BENCH_JOBS", 24))
     mb_per_job = int(os.environ.get("BENCH_MB", 48))
@@ -1733,6 +1987,30 @@ def main() -> None:
                 f"locks {profile_arm['wait_locks']}"
             )
 
+        fleet_chaos = None
+        if os.environ.get("BENCH_FLEET", "1") != "0":
+            fleet_jobs = max(4, int(os.environ.get("BENCH_FLEET_JOBS", 12)))
+            fleet_workers = max(
+                2, int(os.environ.get("BENCH_FLEET_WORKERS", 2))
+            )
+            fleet_spec = os.environ.get(
+                "BENCH_FLEET_SPEC", "queue.publish=fail:0.1"
+            )
+            _log(
+                f"bench: fleet chaos arm, {fleet_workers} worker processes "
+                f"draining {fleet_jobs} multipart jobs with one mid-drain "
+                f"SIGKILL and failpoints '{fleet_spec}'"
+            )
+            fleet_chaos = run_fleet_chaos_arm(
+                jobs=fleet_jobs, workers=fleet_workers, spec=fleet_spec
+            )
+            _log(
+                f"bench: fleet chaos completed {fleet_chaos['completed']}/"
+                f"{fleet_chaos['jobs']} in {fleet_chaos['elapsed_s']}s, "
+                f"restart {fleet_chaos['restart_s']}s, dangling "
+                f"multiparts {fleet_chaos['dangling_multiparts']}"
+            )
+
         extra_metrics = [
             {
                 "metric": "job_overhead_latency_ms",
@@ -1776,6 +2054,8 @@ def main() -> None:
             extra_metrics.append(telemetry_ablation)
         if profile_arm is not None:
             extra_metrics.append(profile_arm)
+        if fleet_chaos is not None:
+            extra_metrics.append(fleet_chaos)
         if os.environ.get("BENCH_DIGEST", "1") != "0":
             _log("bench: digest kernel micro-benchmark (pallas vs hashlib)")
             try:

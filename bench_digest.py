@@ -103,6 +103,17 @@ def digest_line(report: dict) -> dict:
             stages = extra.get("stage_cpu_pct") or {}
             for stage, pct in stages.items():
                 out[f"profile_cpu_{stage}_pct"] = pct
+        elif metric == "fleet_chaos":
+            out["fleet_completed"] = (
+                f"{extra.get('completed')}/{extra.get('jobs')}"
+            )
+            out["fleet_restart_s"] = extra.get("restart_s")
+            out["fleet_dangling_multiparts"] = extra.get(
+                "dangling_multiparts"
+            )
+            out["fleet_duplicate_converts"] = extra.get(
+                "duplicate_converts"
+            )
     return out
 
 

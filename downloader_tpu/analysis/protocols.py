@@ -236,6 +236,27 @@ RUNTIME_PROTOCOLS: dict[str, dict] = {
             },
         ],
     },
+    "worker-lifecycle": {
+        "module": "downloader_tpu.daemon.fleet",
+        "methods": [
+            # the fleet's declared lifecycle (spawn -> ready ->
+            # draining -> reaped): every spawned worker process must be
+            # collected by exactly one reap — a supervisor path that
+            # loses a handle leaks a zombie (and its federation source)
+            {
+                "class": "WorkerHandle",
+                "name": "spawn",
+                "kind": "acquire",
+                "key": "result",
+            },
+            {
+                "class": "WorkerHandle",
+                "name": "reap",
+                "kind": "release",
+                "key": "self",
+            },
+        ],
+    },
     "multipart-upload": {
         "module": "downloader_tpu.store.s3",
         "methods": [

@@ -63,15 +63,31 @@ def _build_parser() -> argparse.ArgumentParser:
     )
 
     serve = sub.add_parser("serve", help="run the queue-driven daemon")
+    # flag defaults come FROM the documented env contract: a fleet
+    # supervisor (or an operator) configuring BUCKET/DOWNLOAD_DIR in
+    # the environment must not be silently overridden by the argparse
+    # defaults riding every `serve` invocation
     serve.add_argument(
-        "--base-dir", default=os.path.join(os.getcwd(), "downloading")
+        "--base-dir",
+        default=os.environ.get("DOWNLOAD_DIR")
+        or os.path.join(os.getcwd(), "downloading"),
     )
-    serve.add_argument("--bucket", default=DEFAULT_BUCKET)
+    serve.add_argument(
+        "--bucket", default=os.environ.get("BUCKET", DEFAULT_BUCKET)
+    )
     serve.add_argument(
         "--concurrency",
         type=int,
         default=int(os.environ.get("JOB_CONCURRENCY", "1")),
         help="parallel job workers (reference fixes this at 1, cmd:100-103)",
+    )
+    serve.add_argument(
+        "--workers",
+        type=int,
+        default=int(os.environ.get("FLEET_WORKERS", "0")),
+        help="run a crash-only fleet: supervise this many worker "
+        "PROCESSES (each its own serve() against the broker) with "
+        "liveness-watched restarts; 0/1 = single process (default)",
     )
     return parser
 
@@ -251,6 +267,16 @@ def main(argv: list[str] | None = None) -> int:
         if args.command == "download-once":
             return _download_once(args)
         if args.command == "serve":
+            if args.workers and args.workers > 1:
+                from .daemon.fleet import run_fleet
+
+                # worker processes inherit the environment; base-dir /
+                # bucket / concurrency ride through it so every worker
+                # runs the exact single-process serve() contract
+                os.environ["DOWNLOAD_DIR"] = os.path.abspath(args.base_dir)
+                os.environ["BUCKET"] = args.bucket
+                os.environ["JOB_CONCURRENCY"] = str(args.concurrency)
+                return run_fleet(workers=args.workers)
             try:
                 from .daemon.app import serve
             except ImportError as exc:

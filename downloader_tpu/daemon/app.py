@@ -39,6 +39,7 @@ from ..store import Uploader, UploadError
 from ..utils import metrics, configure_from_env, get_logger, tracing
 from ..utils import admission, incident, profiling, watchdog
 from ..utils.cancel import Cancelled, CancelToken
+from ..utils.failpoints import FAILPOINTS
 from ..wire import Convert, Download, WireError
 from .config import Config
 
@@ -312,6 +313,15 @@ class Daemon:
             if session is not None:
                 session.close()
 
+        # crash-matrix boundary: a kill here dies after fetch/scan/
+        # upload but before the Convert hand-off; fail mode routes the
+        # job through the normal transient-retry path
+        if FAILPOINTS.fire("daemon.pre_publish"):
+            self._settle_transient(
+                delivery, job_log, trace,
+                TransferError("failpoint: daemon.pre_publish"),
+            )
+            return
         log.info("creating v1.convert message")
         convert = Convert(
             created_at=time.strftime("%Y-%m-%d %H:%M:%S %z"), media=media
@@ -343,6 +353,15 @@ class Daemon:
             return
         job_log.info("finished processing")
         watch.stage("ack")
+        # crash-matrix boundary: a kill here dies with the Convert
+        # durably published but the original unacked — the duplicate-
+        # delivery window at-least-once promises to survive. Fail mode
+        # requeues, modeling the ack frame never reaching the broker.
+        if FAILPOINTS.fire("daemon.pre_ack"):
+            delivery.nack(requeue=True)
+            self.stats.bump(retried=1)
+            trace.set_status("requeued")
+            return
         with tracing.span("ack"):
             delivery.ack()
         self.stats.bump(processed=1)
@@ -591,6 +610,15 @@ class Daemon:
         for state, flushed in zip(ready, confirmed):
             state.publish_span.finish()
             if flushed:
+                # per-job crash-matrix boundary, mirroring the
+                # unbatched pre-ack seam: confirmed publish, unacked
+                # original (fail mode = the ack frame never made it)
+                if FAILPOINTS.fire("daemon.pre_ack"):
+                    state.delivery.nack(requeue=True)
+                    self.stats.bump(retried=1)
+                    state.trace.root.set_status("requeued")
+                    self._finish_fast_job(state)
+                    continue
                 acks.append(state)
                 continue
             state.job_log.error("convert publish unconfirmed; requeueing job")
@@ -704,6 +732,13 @@ class Daemon:
                 if job_dir is None:
                     root.set_status("fallback")
                     return _FALLBACK
+                # same crash-matrix boundary as the unbatched lane
+                if FAILPOINTS.fire("daemon.pre_publish"):
+                    self._settle_transient(
+                        delivery, job_log, root,
+                        TransferError("failpoint: daemon.pre_publish"),
+                    )
+                    return None
                 log.info("creating v1.convert message")
                 watch.stage("publish")
                 convert = Convert(
@@ -1192,6 +1227,11 @@ def serve(
     tracing.TRACER.set_capacity(config.trace_ring)
     tracing.TRACER.propagate = config.trace_propagate
 
+    # fault injection (utils/failpoints.py): with no FAILPOINT_SPEC the
+    # seams stay named no-ops; armed, every injection is a pure function
+    # of FAILPOINT_SEED so a chaos run reproduces from its seed
+    FAILPOINTS.configure_from_env()
+
     # telemetry plane: the local time-series store samples the registry
     # on an interval, and the alert engine evaluates burn-rate/threshold
     # rules over it — both liveness-watched loops, both off when their
@@ -1307,9 +1347,23 @@ def serve(
         health = HealthServer(
             daemon, client, config.health_port, config.health_host
         ).start()
+    # fleet membership: the supervisor handed down a heartbeat-file
+    # path; the writer thread feeds the parent's liveness verdicts
+    # (wall-clock beat + publisher gauge + watchdog stalled count)
+    heartbeat = None
+    if config.fleet_heartbeat_file:
+        from .fleet import HeartbeatWriter
+
+        heartbeat = HeartbeatWriter(
+            config.fleet_heartbeat_file,
+            config.fleet_heartbeat_s,
+            health_port=health.port if health is not None else 0,
+        ).start()
     try:
         daemon.run()
     finally:
+        if heartbeat is not None:
+            heartbeat.stop()
         profiling.PROFILER.stop()
         alerts.ENGINE.stop()
         tsdb.STORE.stop()

@@ -126,6 +126,12 @@ class Config:
     dlq_max_redeliver: int = 3
     dlq_retry_after_base: float = 5.0
     dlq_retry_after_cap: float = 300.0
+    # crash-only fleet (daemon/fleet.py): when the supervisor spawned
+    # this process it hands down the heartbeat-file path and cadence;
+    # serve() then runs a HeartbeatWriter thread feeding the parent's
+    # liveness verdicts. Empty = not a fleet member, no thread.
+    fleet_heartbeat_file: str = ""
+    fleet_heartbeat_s: float = 1.0
 
     @property
     def dead_letter_queue(self) -> str:
@@ -250,4 +256,10 @@ class Config:
         config.dlq_retry_after_cap = float(
             env.get("DLQ_RETRY_AFTER_CAP", config.dlq_retry_after_cap)
         )
+        from .fleet import heartbeat_from_env
+
+        config.fleet_heartbeat_file = (
+            env.get("FLEET_HEARTBEAT_FILE") or ""
+        ).strip()
+        config.fleet_heartbeat_s = heartbeat_from_env(env)
         return config

@@ -395,95 +395,8 @@ class HealthServer:
         )
 
     def _metrics(self) -> tuple[int, bytes, str]:
-        # Prometheus exposition: every family gets one well-formed
-        # `# HELP` + `# TYPE` pair before its samples (metrics.py keeps
-        # the help catalog) — tests/test_metrics_lint.py gates the
-        # format, histogram triples, and family uniqueness
-        lines = []
-        for name, value in self._counters().items():
-            metric = f"downloader_{name}"
-            lines.append(f"# HELP {metric} {metrics.help_text(name)}")
-            lines.append(f"# TYPE {metric} counter")
-            lines.append(f"{metric} {value}")
-        metric = "downloader_broker_connected"
-        lines.append(
-            f"# HELP {metric} {metrics.help_text('broker_connected')}"
-        )
-        lines.append(f"# TYPE {metric} gauge")
-        lines.append(f"{metric} {1 if self._connected() else 0}")
-        # live levels (active swarms / peer connections) — the level
-        # series exist from the first scrape (value 0), not from the
-        # first torrent job: dashboards and absent()-style alerts need
-        # the series present before traffic
-        gauges = {
-            "torrent_active_swarms": 0.0,
-            "torrent_active_peers": 0.0,
-            # telemetry-plane levels, present from the first scrape so
-            # alert expressions and dashboards never see a gap: the
-            # publisher gauge goes live when the queue client builds
-            # its publisher; alerts_firing when the engine evaluates
-            "alerts_firing": 0.0,
-            "queue_publisher_alive": 0.0,
-            **metrics.GLOBAL.gauges(),
-        }
-        for name, value in sorted(gauges.items()):
-            metric = f"downloader_{name}"
-            lines.append(f"# HELP {metric} {metrics.help_text(name)}")
-            lines.append(f"# TYPE {metric} gauge")
-            lines.append(f"{metric} {value:g}")
-        # fixed-bucket histograms, Prometheus exposition: cumulative
-        # le-buckets + _sum + _count, per-series bucket bounds (job
-        # latency uses job-scale buckets; the tracing layer's
-        # overhead_seconds uses ms-scale ones — see metrics.py).
-        # Seeded like the gauges: the series must exist from the first
-        # scrape — an idle (or only-failing) daemon must read as zero
-        # completions, not as "no data"
-        histograms = {
-            **{
-                name: (
-                    metrics.LATENCY_BUCKETS,
-                    [0] * len(metrics.LATENCY_BUCKETS), 0.0, 0,
-                )
-                for name in (
-                    "job_duration_seconds", "fetch_seconds",
-                    "scan_seconds", "upload_seconds", "publish_seconds",
-                    # per-class SLO series: present from the first
-                    # scrape so an interactive-p99 alert can use
-                    # absent()-free expressions before any traffic
-                    "slo_job_duration_seconds_interactive",
-                    "slo_job_duration_seconds_bulk",
-                )
-            },
-            "overhead_seconds": (
-                metrics.OVERHEAD_BUCKETS,
-                [0] * len(metrics.OVERHEAD_BUCKETS), 0.0, 0,
-            ),
-            **metrics.GLOBAL.histograms(),
-        }
-        for name, (bounds, counts, total, count) in sorted(
-            histograms.items()
-        ):
-            metric = f"downloader_{name}"
-            lines.append(f"# HELP {metric} {metrics.help_text(name)}")
-            lines.append(f"# TYPE {metric} histogram")
-            for le, bucket_count in zip(bounds, counts):
-                lines.append(
-                    f'{metric}_bucket{{le="{le:g}"}} {bucket_count}'
-                )
-            lines.append(f'{metric}_bucket{{le="+Inf"}} {count}')
-            lines.append(f"{metric}_sum {total:.6f}")
-            lines.append(f"{metric}_count {count}")
-        body = ("\n".join(lines) + "\n").encode()
+        body = render_metrics(self._counters(), self._connected())
         return 200, body, "text/plain; version=0.0.4"
-
-    # one exposition sample line: name, optional {labels}, value. The
-    # label body is parsed quote-aware — label VALUES may legally
-    # contain '}' (path templates, regexes), so a naive [^}]* would
-    # drop those samples from the merge as "malformed"
-    _SAMPLE_RE = re.compile(
-        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
-        r'(\{(?:[^"}]|"(?:[^"\\]|\\.)*")*\})? (.+)$'
-    )
 
     def _metrics_federate(self) -> tuple[int, bytes, str]:
         """ROADMAP item 1's "one /metrics scrape, per-worker labels":
@@ -493,54 +406,173 @@ class HealthServer:
         (first worker wins); a failing child source costs its samples
         and a counter bump, never the scrape."""
         _, own_body, _ = self._metrics()
-        instance = metrics.FEDERATION.instance or "worker-0"
-        lines: list[str] = []
-        declared: set[tuple[str, str]] = set()
-
-        def fold(text: str, inst: str) -> None:
-            # label values are quoted strings in the exposition format:
-            # an instance like us-"east" must escape, not break parsing
-            escaped = inst.replace("\\", "\\\\").replace('"', '\\"')
-            for line in text.splitlines():
-                if not line.strip():
-                    continue
-                if line.startswith("#"):
-                    parts = line.split(" ", 3)
-                    if len(parts) >= 3:
-                        key = (parts[1], parts[2])
-                        if key in declared:
-                            continue
-                        declared.add(key)
-                    lines.append(line)
-                    continue
-                match = self._SAMPLE_RE.match(line)
-                if match is None:
-                    continue  # a malformed child line never poisons ours
-                name, labels, value = match.groups()
-                inner = (labels or "{}")[1:-1]
-                if inner.startswith('instance="') or ',instance="' in inner:
-                    # the source already tagged its samples (a child
-                    # that is itself federating): keep its labels —
-                    # duplicating the label name is a hard parse error.
-                    # Anchored match: a label NAMED xyz_instance must
-                    # not suppress the tagging
-                    lines.append(line)
-                    continue
-                tag = f'instance="{escaped}"'
-                inner = tag if not inner else f"{tag},{inner}"
-                lines.append(f"{name}{{{inner}}} {value}")
-
-        fold(own_body.decode(), instance)
-        for inst, fetch in sorted(metrics.FEDERATION.sources().items()):
-            try:
-                text = fetch()
-            except Exception as exc:
-                metrics.GLOBAL.add("federate_source_errors")
-                log.with_fields(instance=inst).warning(
-                    f"federate source scrape failed: {exc}"
-                )
-                continue
-            fold(text, inst)
-        metrics.GLOBAL.add("federate_scrapes")
-        body = ("\n".join(lines) + "\n").encode()
+        body = render_federated(own_body)
         return 200, body, "text/plain; version=0.0.4"
+
+
+# -- exposition renderers (module-level: the fleet supervisor serves the
+# -- same formats without a Daemon/QueueClient behind it) -------------------
+
+
+def render_metrics(
+    extra_counters: "dict | None" = None,
+    broker_connected: "bool | None" = None,
+) -> bytes:
+    """Prometheus text exposition of the process-wide registry plus
+    ``extra_counters`` (the daemon/queue stats the worker's health
+    server folds in; the fleet supervisor passes only the registry).
+    Every family gets one well-formed `# HELP` + `# TYPE` pair before
+    its samples (metrics.py keeps the help catalog) —
+    tests/test_metrics_lint.py gates the format, histogram triples, and
+    family uniqueness."""
+    lines = []
+    counters = (
+        extra_counters
+        if extra_counters is not None
+        else dict(sorted(metrics.GLOBAL.snapshot().items()))
+    )
+    for name, value in counters.items():
+        metric = f"downloader_{name}"
+        lines.append(f"# HELP {metric} {metrics.help_text(name)}")
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric} {value}")
+    if broker_connected is not None:
+        metric = "downloader_broker_connected"
+        lines.append(
+            f"# HELP {metric} {metrics.help_text('broker_connected')}"
+        )
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {1 if broker_connected else 0}")
+    # live levels (active swarms / peer connections) — the level
+    # series exist from the first scrape (value 0), not from the
+    # first torrent job: dashboards and absent()-style alerts need
+    # the series present before traffic
+    gauges = {
+        "torrent_active_swarms": 0.0,
+        "torrent_active_peers": 0.0,
+        # telemetry-plane levels, present from the first scrape so
+        # alert expressions and dashboards never see a gap: the
+        # publisher gauge goes live when the queue client builds
+        # its publisher; alerts_firing when the engine evaluates
+        "alerts_firing": 0.0,
+        "queue_publisher_alive": 0.0,
+        **metrics.GLOBAL.gauges(),
+    }
+    for name, value in sorted(gauges.items()):
+        metric = f"downloader_{name}"
+        lines.append(f"# HELP {metric} {metrics.help_text(name)}")
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {value:g}")
+    # fixed-bucket histograms, Prometheus exposition: cumulative
+    # le-buckets + _sum + _count, per-series bucket bounds (job
+    # latency uses job-scale buckets; the tracing layer's
+    # overhead_seconds uses ms-scale ones — see metrics.py).
+    # Seeded like the gauges: the series must exist from the first
+    # scrape — an idle (or only-failing) daemon must read as zero
+    # completions, not as "no data"
+    histograms = {
+        **{
+            name: (
+                metrics.LATENCY_BUCKETS,
+                [0] * len(metrics.LATENCY_BUCKETS), 0.0, 0,
+            )
+            for name in (
+                "job_duration_seconds", "fetch_seconds",
+                "scan_seconds", "upload_seconds", "publish_seconds",
+                # per-class SLO series: present from the first
+                # scrape so an interactive-p99 alert can use
+                # absent()-free expressions before any traffic
+                "slo_job_duration_seconds_interactive",
+                "slo_job_duration_seconds_bulk",
+            )
+        },
+        "overhead_seconds": (
+            metrics.OVERHEAD_BUCKETS,
+            [0] * len(metrics.OVERHEAD_BUCKETS), 0.0, 0,
+        ),
+        **metrics.GLOBAL.histograms(),
+    }
+    for name, (bounds, counts, total, count) in sorted(
+        histograms.items()
+    ):
+        metric = f"downloader_{name}"
+        lines.append(f"# HELP {metric} {metrics.help_text(name)}")
+        lines.append(f"# TYPE {metric} histogram")
+        for le, bucket_count in zip(bounds, counts):
+            lines.append(
+                f'{metric}_bucket{{le="{le:g}"}} {bucket_count}'
+            )
+        lines.append(f'{metric}_bucket{{le="+Inf"}} {count}')
+        lines.append(f"{metric}_sum {total:.6f}")
+        lines.append(f"{metric}_count {count}")
+    return ("\n".join(lines) + "\n").encode()
+
+
+# one exposition sample line: name, optional {labels}, value. The
+# label body is parsed quote-aware — label VALUES may legally
+# contain '}' (path templates, regexes), so a naive [^}]* would
+# drop those samples from the merge as "malformed"
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r'(\{(?:[^"}]|"(?:[^"\\]|\\.)*")*\})? (.+)$'
+)
+
+
+def render_federated(own_body: bytes) -> bytes:
+    """Merge ``own_body`` (this process's exposition) with every
+    registered child source in ``metrics.FEDERATION``, tagging each
+    sample with its ``instance`` label. Family metadata is declared
+    once (first source wins); a failing child source costs its samples
+    and a counter bump, never the scrape. Shared by the worker's
+    ``/metrics/federate`` and the fleet supervisor's, which registers
+    one HTTP scraper per live worker process."""
+    instance = metrics.FEDERATION.instance or "worker-0"
+    lines: list[str] = []
+    declared: set[tuple[str, str]] = set()
+
+    def fold(text: str, inst: str) -> None:
+        # label values are quoted strings in the exposition format:
+        # an instance like us-"east" must escape, not break parsing
+        escaped = inst.replace("\\", "\\\\").replace('"', '\\"')
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("#"):
+                parts = line.split(" ", 3)
+                if len(parts) >= 3:
+                    key = (parts[1], parts[2])
+                    if key in declared:
+                        continue
+                    declared.add(key)
+                lines.append(line)
+                continue
+            match = _SAMPLE_RE.match(line)
+            if match is None:
+                continue  # a malformed child line never poisons ours
+            name, labels, value = match.groups()
+            inner = (labels or "{}")[1:-1]
+            if inner.startswith('instance="') or ',instance="' in inner:
+                # the source already tagged its samples (a child
+                # that is itself federating): keep its labels —
+                # duplicating the label name is a hard parse error.
+                # Anchored match: a label NAMED xyz_instance must
+                # not suppress the tagging
+                lines.append(line)
+                continue
+            tag = f'instance="{escaped}"'
+            inner = tag if not inner else f"{tag},{inner}"
+            lines.append(f"{name}{{{inner}}} {value}")
+
+    fold(own_body.decode(), instance)
+    for inst, fetch in sorted(metrics.FEDERATION.sources().items()):
+        try:
+            text = fetch()
+        except Exception as exc:
+            metrics.GLOBAL.add("federate_source_errors")
+            log.with_fields(instance=inst).warning(
+                f"federate source scrape failed: {exc}"
+            )
+            continue
+        fold(text, inst)
+    metrics.GLOBAL.add("federate_scrapes")
+    return ("\n".join(lines) + "\n").encode()

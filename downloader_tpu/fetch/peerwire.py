@@ -18,6 +18,7 @@ import struct
 import time
 
 from ..utils import get_logger, metrics
+from ..utils.failpoints import FAILPOINTS
 from ..utils.netio import SocketWaiter
 from . import bencode, mse, utp
 from .http import TransferError
@@ -130,6 +131,8 @@ def _recv_into(sock: socket.socket, count: int) -> bytes | None:  # deadline: ca
     side's idiomatic exception — TransferError outbound, OSError inbound)."""
     data = bytearray()
     while len(data) < count:
+        if FAILPOINTS.fire("peer.recv"):
+            raise ConnectionResetError("failpoint: peer.recv reset")
         chunk = sock.recv(count - len(data))
         if not chunk:
             return None
@@ -412,6 +415,8 @@ class PeerConnection:
 
     def send_message(self, msg_id: int, payload: bytes = b"") -> None:
         self._last_send = time.monotonic()
+        if FAILPOINTS.fire("peer.send"):
+            raise BrokenPipeError("failpoint: peer.send broken")
         self._sock.sendall(_frame(msg_id, payload))
 
     def read_message(self) -> tuple[int, bytes]:

@@ -66,6 +66,7 @@ from ..utils import (
     watchdog,
 )
 from ..utils.cancel import Cancelled, CancelToken
+from ..utils.failpoints import FAILPOINTS
 from . import progress as transfer_progress
 from . import sources as source_accounting
 from .connpool import ConnectionPool
@@ -1049,6 +1050,8 @@ class SegmentedFetcher:
         admission.LEDGER.charge("disk", scratch, probe.total)
         state: _FetchState | None = None
         try:
+            if FAILPOINTS.fire("segments.preallocate"):
+                raise OSError(28, "failpoint: segments.preallocate disk full")
             os.truncate(part_file.fileno(), probe.total)
 
             sink = transfer_progress.current()
@@ -1400,6 +1403,8 @@ class SegmentedFetcher:
                     if token.cancelled():
                         raise Cancelled()
                     try:
+                        if FAILPOINTS.fire("http.read"):
+                            raise TimeoutError("failpoint: http.read")
                         chunk = response.read(min(_CHUNK, total - wrote))
                     except (
                         http.client.HTTPException, OSError, TimeoutError,
@@ -1577,6 +1582,8 @@ class SegmentedFetcher:
                     return False
                 state.token.raise_if_cancelled()
                 try:
+                    if FAILPOINTS.fire("segments.read"):
+                        raise TimeoutError("failpoint: segments.read")
                     chunk = response.read(min(_CHUNK, remaining))
                 except (
                     http.client.HTTPException, OSError, TimeoutError,
@@ -1590,6 +1597,8 @@ class SegmentedFetcher:
                 # pwrite may write short (near-full disk, RLIMIT_FSIZE):
                 # advancing by len(chunk) anyway would journal — and
                 # stream-upload — preallocated zeros as covered bytes
+                if FAILPOINTS.fire("segments.pwrite"):
+                    raise OSError(28, "failpoint: segments.pwrite disk full")
                 view = memoryview(chunk)
                 write_at = seg.pos
                 while view:

@@ -56,17 +56,47 @@ _CALIBRATE_BYTES = 4 * 1024 * 1024
 # accelerator-init watchdog: jax backend initialisation (the first
 # jax.devices() call) blocks INDEFINITELY when the device runtime is
 # wedged — observed with a dead TPU tunnel — and a media job must fall
-# back to hashlib, not hang. The probe runs once per process in a
-# daemon thread; a timeout latches "unavailable" for the process (the
-# abandoned thread finishing later is harmless).
+# back to hashlib, not hang. The probe runs once per cooldown window in
+# a daemon thread; a timeout/err verdict holds for DIGEST_REPROBE_S
+# seconds (0 latches for the process lifetime, the pre-ISSUE-14
+# behavior), after which the NEXT caller re-probes — a runtime that
+# recovers (tunnel back up, driver restarted) is re-adopted without a
+# process restart, and a still-wedged one costs one probe per window,
+# never a job. The abandoned probe thread finishing later is harmless.
 _probe_lock = threading.Lock()
 _probe_state: "tuple[str, object] | None" = None  # ("ok", devices)|("err", exc)
+_probe_failed_at: float | None = None  # monotonic; err verdicts only
+
+
+def reprobe_cooldown_from_env(environ=None) -> float:
+    """``DIGEST_REPROBE_S``: seconds a failed device probe's verdict
+    holds before the next caller re-probes (0 = latch forever)."""
+    env = os.environ if environ is None else environ
+    raw = (env.get("DIGEST_REPROBE_S") or "").strip()
+    if not raw:
+        return 300.0
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        return 300.0
 
 
 def _devices_with_timeout():
-    global _probe_state
+    global _probe_state, _probe_failed_at
     wedged_timeout = None
     with _probe_lock:
+        if _probe_state is not None and _probe_state[0] == "err":
+            cooldown = reprobe_cooldown_from_env()
+            if (
+                cooldown > 0
+                and _probe_failed_at is not None
+                and time.monotonic() - _probe_failed_at >= cooldown
+            ):
+                # the failure verdict aged out: this caller re-probes
+                # (still bounded by DIGEST_INIT_TIMEOUT; everyone else
+                # keeps deduping on the lock as on the first probe)
+                _probe_state = None
+                _probe_failed_at = None
         if _probe_state is None:
             timeout = float(os.environ.get("DIGEST_INIT_TIMEOUT", "30"))
             result: list = []
@@ -74,6 +104,13 @@ def _devices_with_timeout():
 
             def probe() -> None:
                 try:
+                    from ..utils.failpoints import FAILPOINTS
+
+                    # the device-init wedge seam: `wedge` mode parks
+                    # this probe thread past DIGEST_INIT_TIMEOUT, which
+                    # is exactly how a dead TPU tunnel presents
+                    if FAILPOINTS.fire("device.init"):
+                        raise RuntimeError("failpoint: device.init")
                     import jax
 
                     result.append(jax.devices())
@@ -89,8 +126,10 @@ def _devices_with_timeout():
                 _probe_state = ("ok", result[0])
             elif error:
                 _probe_state = ("err", (type(error[0]), error[0].args))
+                _probe_failed_at = time.monotonic()
             else:
                 wedged_timeout = timeout
+                _probe_failed_at = time.monotonic()
                 _probe_state = (
                     "err",
                     (
@@ -157,9 +196,10 @@ def _capture_init_wedge(timeout: float) -> str | None:
 
 def _reset_device_probe() -> None:
     """Test isolation only."""
-    global _probe_state
+    global _probe_state, _probe_failed_at
     with _probe_lock:
         _probe_state = None
+        _probe_failed_at = None
 
 
 def _timed(fn) -> float:
@@ -208,6 +248,10 @@ class DigestEngine:
         self._jax_failed = False
         self._pallas_fn = None  # lazily built tiled digest fn
         self._pallas_failed = False
+        # when the device path last failed (monotonic); the cooldown
+        # re-probe (DIGEST_REPROBE_S) un-latches the failure flags so a
+        # recovered runtime is re-adopted without a process restart
+        self._failed_at: float | None = None
         # (hashlib_Bps, transfer_Bps, sync_s) measured once; None = not yet.
         # A dedicated lock held across the WHOLE measurement: N swarm
         # workers hitting first-flush concurrently must not each pay the
@@ -219,10 +263,32 @@ class DigestEngine:
 
     # -- backend plumbing ------------------------------------------------
 
+    def _maybe_unlatch(self) -> None:
+        """The cooldown half of ROADMAP 3a's supervised device runtime:
+        a failed device path stops being a life sentence. After
+        DIGEST_REPROBE_S the failure flags clear and the next digest
+        call re-probes (still bounded by DIGEST_INIT_TIMEOUT, still
+        deduped on the probe lock); 0 keeps the old latch-forever
+        behavior. A still-wedged runtime costs one probe per window —
+        never a job, which falls back to hashlib exactly as before."""
+        if self._failed_at is None:
+            return
+        cooldown = reprobe_cooldown_from_env()
+        if cooldown <= 0 or time.monotonic() - self._failed_at < cooldown:
+            return
+        with self._lock:
+            if self._failed_at is None:
+                return
+            self._jax_failed = False
+            self._pallas_failed = False
+            self._failed_at = None
+            self._tiled_possible = None
+
     def _jax(self):
         """Build (or recall) the device path; None if unavailable."""
         if self._backend == "hashlib":
             return None
+        self._maybe_unlatch()
         if self._jax_failed:
             if self._backend == "jax":
                 raise RuntimeError(
@@ -243,6 +309,7 @@ class DigestEngine:
             devices = self._devices or _devices_with_timeout()
         except Exception as exc:  # pragma: no cover - env-dependent
             self._jax_failed = True
+            self._failed_at = time.monotonic()
             if self._backend == "jax":
                 raise
             log.warning(f"jax digest path unavailable ({exc}); "
@@ -273,6 +340,7 @@ class DigestEngine:
                 return self._jax_state
             except Exception as exc:  # pragma: no cover - env-dependent
                 self._jax_failed = True
+                self._failed_at = time.monotonic()
                 if self._backend == "jax":
                     raise
                 log.warning(f"jax digest path unavailable ({exc}); "
@@ -283,6 +351,7 @@ class DigestEngine:
         """The tiled Pallas digest path (single TPU device), or None."""
         if self._backend == "hashlib":
             return None
+        self._maybe_unlatch()
         if self._pallas_failed:
             if self._backend == "pallas":
                 raise RuntimeError(
@@ -299,6 +368,7 @@ class DigestEngine:
             devices = self._devices or _devices_with_timeout()
         except Exception as exc:
             self._pallas_failed = True
+            self._failed_at = time.monotonic()
             if self._backend == "pallas":
                 raise
             log.debug(f"pallas digest path unavailable ({exc})")
@@ -339,6 +409,7 @@ class DigestEngine:
                 return fn
             except Exception as exc:
                 self._pallas_failed = True
+                self._failed_at = time.monotonic()
                 if self._backend == "pallas":
                     raise
                 log.debug(f"pallas digest path unavailable ({exc})")

@@ -39,6 +39,7 @@ from dataclasses import dataclass, field
 
 from ..utils import get_logger, metrics
 from ..utils import incident, profiling, tracing, watchdog
+from ..utils.failpoints import FAILPOINTS
 from ..utils.cancel import CancelToken
 from .broker import BrokerError, Channel, Connection, ConnectionFactory, Message
 from .delivery import Delivery
@@ -832,6 +833,8 @@ class QueueClient:
         else:
             routing_key = self._next_rk(pending.topic)
         try:
+            if FAILPOINTS.fire("queue.publish"):
+                raise BrokerError("failpoint: queue.publish dropped")
             if pending.topic:  # the default exchange ("") is not declarable
                 self._ensure_topology(my_channel, pending.topic)
             my_channel.publish(
@@ -862,6 +865,8 @@ class QueueClient:
         (False), same as the single path."""
         entries = []
         try:
+            if FAILPOINTS.fire("queue.publish"):
+                raise BrokerError("failpoint: queue.publish dropped")
             for pending in batch:
                 if pending.topic:
                     self._ensure_topology(my_channel, pending.topic)

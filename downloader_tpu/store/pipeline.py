@@ -439,9 +439,14 @@ class PipelineSession:
             return
         try:
             self._pipeline._prepare()
-            upload_id = self._client.initiate_multipart(
-                self._bucket, object_key(self._media_id, path)
-            )
+            key = object_key(self._media_id, path)
+            # crash janitor: a worker SIGKILLed mid-stream left nothing
+            # alive to abort its upload — the redelivered job owns the
+            # key now and reclaims the orphan before starting its own
+            # (zero dangling multiparts is a fleet invariant, not a
+            # process one)
+            self._client.abort_stale_multiparts(self._bucket, key)
+            upload_id = self._client.initiate_multipart(self._bucket, key)
         except (S3Error, OSError) as exc:
             log.with_fields(path=os.path.basename(path)).info(
                 f"streaming unavailable; store-and-forward ({exc})"

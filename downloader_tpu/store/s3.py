@@ -31,6 +31,7 @@ from typing import BinaryIO, Mapping
 
 from ..utils import get_logger, tracing, zero_copy_from_env
 from ..utils.cancel import CancelToken
+from ..utils.failpoints import FAILPOINTS
 from ..utils.netio import SocketWaiter
 from . import sigv4
 from .credentials import Credentials
@@ -46,6 +47,46 @@ _SENDFILE_WINDOW = 4 * 1024 * 1024
 MULTIPART_THRESHOLD = 64 * 1024 * 1024
 _MAX_PARTS = 10_000
 _UPLOAD_ID_RE = re.compile(rb"<UploadId>([^<]+)</UploadId>")
+_UPLOAD_ENTRY_RE = re.compile(
+    rb"<Upload>.*?<Key>([^<]*)</Key>.*?<UploadId>([^<]+)</UploadId>.*?"
+    rb"</Upload>",
+    re.S,
+)
+
+
+def multipart_threshold_from_env(environ=None) -> int:
+    """``S3_MULTIPART_THRESHOLD``: bytes above which objects take the
+    multipart API (and below which the streaming pipeline declines).
+    Operators with small median objects (or chaos suites that must
+    exercise multipart without 64 MiB transfers) lower it; the floor
+    of 5 MiB matches real S3's minimum part size."""
+    env = os.environ if environ is None else environ
+    raw = (env.get("S3_MULTIPART_THRESHOLD") or "").strip()
+    if not raw:
+        return MULTIPART_THRESHOLD
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        log.with_fields(value=raw).warning(
+            "ignoring invalid S3_MULTIPART_THRESHOLD (want bytes)"
+        )
+        return MULTIPART_THRESHOLD
+
+
+def part_size_from_env(environ=None) -> "int | None":
+    """``S3_PART_SIZE``: fixed multipart part size in bytes (empty =
+    derive per object, minio-go optimalPartInfo semantics)."""
+    env = os.environ if environ is None else environ
+    raw = (env.get("S3_PART_SIZE") or "").strip()
+    if not raw:
+        return None
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        log.with_fields(value=raw).warning(
+            "ignoring invalid S3_PART_SIZE (want bytes)"
+        )
+        return None
 
 
 def _fileno_of(body) -> int | None:
@@ -147,6 +188,8 @@ class S3Client:
             secure=parsed.scheme == "https",
             region=region,
             zero_copy=zero_copy,
+            multipart_threshold=multipart_threshold_from_env(),
+            part_size=part_size_from_env(),
         )
 
     # -- request plumbing ------------------------------------------------
@@ -490,6 +533,8 @@ class S3Client:
         then ship in ANY order (S3 parts are independent — the
         streaming pipeline exploits this for out-of-order piece spans);
         the caller owns completing or aborting the upload."""
+        if FAILPOINTS.fire("s3.initiate"):
+            raise S3Error(503, "failpoint: s3.initiate unavailable")
         status, body, _ = self._request(
             "POST",
             self._object_path(bucket, key),
@@ -534,6 +579,11 @@ class S3Client:
                 token.raise_if_cancelled()
             if attempt and start is not None:
                 stream.seek(start)
+            if FAILPOINTS.fire("s3.part_put"):
+                # an injected 5xx: the client's own one-retry-per-part
+                # policy engages exactly as for a real server error
+                last_error = S3Error(500, f"part {number}: failpoint 5xx")
+                continue
             try:
                 with tracing.span("s3-part", part=number, bytes=length):
                     status, body, headers = self._request(
@@ -600,6 +650,68 @@ class S3Client:
         if status != 200 or b"<Error>" in body:
             raise S3Error(status, body.decode(errors="replace")[:200])
 
+    def list_multipart_uploads(
+        self, bucket: str, prefix: str = ""
+    ) -> "list[tuple[str, str]]":
+        """In-progress multipart uploads as (key, upload_id) pairs —
+        S3 ListMultipartUploads, path-style. The crash-only janitor
+        reads this: a worker SIGKILLed mid-stream leaves its initiated
+        upload dangling (nothing in-process survives to abort it), and
+        the redelivered job is the first actor that knows the key is
+        its to reclaim."""
+        query: dict[str, str] = {"uploads": ""}
+        if prefix:
+            query["prefix"] = prefix
+        status, body, _ = self._request("GET", f"/{bucket}", query=query)
+        if status != 200:
+            raise S3Error(status, body.decode(errors="replace")[:200])
+        return [
+            (key.decode(errors="replace"), upload_id.decode())
+            for key, upload_id in _UPLOAD_ENTRY_RE.findall(body)
+        ]
+
+    def abort_stale_multiparts(self, bucket: str, key: str) -> int:
+        """Crash janitor: abort every in-progress multipart upload for
+        EXACTLY ``key`` and return how many were reclaimed. Called by
+        the streaming pipeline before it initiates its own upload for a
+        key — at-least-once redelivery makes the re-running job the
+        key's sole owner, so anything already in flight is a dead
+        worker's orphan (a concurrent duplicate delivery losing its
+        upload here just retries, which at-least-once already absorbs).
+        A store that cannot list (ancient stub, denied permission)
+        costs nothing: the caller proceeds and real S3's lifecycle
+        rules remain the backstop."""
+        try:
+            stale = [
+                upload_id
+                for got_key, upload_id in self.list_multipart_uploads(
+                    bucket, prefix=key
+                )
+                if got_key == key
+            ]
+        except (S3Error, OSError, http.client.HTTPException) as exc:
+            log.with_fields(key=key).debug(
+                f"stale-multipart listing unavailable ({exc})"
+            )
+            return 0
+        reclaimed = 0
+        for upload_id in stale:
+            try:
+                self.abort_multipart(bucket, key, upload_id)
+                reclaimed += 1
+            except (S3Error, OSError, http.client.HTTPException) as exc:
+                log.with_fields(key=key).warning(
+                    f"failed to abort stale multipart {upload_id}: {exc}"
+                )
+        if reclaimed:
+            from ..utils import metrics
+
+            metrics.GLOBAL.add("multipart_stale_aborts", reclaimed)
+            log.with_fields(key=key, count=reclaimed).warning(
+                "aborted stale multipart uploads left by a dead worker"
+            )
+        return reclaimed
+
     def abort_multipart(self, bucket: str, key: str, upload_id: str) -> None:  # protocol: multipart-upload release bind=upload_id
         """Abort an in-progress multipart upload so the store doesn't
         accrue orphaned part storage. Deliberately token-free — aborts
@@ -629,6 +741,11 @@ class S3Client:
         already on disk (or spooled), so parts ship in order off one
         stream. The streaming pipeline drives the same initiate/part/
         complete/abort API out of order instead."""
+        # crash janitor, same as the streaming lane: a worker SIGKILLed
+        # mid-multipart left nothing alive to abort, and the
+        # redelivered job re-uploading this key is its new sole owner —
+        # zero dangling multiparts must hold on BOTH upload lanes
+        self.abort_stale_multiparts(bucket, key)
         upload_id = self.initiate_multipart(
             bucket, key, content_type=content_type, token=token
         )

@@ -353,6 +353,34 @@ class S3Stub:
 
             def do_GET(self):
                 bucket, key = self._route()
+                query = self._query()
+                if "uploads" in query and not key:
+                    # ListMultipartUploads: the crash janitor's read —
+                    # pending uploads for the bucket (optionally
+                    # prefix-filtered), S3 XML shape
+                    prefix = query.get("prefix", "")
+                    with stub.lock:
+                        pending = [
+                            (up_key, up_id)
+                            for up_bucket, up_key, up_id in stub.uploads
+                            if up_bucket == bucket
+                            and up_key.startswith(prefix)
+                        ]
+                    entries = "".join(
+                        f"<Upload><Key>{up_key}</Key>"
+                        f"<UploadId>{up_id}</UploadId></Upload>"
+                        for up_key, up_id in pending
+                    )
+                    payload = (
+                        "<ListMultipartUploadsResult>"
+                        f"<Bucket>{bucket}</Bucket>{entries}"
+                        "</ListMultipartUploadsResult>"
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 with stub.lock:
                     data = stub.buckets.get(bucket, {}).get(key)
                 if data is None:

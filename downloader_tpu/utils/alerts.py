@@ -498,6 +498,47 @@ def default_rules(
     ]
 
 
+# a worker restarting occasionally is the crash-only design WORKING;
+# this many restarts across the fleet inside the fast window is a
+# crash loop an operator must see (bad deploy, poisoned job class,
+# dying host)
+WORKER_FLAP_RESTARTS = 3.0
+
+
+def fleet_rules(
+    fast_window_s: float = DEFAULT_FAST_WINDOW_S,
+) -> "list[AlertRule]":
+    """The fleet supervisor's rule set (daemon/fleet.py installs it):
+    restart churn and fatal start-failure slots, evaluated over the
+    supervisor's own registry — the crash-only escalation path from
+    "the supervisor handled it" to "a human must look"."""
+    return [
+        ThresholdRule(
+            "worker-flapping",
+            "fleet_worker_restarts",
+            threshold=WORKER_FLAP_RESTARTS / fast_window_s,
+            source="counter_rate",
+            window_s=fast_window_s,
+            description=(
+                "fleet workers are restart-looping faster than the "
+                "crash-only design can absorb (bad deploy or dying host)"
+            ),
+        ),
+        ThresholdRule(
+            "worker-start-failures",
+            "fleet_worker_start_failures",
+            threshold=1.0,
+            source="counter_rate",
+            window_s=fast_window_s,
+            severity="ticket",
+            description=(
+                "workers are exiting during startup (bad config, port "
+                "in use); slots go FATAL after the configured attempts"
+            ),
+        ),
+    ]
+
+
 # -- the engine ---------------------------------------------------------------
 
 

@@ -199,6 +199,21 @@ HELP = {
     "lock_wait_seconds_source_board": (
         "acquire wait on a job's multi-source scheduling board lock"
     ),
+    # crash-only worker fleet (daemon/fleet.py)
+    "fleet_workers_target": "worker processes the supervisor is configured for",
+    "fleet_workers_alive": "worker processes currently running",
+    "fleet_worker_restarts": (
+        "workers restarted after dying or wedging (the worker-flapping "
+        "alert rule's series)"
+    ),
+    "fleet_worker_start_failures": (
+        "workers that exited during startup without ever heartbeating "
+        "(fatal-after-M slots escalate instead of restart-looping)"
+    ),
+    "multipart_stale_aborts": (
+        "stale multipart uploads aborted by the crash janitor (orphans "
+        "of workers that died mid-stream)"
+    ),
 }
 
 

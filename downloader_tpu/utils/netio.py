@@ -128,6 +128,12 @@ def create_connection(
     ``_create_connection`` hook. Tries each cached address in resolver
     order, raising the last error when none connects."""
     host, port = address
+    from .failpoints import FAILPOINTS
+
+    if FAILPOINTS.fire("net.connect"):
+        raise ConnectionRefusedError(
+            f"failpoint: net.connect refused for {host!r}"
+        )
     infos = (resolver or RESOLVER).resolve(host, port)
     if not infos:
         raise OSError(f"getaddrinfo returned nothing for {host!r}")

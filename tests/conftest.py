@@ -149,6 +149,9 @@ _PROTOCOL_MODULES = {
     # the trace/watch/delivery protocols the e2e walks exercise
     "test_alerts",
     "test_telemetry",
+    # the fleet's worker-lifecycle (spawn -> ready -> draining ->
+    # reaped): every worker process a test spawns must be reaped
+    "test_fleet",
 }
 
 

@@ -1,0 +1,256 @@
+"""The failpoint layer (utils/failpoints.py): spec parsing, the
+pure-in-seed determinism contract, the disarmed fast path, the seams'
+natural-failure routing, and the supervised device runtime (wedge →
+one probe lost, zero jobs lost → cooldown re-probe re-adopts)."""
+
+import hashlib
+import io
+import time
+
+import pytest
+
+from downloader_tpu.store import stub as store_stub
+from downloader_tpu.store.credentials import Credentials
+from downloader_tpu.store.s3 import S3Client, S3Error
+from downloader_tpu.utils import failpoints
+from downloader_tpu.utils.failpoints import FailpointRegistry
+
+CREDS = Credentials(access_key="ak", secret_key="sk")
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    failpoints.FAILPOINTS.reset()
+
+
+# -- spec parsing -------------------------------------------------------------
+
+
+def test_spec_grammar_modes_and_fields():
+    sites = failpoints.parse_spec(
+        "s3.part_put=fail:0.25, device.init=wedge:1:0:2.5;"
+        "daemon.pre_ack=kill segments.pwrite=0.05,net.connect=fail:1:3"
+    )
+    assert sites["s3.part_put"].mode == "fail"
+    assert sites["s3.part_put"].prob == 0.25
+    assert sites["device.init"].mode == "wedge"
+    assert sites["device.init"].param == 2.5
+    assert sites["daemon.pre_ack"].mode == "kill"
+    # bare-float shorthand means fail at that probability
+    assert sites["segments.pwrite"].mode == "fail"
+    assert sites["segments.pwrite"].prob == 0.05
+    assert sites["net.connect"].skip == 3
+
+
+def test_spec_malformed_entries_dropped_not_fatal():
+    sites = failpoints.parse_spec(
+        "good.site=fail, =fail, nonsense, bad.mode=explode, "
+        "bad.prob=fail:lots"
+    )
+    assert set(sites) == {"good.site"}
+
+
+def test_seed_env_parsing():
+    assert failpoints.seed_from_env({}) == failpoints.DEFAULT_SEED
+    assert failpoints.seed_from_env({"FAILPOINT_SEED": "0x2a"}) == 42
+    assert (
+        failpoints.seed_from_env({"FAILPOINT_SEED": "zzz"})
+        == failpoints.DEFAULT_SEED
+    )
+
+
+# -- determinism: same seed + spec => identical injection schedule ------------
+
+
+def test_schedule_is_pure_in_seed():
+    spec = "chaos.site=fail:0.3"
+    a = FailpointRegistry()
+    a.configure(spec, seed=1234)
+    b = FailpointRegistry()
+    b.configure(spec, seed=1234)
+    schedule_a = a.schedule("chaos.site", 200)
+    assert schedule_a == b.schedule("chaos.site", 200)
+    # the live fire() path makes the same decisions as schedule()
+    fired = [a.fire("chaos.site") for _ in range(200)]
+    assert fired == schedule_a
+    # and the hit rate tracks the configured probability
+    assert 30 <= sum(schedule_a) <= 90
+    # a different seed selects a different schedule
+    c = FailpointRegistry()
+    c.configure(spec, seed=4321)
+    assert c.schedule("chaos.site", 200) != schedule_a
+
+
+def test_skip_arms_after_n_calls():
+    registry = FailpointRegistry()
+    registry.configure("late.site=fail:1:2")
+    assert [registry.fire("late.site") for _ in range(4)] == [
+        False, False, True, True,
+    ]
+
+
+def test_sleep_mode_delays_without_injecting():
+    registry = FailpointRegistry()
+    registry.configure("slow.site=sleep:1:0:0.05")
+    start = time.monotonic()
+    assert registry.fire("slow.site") is False
+    assert time.monotonic() - start >= 0.04
+    assert registry.snapshot()["sites"]["slow.site"]["injected"] == 1
+
+
+def test_disarmed_fast_path_costs_one_dict_check():
+    registry = FailpointRegistry()
+    start = time.monotonic()
+    for _ in range(200_000):
+        registry.fire("hot.site")
+    elapsed = time.monotonic() - start
+    # the production state: ~tens of ns per call; 0.5 s for 200k calls
+    # is two orders of magnitude of headroom on a loaded CI host
+    assert elapsed < 0.5, f"disarmed fire() cost {elapsed:.3f}s for 200k calls"
+
+
+# -- seams route through their natural failure paths --------------------------
+
+
+def test_s3_part_put_5xx_fails_multipart_and_aborts():
+    with store_stub.S3Stub(CREDS) as stub:
+        client = S3Client(
+            stub.endpoint, CREDS,
+            multipart_threshold=64 * 1024, part_size=64 * 1024,
+        )
+        client.make_bucket("fp")
+        failpoints.FAILPOINTS.configure("s3.part_put=fail:1")
+        body = b"x" * (192 * 1024)
+        with pytest.raises(S3Error):
+            client.put_object(
+                "fp", "obj", io.BytesIO(body), len(body)
+            )
+        # the store-and-forward multipart path aborted its own upload
+        assert stub.list_multipart_uploads() == []
+        failpoints.FAILPOINTS.reset()
+        client.put_object("fp", "obj", io.BytesIO(body), len(body))
+        assert stub.buckets["fp"]["obj"] == body
+
+
+def test_stale_multipart_janitor_reclaims_dead_workers_orphan():
+    with store_stub.S3Stub(CREDS) as stub:
+        client = S3Client(
+            stub.endpoint, CREDS,
+            multipart_threshold=64 * 1024, part_size=64 * 1024,
+        )
+        client.make_bucket("fp")
+        # a dead worker's orphan: initiated, one part shipped, nobody
+        # left alive to abort or complete it
+        orphan = client.initiate_multipart("fp", "media/1/file")
+        client.upload_part(
+            "fp", "media/1/file", orphan, 1, io.BytesIO(b"y" * 1024), 1024
+        )
+        other = client.initiate_multipart("fp", "media/2/other")
+        assert len(stub.list_multipart_uploads()) == 2
+        # the redelivered job owns the key now: janitor reclaims ONLY
+        # its own key's orphans
+        assert client.abort_stale_multiparts("fp", "media/1/file") == 1
+        assert stub.list_multipart_uploads() == [("fp", "media/2/other", other)]
+        client.abort_multipart("fp", "media/2/other", other)
+
+
+def test_net_connect_seam_refuses():
+    from downloader_tpu.utils import netio
+
+    failpoints.FAILPOINTS.configure("net.connect=fail:1")
+    with pytest.raises(ConnectionRefusedError):
+        netio.create_connection(("127.0.0.1", 9))
+
+
+# -- the supervised device runtime -------------------------------------------
+
+
+@pytest.fixture
+def _fresh_probe():
+    from downloader_tpu.parallel import engine
+
+    engine._reset_device_probe()
+    yield engine
+    engine._reset_device_probe()
+
+
+def test_device_init_wedge_costs_one_probe_never_a_job(
+    _fresh_probe, monkeypatch
+):
+    engine = _fresh_probe
+    monkeypatch.setenv("DIGEST_INIT_TIMEOUT", "0.2")
+    monkeypatch.setenv("DIGEST_REPROBE_S", "0")  # latch: no re-probe here
+    failpoints.FAILPOINTS.configure("device.init=wedge:1:0:5")
+    digest_engine = engine.DigestEngine(backend="auto", min_batch=1)
+    pieces = [b"piece-%d" % i for i in range(16)]
+    start = time.monotonic()
+    digests = digest_engine.sha1_many(pieces)
+    first_cost = time.monotonic() - start
+    # the job COMPLETED, on hashlib, and paid roughly one probe timeout
+    assert digests == [hashlib.sha1(p).digest() for p in pieces]
+    assert first_cost < 3.0
+    with pytest.raises(TimeoutError, match="wedged device runtime"):
+        engine._devices_with_timeout()
+    # later jobs pay nothing: the verdict is latched
+    start = time.monotonic()
+    assert digest_engine.sha1_many(pieces[:4]) == digests[:4]
+    assert time.monotonic() - start < 0.2
+
+
+def test_cooldown_reprobe_readopts_recovered_runtime(
+    _fresh_probe, monkeypatch
+):
+    engine = _fresh_probe
+    monkeypatch.setenv("DIGEST_INIT_TIMEOUT", "0.2")
+    monkeypatch.setenv("DIGEST_REPROBE_S", "0.1")
+    failpoints.FAILPOINTS.configure("device.init=wedge:1:0:5")
+    with pytest.raises(TimeoutError):
+        engine._devices_with_timeout()
+    # still inside the cooldown window: the verdict holds, no new probe
+    with pytest.raises(TimeoutError):
+        engine._devices_with_timeout()
+    # the runtime "recovers" (failpoint disarmed); after the cooldown
+    # the next caller re-probes and the device comes back
+    failpoints.FAILPOINTS.reset()
+    monkeypatch.setenv("DIGEST_INIT_TIMEOUT", "60")
+    time.sleep(0.15)
+    devices = engine._devices_with_timeout()
+    assert devices, "recovered runtime was not re-adopted"
+
+
+def test_bench_digest_keeps_its_arm_through_a_wedge(
+    _fresh_probe, monkeypatch
+):
+    """The ISSUE 14 acceptance: a failpoint-injected device-init wedge
+    costs the bench one bounded probe — the digest arm still reports
+    its hashlib numbers, with a structured ``device_reason`` naming the
+    timeout instead of a lost arm (BENCH_r05's failure mode)."""
+    monkeypatch.setenv("DIGEST_INIT_TIMEOUT", "0.2")
+    monkeypatch.setenv("DIGEST_REPROBE_S", "0")
+    failpoints.FAILPOINTS.configure("device.init=wedge:1:0:5")
+    import bench_digest
+
+    out = bench_digest.measure(piece_kb=4, batch=4, reps=1)
+    assert out is not None
+    assert out["hashlib_GBps"] > 0  # the arm survived
+    assert out["device"] == "unavailable"
+    assert "TimeoutError" in out["device_reason"]
+
+
+def test_engine_unlatches_failure_flags_after_cooldown(
+    _fresh_probe, monkeypatch
+):
+    engine = _fresh_probe
+    digest_engine = engine.DigestEngine(backend="auto", min_batch=1)
+    digest_engine._jax_failed = True
+    digest_engine._pallas_failed = True
+    digest_engine._failed_at = time.monotonic() - 10.0
+    monkeypatch.setenv("DIGEST_REPROBE_S", "0")  # latch-forever keeps flags
+    digest_engine._maybe_unlatch()
+    assert digest_engine._jax_failed
+    monkeypatch.setenv("DIGEST_REPROBE_S", "5")  # 10s old > 5s cooldown
+    digest_engine._maybe_unlatch()
+    assert not digest_engine._jax_failed
+    assert not digest_engine._pallas_failed
+    assert digest_engine._failed_at is None

@@ -1,0 +1,763 @@
+"""Crash-only fleet proofs (daemon/fleet.py + utils/failpoints.py).
+
+Three layers:
+
+- supervisor unit tests against SCRIPTED worker processes (start
+  failures go fatal-after-M with the exit code named, crashed workers
+  restart with backoff, wedged workers are killed and restarted,
+  drain reaps everything);
+- the fleet chaos e2e (tier-1 acceptance): two REAL ``serve()`` worker
+  processes against a real-TCP AMQP broker stub and S3 stub, one
+  SIGKILLed mid-stream — its job redelivers to the survivor under the
+  ORIGINAL trace id, the dead worker's multipart orphan is reclaimed
+  (zero dangling uploads), the supervisor restarts the worker inside
+  its deadline, and ``/metrics/federate`` shows both instances again;
+- the crash-during-multipart matrix: SIGKILL (via seeded failpoint
+  ``kill`` sites) at {before first part, mid-part, pre-publish,
+  pre-ack} × {streamed, batched fast-lane}, each cell asserting
+  redelivery outcome, trace-id continuity, ``list_multipart_uploads()
+  == []``, and a zero ledger on the survivor.
+"""
+
+import http.client
+import http.server
+import json
+import os
+import signal
+import socketserver
+import sys
+import threading
+import time
+
+import pytest
+
+from downloader_tpu.daemon.fleet import (
+    FleetConfig,
+    FleetHealthServer,
+    FleetSupervisor,
+    HeartbeatWriter,
+    WorkerHandle,
+)
+from downloader_tpu.queue.amqp_server import AmqpServerStub
+from downloader_tpu.store.credentials import Credentials
+from downloader_tpu.store.stub import S3Stub
+from downloader_tpu.utils import metrics, tracing
+
+CREDS = Credentials(access_key="ak", secret_key="sk")
+BUCKET = "fleet-bkt"
+PKG_ROOT = os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))
+)
+
+
+def _wait(predicate, timeout: float, what: str):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(0.05)
+    raise AssertionError(f"timed out after {timeout}s waiting for {what}")
+
+
+@pytest.fixture(autouse=True)
+def _fleet_isolation():
+    yield
+    metrics.FEDERATION.reset()
+
+
+# -- fast supervisor configs --------------------------------------------------
+
+
+def _fast_config(workers: int = 1, **overrides) -> FleetConfig:
+    base = dict(
+        workers=workers,
+        heartbeat_s=0.1,
+        stall_s=1.0,
+        publisher_down_s=30.0,
+        restart_backoff_s=0.05,
+        restart_backoff_cap_s=0.4,
+        start_grace_s=10.0,
+        start_failures_max=2,
+        drain_s=5.0,
+    )
+    base.update(overrides)
+    return FleetConfig(**base)
+
+
+def _script_argv(script: str):
+    def argv(slot):
+        return [sys.executable, "-c", script]
+
+    return argv
+
+
+_BEAT_PREAMBLE = """
+import json, os, signal, sys, time
+
+def beat():
+    path = os.environ["FLEET_HEARTBEAT_FILE"]
+    with open(path + ".tmp", "w") as sink:
+        json.dump({"pid": os.getpid(), "ts": time.time(),
+                   "publisher_alive": 1, "stalled": 0,
+                   "health_port": 0}, sink)
+    os.replace(path + ".tmp", path)
+"""
+
+
+# -- supervisor unit tests (scripted workers) ---------------------------------
+
+
+def test_start_failure_goes_fatal_after_max_attempts():
+    before = metrics.GLOBAL.snapshot().get("fleet_worker_start_failures", 0)
+    supervisor = FleetSupervisor(
+        _fast_config(start_failures_max=2),
+        worker_argv=_script_argv("import sys; sys.exit(3)"),
+    )
+    try:
+        supervisor.start()
+        _wait(
+            lambda: supervisor.snapshot()["slots"][0]["fatal"],
+            15.0,
+            "slot to go fatal",
+        )
+        slot = supervisor.snapshot()["slots"][0]
+        assert slot["start_failures"] >= 2
+        assert slot["restarts"] == 0  # startup deaths are NOT restarts
+        after = metrics.GLOBAL.snapshot().get(
+            "fleet_worker_start_failures", 0
+        )
+        assert after - before >= 2
+        # fatal means parked: no further spawns happen
+        time.sleep(0.5)
+        assert supervisor.snapshot()["slots"][0]["state"] == "down"
+    finally:
+        supervisor.drain()
+
+
+def test_crashed_worker_restarts_with_backoff():
+    script = _BEAT_PREAMBLE + "beat()\ntime.sleep(0.25)\nsys.exit(1)\n"
+    before = metrics.GLOBAL.snapshot().get("fleet_worker_restarts", 0)
+    supervisor = FleetSupervisor(
+        _fast_config(), worker_argv=_script_argv(script)
+    )
+    try:
+        supervisor.start()
+        _wait(
+            lambda: supervisor.snapshot()["slots"][0]["restarts"] >= 2,
+            20.0,
+            "two restarts of a crashing worker",
+        )
+        after = metrics.GLOBAL.snapshot().get("fleet_worker_restarts", 0)
+        assert after - before >= 2
+        # it heartbeated before dying, so these were crashes, never
+        # start failures — the slot must not be anywhere near fatal
+        assert not supervisor.snapshot()["slots"][0]["fatal"]
+    finally:
+        supervisor.drain()
+
+
+def test_wedged_worker_is_killed_and_restarted():
+    # beats once, then stops beating forever while staying alive: the
+    # supervisor must read staleness as wedged and SIGKILL it
+    script = _BEAT_PREAMBLE + "beat()\ntime.sleep(600)\n"
+    supervisor = FleetSupervisor(
+        _fast_config(stall_s=0.6), worker_argv=_script_argv(script)
+    )
+    try:
+        supervisor.start()
+        _wait(
+            lambda: supervisor.snapshot()["slots"][0]["restarts"] >= 1,
+            20.0,
+            "wedged worker to be killed and counted as a restart",
+        )
+    finally:
+        supervisor.drain()
+
+
+def test_drain_reaps_everything():
+    script = _BEAT_PREAMBLE + (
+        "signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))\n"
+        "while True:\n    beat()\n    time.sleep(0.05)\n"
+    )
+    supervisor = FleetSupervisor(
+        _fast_config(workers=2), worker_argv=_script_argv(script)
+    )
+    supervisor.start()
+    _wait(
+        lambda: all(
+            s["ready"] for s in supervisor.snapshot()["slots"]
+        ),
+        15.0,
+        "both scripted workers ready",
+    )
+    supervisor.drain()
+    snap = supervisor.snapshot()
+    assert snap["workers_alive"] == 0
+    assert metrics.GLOBAL.gauges().get("fleet_workers_alive") == 0
+
+
+def test_heartbeat_writer_writes_atomically(tmp_path):
+    path = str(tmp_path / "hb.json")
+    writer = HeartbeatWriter(path, 0.05, health_port=1234).start()
+    try:
+        _wait(lambda: os.path.exists(path), 5.0, "heartbeat file")
+        payload = json.loads(open(path).read())
+        assert payload["pid"] == os.getpid()
+        assert payload["health_port"] == 1234
+        first_ts = payload["ts"]
+        _wait(
+            lambda: json.loads(open(path).read())["ts"] > first_ts,
+            5.0,
+            "a second beat",
+        )
+    finally:
+        writer.stop()
+
+
+# -- real-worker plumbing -----------------------------------------------------
+
+
+class _Origin:
+    """Threaded HTTP origin serving a dict of path -> payload, with
+    HEAD + (optionally throttled) GET incl. Range support."""
+
+    def __init__(self, objects: "dict[str, bytes]", rate_bps: float = 0.0):
+        origin = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def do_HEAD(self):
+                payload = origin.objects.get(self.path)
+                if payload is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.send_header("Accept-Ranges", "bytes")
+                self.end_headers()
+
+            def do_GET(self):
+                payload = origin.objects.get(self.path)
+                if payload is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                start, end = 0, len(payload)
+                header = self.headers.get("Range")
+                if header and header.startswith("bytes="):
+                    lo, _, hi = header[len("bytes="):].partition("-")
+                    start = int(lo) if lo else 0
+                    end = int(hi) + 1 if hi else len(payload)
+                    self.send_response(206)
+                    self.send_header(
+                        "Content-Range",
+                        f"bytes {start}-{end - 1}/{len(payload)}",
+                    )
+                else:
+                    self.send_response(200)
+                self.send_header("Content-Length", str(end - start))
+                self.send_header("Accept-Ranges", "bytes")
+                self.end_headers()
+                window = payload[start:end]
+                chunk = 64 * 1024
+                for offset in range(0, len(window), chunk):
+                    piece = window[offset:offset + chunk]
+                    try:
+                        self.wfile.write(piece)
+                        self.wfile.flush()
+                    except OSError:
+                        return
+                    if origin.rate_bps > 0:
+                        time.sleep(len(piece) / origin.rate_bps)
+
+        self.objects = dict(objects)
+        self.rate_bps = rate_bps
+        self._server = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", 0), Handler
+        )
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def _worker_env(broker: AmqpServerStub, s3: S3Stub, base_dir: str, **extra):
+    env = {
+        "BROKER": "amqp",
+        "RABBITMQ_ENDPOINT": broker.endpoint,
+        "RABBITMQ_USERNAME": "",
+        "RABBITMQ_PASSWORD": "",
+        "S3_ENDPOINT": f"http://{s3.endpoint}",
+        "S3_ACCESS_KEY": CREDS.access_key,
+        "S3_SECRET_KEY": CREDS.secret_key,
+        "BUCKET": BUCKET,
+        "DOWNLOAD_DIR": base_dir,
+        "JOB_CONCURRENCY": "1",
+        "PREFETCH": "4",
+        "BATCH_JOBS": "1",
+        "HTTP_SEGMENTS": "1",
+        "S3_MULTIPART_THRESHOLD": str(128 * 1024),
+        "S3_PART_SIZE": str(128 * 1024),
+        "PROFILE": "0",
+        "TSDB_INTERVAL": "off",
+        "ALERT_INTERVAL": "off",
+        "LSD": "off",
+        "DHT_BOOTSTRAP": "off",
+        "WATCHDOG_STALL_S": "60",
+        "MAX_JOB_RETRIES": "6",
+        "RETRY_DELAY": "0.1",
+        "RETRY_DELAY_CAP": "0.5",
+        "PUBLISH_CONFIRM_TIMEOUT": "10",
+        "FAILPOINT_SPEC": "",
+        "LOG_LEVEL": "info",
+    }
+    env.update(extra)
+    return env
+
+
+def _declare_topology(channel, topic: str) -> None:
+    channel.declare_exchange(topic)
+    for index in range(2):
+        name = f"{topic}-{index}"
+        channel.declare_queue(name)
+        channel.bind_queue(name, topic, name)
+
+
+def _publish_job(
+    broker: AmqpServerStub, media_id: str, url: str
+) -> "tracing.TraceContext":
+    """Publish one Download with a producer-minted trace context (the
+    continuity anchor every redelivery must preserve); topology is
+    declared first so a not-yet-started worker can't lose it."""
+    from downloader_tpu.wire import Download, Media
+
+    context = tracing.TraceContext.mint()
+    connection = broker.broker.connect()
+    try:
+        channel = connection.channel()
+        _declare_topology(channel, "v1.download")
+        channel.publish(
+            "v1.download",
+            "v1.download-0",
+            Download(media=Media(id=media_id, source_uri=url)).marshal(),
+            headers={
+                tracing.TRACE_CONTEXT_HEADER: context.header_value(),
+                "X-Job-Class": "interactive",
+            },
+            persistent=True,
+        )
+        channel.close()
+    finally:
+        connection.close()
+    return context
+
+
+class _ConvertSink:
+    """Consumes both v1.convert shards and collects (media_id,
+    trace_id) pairs as workers publish them."""
+
+    def __init__(self, broker: AmqpServerStub):
+        from downloader_tpu.wire import Convert
+
+        self.received: "list[tuple[str, str]]" = []
+        self._lock = threading.Lock()
+        self._connection = broker.broker.connect()
+        channel = self._connection.channel()
+        channel.set_prefetch(100)
+        _declare_topology(channel, "v1.convert")
+
+        def on_message(message, ch=channel):
+            convert = Convert.unmarshal(message.body)
+            context = tracing.TraceContext.parse(
+                message.headers.get(tracing.TRACE_CONTEXT_HEADER)
+            )
+            with self._lock:
+                self.received.append(
+                    (
+                        convert.media.id if convert.media else "",
+                        context.trace_id if context else "",
+                    )
+                )
+            ch.ack(message.delivery_tag)
+
+        for index in range(2):
+            channel.consume(f"v1.convert-{index}", on_message)
+
+    def snapshot(self) -> "list[tuple[str, str]]":
+        with self._lock:
+            return list(self.received)
+
+    def close(self) -> None:
+        self._connection.close()
+
+
+def _scrape_worker(port: int, path: str = "/metrics") -> str:
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2.0)
+    try:
+        conn.request("GET", path)
+        response = conn.getresponse()
+        return response.read().decode()
+    finally:
+        conn.close()
+
+
+def _counter_from(exposition: str, family: str) -> float:
+    for line in exposition.splitlines():
+        if line.startswith(f"downloader_{family} "):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+def _assert_worker_ledger_zero(port: int) -> None:
+    payload = json.loads(_scrape_worker(port, "/debug/admission"))
+    budgets = payload.get("ledger", {}).get("budgets", {})
+    used = {
+        name: entry.get("used", 0)
+        for name, entry in budgets.items()
+        if entry.get("used", 0)
+    }
+    assert not used, f"worker ledger not balanced to zero: {used}"
+
+
+def _spawn_worker(instance: str, env_overrides: "dict[str, str]"):
+    env = dict(os.environ)
+    env.update(env_overrides)
+    existing = env.get("PYTHONPATH", "")
+    if PKG_ROOT not in existing.split(os.pathsep):
+        env["PYTHONPATH"] = (
+            f"{PKG_ROOT}{os.pathsep}{existing}" if existing else PKG_ROOT
+        )
+    handle = WorkerHandle(
+        instance, [sys.executable, "-m", "downloader_tpu", "serve"], env
+    )
+    return handle.spawn()
+
+
+# -- the fleet chaos e2e (tier-1 acceptance) ----------------------------------
+
+
+def test_fleet_chaos_sigkill_midstream_redelivers_to_survivor(tmp_path):
+    payload = os.urandom(3 * 1024 * 1024)
+    with S3Stub(CREDS) as s3, AmqpServerStub() as broker, _Origin(
+        {"/video.mp4": payload}, rate_bps=768 * 1024
+    ) as origin:
+        supervisor = FleetSupervisor(
+            _fast_config(
+                workers=2,
+                heartbeat_s=0.2,
+                stall_s=2.0,
+                start_grace_s=30.0,
+                restart_backoff_s=0.1,
+                restart_backoff_cap_s=0.5,
+                drain_s=10.0,
+            ),
+            worker_env=_worker_env(broker, s3, str(tmp_path)),
+        )
+        sink = None
+        try:
+            supervisor.start()
+            _wait(
+                lambda: all(
+                    s["ready"] for s in supervisor.snapshot()["slots"]
+                ),
+                40.0,
+                "both real workers ready",
+            )
+            sink = _ConvertSink(broker)
+            context = _publish_job(
+                broker, "chaos-1", f"{origin.url}/video.mp4"
+            )
+            # mid-stream = the job's multipart upload is initiated and
+            # the fetch (throttled to ~0.75 MB/s over 3 MB) still runs
+            _wait(
+                lambda: s3.list_multipart_uploads(),
+                20.0,
+                "the streaming upload to initiate",
+            )
+            # find which worker took the job and SIGKILL it, externally
+            snap = supervisor.snapshot()
+            busy = _wait(
+                lambda: [
+                    s
+                    for s in supervisor.snapshot()["slots"]
+                    if s["health_port"]
+                    and _counter_from(
+                        _scrape_worker(s["health_port"]),
+                        "queue_delivered",
+                    )
+                    > 0
+                ],
+                10.0,
+                "the busy worker to be identifiable",
+            )[0]
+            victim_pid = busy["pid"]
+            killed_at = time.monotonic()
+            os.kill(victim_pid, signal.SIGKILL)
+
+            # the job redelivers to the SURVIVOR and completes under
+            # the ORIGINAL trace id
+            _wait(
+                lambda: ("chaos-1", context.trace_id) in sink.snapshot(),
+                60.0,
+                "the redelivered job to complete under the original "
+                "trace id",
+            )
+            foreign = [
+                entry
+                for entry in sink.snapshot()
+                if entry[1] != context.trace_id
+            ]
+            assert not foreign, (
+                f"completions under a different trace id: {foreign}"
+            )
+            # the object landed intact despite the mid-stream death
+            assert payload in s3.buckets.get(BUCKET, {}).values()
+            # zero dangling multiparts: the dead worker's orphan was
+            # reclaimed by the survivor's janitor
+            _wait(
+                lambda: not s3.list_multipart_uploads(),
+                20.0,
+                "dangling multipart uploads to be reclaimed",
+            )
+            # the supervisor restarted the dead worker inside its
+            # deadline (stall scan + backoff + spawn, all configured)
+            _wait(
+                lambda: supervisor.snapshot()["workers_alive"] == 2,
+                20.0,
+                "the killed worker to be restarted",
+            )
+            restart_latency = time.monotonic() - killed_at
+            restart_deadline = (
+                supervisor._config.stall_s
+                + supervisor._config.restart_backoff_cap_s
+                + 20.0  # interpreter + daemon startup on a loaded host
+            )
+            assert restart_latency <= restart_deadline, (
+                f"restart took {restart_latency:.1f}s "
+                f"(deadline {restart_deadline:.1f}s)"
+            )
+            assert (
+                metrics.GLOBAL.snapshot().get("fleet_worker_restarts", 0)
+                >= 1
+            )
+            # /metrics/federate shows BOTH instances again
+            _wait(
+                lambda: all(
+                    s["ready"] for s in supervisor.snapshot()["slots"]
+                ),
+                40.0,
+                "the restarted worker to heartbeat",
+            )
+            health = FleetHealthServer(supervisor, 0, "127.0.0.1").start()
+            try:
+                federated = _scrape_worker(health.port, "/metrics/federate")
+            finally:
+                health.stop()
+            assert 'instance="worker-0"' in federated
+            assert 'instance="worker-1"' in federated
+            # the survivor's ledger balanced back to zero
+            survivor = next(
+                s
+                for s in snap["slots"]
+                if s["pid"] != victim_pid and s["health_port"]
+            )
+            _assert_worker_ledger_zero(survivor["health_port"])
+        finally:
+            if sink is not None:
+                sink.close()
+            supervisor.drain()
+
+
+# -- crash-during-multipart matrix -------------------------------------------
+
+# each cell: (lane, failpoint spec for the armed worker, object size)
+_MATRIX = [
+    ("streamed", "s3.part_put=kill:1:0", "before-first-part"),
+    ("streamed", "s3.part_put=kill:1:2", "mid-part"),
+    ("streamed", "daemon.pre_publish=kill", "pre-publish"),
+    ("streamed", "daemon.pre_ack=kill", "post-publish-pre-ack"),
+    ("batched", "net.connect=kill", "before-fetch"),
+    ("batched", "http.read=kill", "mid-fetch"),
+    ("batched", "daemon.pre_publish=kill", "pre-publish"),
+    ("batched", "daemon.pre_ack=kill", "post-publish-pre-ack"),
+]
+
+
+@pytest.mark.parametrize(
+    "lane,spec,label",
+    _MATRIX,
+    ids=[f"{lane}-{label}" for lane, spec, label in _MATRIX],
+)
+def test_crash_matrix_cell(lane, spec, label, tmp_path):
+    """One SIGKILL cell: an armed worker dies at the seam, the job(s)
+    redeliver to a clean survivor, and every at-least-once invariant
+    holds — original trace ids on the Converts, objects intact, zero
+    dangling multiparts, survivor ledger zero."""
+    if lane == "streamed":
+        objects = {"/video.mp4": os.urandom(512 * 1024)}
+        lane_env = {"BATCH_JOBS": "1"}
+    else:
+        objects = {
+            "/clip1.mp4": os.urandom(64 * 1024),
+            "/clip2.mp4": os.urandom(64 * 1024),
+        }
+        lane_env = {"BATCH_JOBS": "4", "BATCH_WAIT_MS": "400"}
+    with S3Stub(CREDS) as s3, AmqpServerStub() as broker, _Origin(
+        objects
+    ) as origin:
+        contexts = {}
+        for index, path in enumerate(sorted(objects)):
+            media_id = f"cell-{index}"
+            contexts[media_id] = _publish_job(
+                broker, media_id, f"{origin.url}{path}"
+            )
+        sink = _ConvertSink(broker)
+        armed = _spawn_worker(
+            "armed",
+            _worker_env(
+                broker, s3, str(tmp_path), FAILPOINT_SPEC=spec, **lane_env
+            ),
+        )
+        survivor = None
+        try:
+            # the armed worker must die AT the seam — SIGKILL, no
+            # graceful path, no atexit
+            assert armed.proc.wait(timeout=60) == -signal.SIGKILL, (
+                f"armed worker did not die at the {lane}/{label} seam"
+            )
+            armed.reap()
+            survivor = _spawn_worker(
+                "survivor", _worker_env(broker, s3, str(tmp_path), **lane_env)
+            )
+            expected = {
+                (media_id, context.trace_id)
+                for media_id, context in contexts.items()
+            }
+            _wait(
+                lambda: expected <= set(sink.snapshot()),
+                90.0,
+                f"redelivered jobs to complete ({lane}/{label})",
+            )
+            # trace-id continuity: NOTHING completed under a fresh id
+            foreign = [
+                entry
+                for entry in sink.snapshot()
+                if entry[0] in contexts
+                and entry[1] != contexts[entry[0]].trace_id
+            ]
+            assert not foreign, f"trace-id continuity broken: {foreign}"
+            stored = s3.buckets.get(BUCKET, {}).values()
+            for payload in objects.values():
+                assert payload in stored
+            _wait(
+                lambda: not s3.list_multipart_uploads(),
+                20.0,
+                "zero dangling multipart uploads",
+            )
+        finally:
+            sink.close()
+            for handle in (survivor, armed):
+                if handle is None:
+                    continue
+                handle.draining()
+                try:
+                    handle.proc.wait(timeout=10)
+                except Exception:
+                    handle.kill()
+                handle.reap()
+
+
+# -- failpoint storm: broker bounce + injected faults while draining ----------
+
+
+def test_failpoint_storm_two_workers_drain_everything(tmp_path):
+    """Two real workers drain 6 multipart jobs while seeded failpoints
+    inject publish drops, part-PUT 5xxs, and connect refusals — and the
+    broker bounces every client once mid-drain. At-least-once must
+    hold: every job completes under its original trace id, objects
+    intact, no dangling multiparts, both workers' ledgers at zero."""
+    objects = {
+        f"/movie{index}.mp4": os.urandom(256 * 1024) for index in range(6)
+    }
+    spec = (
+        "queue.publish=fail:0.25,s3.part_put=fail:0.1,net.connect=fail:0.03"
+    )
+    with S3Stub(CREDS) as s3, AmqpServerStub() as broker, _Origin(
+        objects
+    ) as origin:
+        contexts = {}
+        for index, path in enumerate(sorted(objects)):
+            media_id = f"storm-{index}"
+            contexts[media_id] = _publish_job(
+                broker, media_id, f"{origin.url}{path}"
+            )
+        sink = _ConvertSink(broker)
+        supervisor = FleetSupervisor(
+            _fast_config(
+                workers=2,
+                heartbeat_s=0.2,
+                stall_s=5.0,
+                start_grace_s=30.0,
+                drain_s=10.0,
+            ),
+            worker_env=_worker_env(
+                broker,
+                s3,
+                str(tmp_path),
+                FAILPOINT_SPEC=spec,
+                S3_MULTIPART_THRESHOLD=str(128 * 1024),
+                S3_PART_SIZE=str(128 * 1024),
+            ),
+        )
+        try:
+            supervisor.start()
+            _wait(
+                lambda: len(sink.snapshot()) >= 2,
+                60.0,
+                "the drain to get going",
+            )
+            broker.drop_clients()  # broker restart mid-drain
+            expected = {
+                (media_id, context.trace_id)
+                for media_id, context in contexts.items()
+            }
+            _wait(
+                lambda: expected <= set(sink.snapshot()),
+                120.0,
+                "every job to survive the storm",
+            )
+            stored = s3.buckets.get(BUCKET, {}).values()
+            for payload in objects.values():
+                assert payload in stored
+            _wait(
+                lambda: not s3.list_multipart_uploads(),
+                30.0,
+                "zero dangling multiparts after the storm",
+            )
+            for slot in supervisor.snapshot()["slots"]:
+                if slot["health_port"] and slot["state"] == "ready":
+                    _assert_worker_ledger_zero(slot["health_port"])
+        finally:
+            sink.close()
+            supervisor.drain()
