@@ -56,7 +56,11 @@ multipart jobs drained by BENCH_FLEET_WORKERS real worker processes
 over a TCP broker stub, one worker SIGKILLed mid-drain, seeded
 failpoints from BENCH_FLEET_SPEC injected throughout; reports drain
 time, restart latency, redeliveries, and the dangling-multipart count,
-which must be zero).
+which must be zero),
+BENCH_FLEETPLANE=0 to skip the fleet debug-plane fan-out arm
+(BENCH_FLEETPLANE_WORKERS stub worker endpoints, one wedged, scraped
+under the BENCH_FLEETPLANE_TIMEOUT_S per-worker budget; the wedged
+fan-out must stay within ~one timeout slice).
 
 On the measurement noise: this box's absolute throughput swings ~3x on
 multi-second timescales (the same configuration has measured 85 and 580
@@ -1703,6 +1707,95 @@ def run_fleet_chaos_arm(
         shutil.rmtree(site, ignore_errors=True)
 
 
+def run_fleet_scrape_arm(
+    workers: int = 4, timeout_s: float = 0.5
+) -> dict:
+    """Fleet debug-plane fan-out wall time (ISSUE 15): N stub worker
+    health endpoints — one of them WEDGED (accepts the request, never
+    answers) — scraped concurrently by the FleetQueryPlane. The
+    contract number: the fan-out WITH the wedged worker stays within
+    ~one per-worker scrape-timeout budget, because a wedged worker
+    costs its slice, never the response."""
+    import http.server as http_server
+    import socketserver
+
+    from downloader_tpu.daemon.fleetplane import FleetQueryPlane
+
+    body = json.dumps(
+        {"records": [{"ts": float(i), "msg": f"r{i}"} for i in range(50)]}
+    ).encode()
+    release = threading.Event()
+
+    def make_server(wedge: bool):
+        class Handler(http_server.BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                if wedge:
+                    release.wait(30.0)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except OSError:
+                    pass
+
+        server = socketserver.ThreadingTCPServer(("127.0.0.1", 0), Handler)
+        server.daemon_threads = True
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        return server
+
+    healthy = [make_server(False) for _ in range(max(1, workers - 1))]
+    wedged = make_server(True)
+    try:
+        def members(include_wedged: bool):
+            fleet = [
+                (f"worker-{i}", server.server_address[1])
+                for i, server in enumerate(healthy)
+            ]
+            if include_wedged:
+                fleet.append(("worker-wedged", wedged.server_address[1]))
+            return fleet
+
+        def timed(include_wedged: bool):
+            plane = FleetQueryPlane(
+                lambda: members(include_wedged), timeout_s=timeout_s
+            )
+            laps = []
+            results: dict = {}
+            for _ in range(3):
+                start = time.monotonic()
+                results = plane.fanout("/debug/logs")
+                laps.append(time.monotonic() - start)
+            ok = sum(1 for entry in results.values() if entry.get("ok"))
+            return min(laps), ok
+
+        healthy_s, healthy_ok = timed(False)
+        wedged_s, wedged_ok = timed(True)
+        # one timeout slice + the join grace + scheduler jitter on a
+        # loaded host; N workers must never cost N slices
+        budget_s = timeout_s + 1.0
+        return {
+            "metric": "fleet_scrape",
+            "unit": "ms",
+            "workers": len(healthy) + 1,
+            "timeout_s": timeout_s,
+            "healthy_ms": round(healthy_s * 1000, 1),
+            "wedged_ms": round(wedged_s * 1000, 1),
+            "healthy_ok": healthy_ok,
+            "wedged_ok": wedged_ok,
+            "within_one_timeout_budget": wedged_s <= budget_s,
+        }
+    finally:
+        release.set()
+        for server in healthy + [wedged]:
+            server.shutdown()
+            server.server_close()
+
+
 def main() -> None:
     jobs = int(os.environ.get("BENCH_JOBS", 24))
     mb_per_job = int(os.environ.get("BENCH_MB", 48))
@@ -2011,6 +2104,28 @@ def main() -> None:
                 f"multiparts {fleet_chaos['dangling_multiparts']}"
             )
 
+        fleet_scrape = None
+        if os.environ.get("BENCH_FLEETPLANE", "1") != "0":
+            scrape_workers = max(
+                2, int(os.environ.get("BENCH_FLEETPLANE_WORKERS", 4))
+            )
+            scrape_timeout = float(
+                os.environ.get("BENCH_FLEETPLANE_TIMEOUT_S", 0.5)
+            )
+            _log(
+                f"bench: fleet scrape arm, {scrape_workers} stub workers "
+                f"(one wedged) under a {scrape_timeout:g}s per-worker budget"
+            )
+            fleet_scrape = run_fleet_scrape_arm(
+                workers=scrape_workers, timeout_s=scrape_timeout
+            )
+            _log(
+                "bench: fleet scrape healthy "
+                f"{fleet_scrape['healthy_ms']}ms, with wedged worker "
+                f"{fleet_scrape['wedged_ms']}ms (budget ok: "
+                f"{fleet_scrape['within_one_timeout_budget']})"
+            )
+
         extra_metrics = [
             {
                 "metric": "job_overhead_latency_ms",
@@ -2056,6 +2171,8 @@ def main() -> None:
             extra_metrics.append(profile_arm)
         if fleet_chaos is not None:
             extra_metrics.append(fleet_chaos)
+        if fleet_scrape is not None:
+            extra_metrics.append(fleet_scrape)
         if os.environ.get("BENCH_DIGEST", "1") != "0":
             _log("bench: digest kernel micro-benchmark (pallas vs hashlib)")
             try:

@@ -114,6 +114,12 @@ def digest_line(report: dict) -> dict:
             out["fleet_duplicate_converts"] = extra.get(
                 "duplicate_converts"
             )
+        elif metric == "fleet_scrape":
+            out["fleet_scrape_ms"] = extra.get("healthy_ms")
+            out["fleet_scrape_wedged_ms"] = extra.get("wedged_ms")
+            out["fleet_scrape_budget_ok"] = extra.get(
+                "within_one_timeout_budget"
+            )
     return out
 
 

@@ -372,7 +372,7 @@ class Daemon:
         # distribution an operator alerts on
         elapsed = time.monotonic() - started
         metrics.GLOBAL.observe("job_duration_seconds", elapsed)
-        self._observe_slo(delivery, elapsed)
+        self._observe_slo(delivery, elapsed, trace_id=trace.trace_id)
 
     def _job_mirrors(self, delivery: Delivery, url: str) -> "tuple[str, ...]":
         """The mirror URLs riding this job: the producer's X-Mirrors
@@ -389,13 +389,21 @@ class Daemon:
             cap=self._config.mirror_max,
         )
 
-    def _observe_slo(self, delivery: Delivery, elapsed: float) -> None:
+    def _observe_slo(
+        self, delivery: Delivery, elapsed: float, trace_id: str = ""
+    ) -> None:
         """Per-class SLO latency histogram: the series an operator
         actually alerts on — interactive p99 must hold while bulk is
         allowed to degrade, so the two classes must never share one
-        distribution."""
+        distribution. ``trace_id`` rides as an exemplar (one bounded
+        deque append) so a firing burn alert links straight to example
+        traces instead of a bare percentile."""
         job_class = delivery.job_class or self._config.admission_default_class
-        metrics.GLOBAL.observe(f"slo_job_duration_seconds_{job_class}", elapsed)
+        metrics.GLOBAL.observe(
+            f"slo_job_duration_seconds_{job_class}",
+            elapsed,
+            exemplar=trace_id,
+        )
 
     def _settle_transient(self, delivery, job_log, trace, exc) -> None:
         """One retry-or-drop policy for every transient job failure —
@@ -640,11 +648,14 @@ class Daemon:
             state.trace.root.record("ack", ack_started, ack_ended)
             state.job_log.info("finished processing")
             state.trace.root.set_status("ok")
+            # the exemplar id must be read BEFORE complete() hands the
+            # trace to the ring (the OpenTrace forgets it on settle)
+            trace_id = state.trace.trace_id
             self._finish_fast_job(state)
             self.stats.bump(processed=1)
             elapsed = time.monotonic() - state.started
             metrics.GLOBAL.observe("job_duration_seconds", elapsed)
-            self._observe_slo(state.delivery, elapsed)
+            self._observe_slo(state.delivery, elapsed, trace_id=trace_id)
 
     def _finish_fast_job(self, state: "_FastJob") -> None:
         state.trace.complete()

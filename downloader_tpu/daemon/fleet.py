@@ -33,7 +33,16 @@ Per worker the supervisor owns:
 - **federation** — once a worker heartbeats, the supervisor registers
   an HTTP scraper for its ``/metrics`` as a child source, so the
   parent's ``/metrics/federate`` serves the whole fleet under one
-  scrape.
+  scrape; a reaped worker's source deregisters with it, and a failing
+  scrape costs its samples and a ``fleet_scrape_failures`` bump, never
+  the render;
+- **the debug plane** — the supervisor's ``FleetHealthServer`` is a
+  fleet QUERY plane (daemon/fleetplane.py): every ``/debug/*`` view
+  fans out to the ready workers' health ports concurrently under the
+  ``FLEET_SCRAPE_TIMEOUT_S`` budget and merges with ``instance``
+  attribution, and the alert engine runs fleet-summed burn rules plus
+  a worker-outlier rule whose firing captures one cross-worker
+  incident bundle.
 
 On SIGTERM the supervisor drains: SIGTERM to every worker (each runs
 its own graceful path — finish in-flight jobs, requeue parked/unacked
@@ -48,7 +57,6 @@ at runtime by the ProtocolRecorder over the fleet suite.
 
 from __future__ import annotations
 
-import http.client
 import json
 import os
 import signal
@@ -71,6 +79,8 @@ DEFAULT_RESTART_BACKOFF_CAP_S = 30.0
 DEFAULT_START_GRACE_S = 20.0
 DEFAULT_START_FAILURES_MAX = 3
 DEFAULT_DRAIN_S = 30.0
+DEFAULT_SCRAPE_TIMEOUT_S = 2.0
+DEFAULT_OUTLIER_RATIO = 4.0
 
 
 def _int_env(env, name: str, default: int, minimum: int = 0) -> int:
@@ -126,6 +136,8 @@ class FleetConfig:
         start_grace_s: float = DEFAULT_START_GRACE_S,
         start_failures_max: int = DEFAULT_START_FAILURES_MAX,
         drain_s: float = DEFAULT_DRAIN_S,
+        scrape_timeout_s: float = DEFAULT_SCRAPE_TIMEOUT_S,
+        outlier_ratio: float = DEFAULT_OUTLIER_RATIO,
     ):
         self.workers = max(1, workers)
         self.heartbeat_s = heartbeat_s
@@ -136,6 +148,11 @@ class FleetConfig:
         self.start_grace_s = start_grace_s
         self.start_failures_max = max(1, start_failures_max)
         self.drain_s = drain_s
+        # fleet debug plane (daemon/fleetplane.py): the per-worker
+        # budget every /debug fan-out and federation scrape runs under,
+        # and the worker-outlier rule's p99-vs-fleet-median factor
+        self.scrape_timeout_s = max(0.05, scrape_timeout_s)
+        self.outlier_ratio = max(1.0, outlier_ratio)
 
     @classmethod
     def from_env(cls, environ=None) -> "FleetConfig":
@@ -162,6 +179,15 @@ class FleetConfig:
                 env, "FLEET_START_FAILURES_MAX", DEFAULT_START_FAILURES_MAX, 1
             ),
             drain_s=_float_env(env, "FLEET_DRAIN_S", DEFAULT_DRAIN_S),
+            scrape_timeout_s=_float_env(
+                env,
+                "FLEET_SCRAPE_TIMEOUT_S",
+                DEFAULT_SCRAPE_TIMEOUT_S,
+                0.05,
+            ),
+            outlier_ratio=_float_env(
+                env, "FLEET_OUTLIER_RATIO", DEFAULT_OUTLIER_RATIO, 1.0
+            ),
         )
 
 
@@ -759,21 +785,47 @@ class FleetSupervisor:
             port = slot.health_port
         if not port:
             return
+        timeout = self._config.scrape_timeout_s
+        from .fleetplane import _http_request
 
-        def scrape(port=port) -> str:
-            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2.0)
+        def scrape(port=port, timeout=timeout) -> str:
+            # bounded by the fleet scrape budget and counted on
+            # failure: a stale or wedged source costs its samples and
+            # a fleet_scrape_failures bump, never the federate render
+            # (render_federated catches and skips failing sources)
             try:
-                conn.request("GET", "/metrics")
-                response = conn.getresponse()
-                body = response.read()
-                status = response.status
-            finally:
-                conn.close()
-            if status != 200:
-                raise OSError(f"/metrics answered {status}")
+                status, body = _http_request(
+                    port, "/metrics", timeout=timeout
+                )
+                if status != 200:
+                    raise OSError(f"/metrics answered {status}")
+            except Exception:
+                metrics.GLOBAL.add("fleet_scrape_failures")
+                raise
             return body.decode()
 
         metrics.FEDERATION.register_source(slot.instance, scrape)
+
+    def ready_workers(self) -> "list[tuple[str, int]]":
+        """The fleet members a /debug fan-out may query: slots whose
+        worker has heartbeated (so the health port is known) and whose
+        process is still running — a reaped or just-killed worker
+        drops out here, so a stale member costs nothing, not even a
+        timeout slice."""
+        with self._lock:
+            out = []
+            for slot in self._slots:
+                handle = slot.handle
+                if (
+                    handle is None
+                    or not slot.ever_ready
+                    or not slot.health_port
+                ):
+                    continue
+                if handle.poll() is not None:
+                    continue
+                out.append((slot.instance, slot.health_port))
+        return out
 
     # -- the reaper --------------------------------------------------------
 
@@ -841,19 +893,39 @@ class FleetSupervisor:
 
 
 class FleetHealthServer:
-    """A thin ``/healthz`` + ``/metrics`` + ``/metrics/federate`` for
-    the supervisor process, built on the same renderers the worker's
-    health server uses — ``/metrics/federate`` here is the ONE scrape
-    that shows the whole fleet (each worker's samples under its
-    ``instance`` label, the supervisor's own fleet_* series under
-    ``fleet``)."""
+    """The fleet's operator endpoint: ``/healthz`` + ``/metrics`` +
+    ``/metrics/federate`` for the supervisor process (built on the
+    same renderers the worker's health server uses) PLUS the fleet
+    debug plane — every ``/debug/*`` view fans out to the ready
+    workers' health ports under the scrape-timeout budget and merges
+    with ``instance`` attribution (daemon/fleetplane.py):
+    ``/debug/trace?trace_id=`` stitches one logical trace across
+    processes, ``/debug/logs`` merges rings by timestamp,
+    ``/debug/incidents`` is the fleet index with fetch-by-id routed
+    to the owning worker, ``/debug/profile`` sums folded stacks,
+    ``/debug/tsdb`` aggregates rates and percentiles fleet-wide, and
+    ``POST /debug/incident`` captures one cross-worker bundle."""
 
-    def __init__(self, supervisor: FleetSupervisor, port: int, host: str):
+    def __init__(
+        self,
+        supervisor: FleetSupervisor,
+        port: int,
+        host: str,
+        plane=None,
+    ):
         import http.server
+        import urllib.parse
 
+        from .fleetplane import FleetQueryPlane
         from .health import render_federated, render_metrics
 
         fleet = supervisor
+        if plane is None:
+            plane = FleetQueryPlane(
+                supervisor.ready_workers,
+                timeout_s=supervisor._config.scrape_timeout_s,
+            )
+        self.plane = plane
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def log_message(self, *args):
@@ -862,7 +934,10 @@ class FleetHealthServer:
             def do_GET(self):
                 profiling.ROLES.register_current("health-server")
                 try:
-                    if self.path == "/healthz":
+                    parsed = urllib.parse.urlsplit(self.path)
+                    path = parsed.path
+                    query = urllib.parse.parse_qs(parsed.query)
+                    if path == "/healthz":
                         snap = fleet.snapshot()
                         degraded = snap["workers_alive"] < snap[
                             "workers_target"
@@ -871,17 +946,67 @@ class FleetHealthServer:
                         code = 503 if degraded else 200
                         body = (json.dumps(snap, indent=1) + "\n").encode()
                         ctype = "application/json"
-                    elif self.path == "/metrics":
+                    elif path == "/metrics":
                         code, body = 200, render_metrics()
                         ctype = "text/plain; version=0.0.4"
-                    elif self.path == "/metrics/federate":
+                    elif path == "/metrics/federate":
                         code, body = 200, render_federated(render_metrics())
                         ctype = "text/plain; version=0.0.4"
+                    elif path == "/debug/trace":
+                        code, body, ctype = plane.debug_trace(query)
+                    elif path == "/debug/logs":
+                        code, body, ctype = plane.debug_logs(query)
+                    elif path == "/debug/tsdb":
+                        code, body, ctype = plane.debug_tsdb(query)
+                    elif path == "/debug/profile":
+                        code, body, ctype = plane.debug_profile(query)
+                    elif path == "/debug/alerts":
+                        code, body, ctype = plane.debug_alerts()
+                    elif path == "/debug/incidents":
+                        code, body, ctype = plane.debug_incidents()
+                    elif path.startswith("/debug/incidents/"):
+                        code, body, ctype = plane.debug_incident(
+                            path[len("/debug/incidents/"):]
+                        )
+                    elif path in (
+                        "/debug/watchdog", "/debug/admission", "/debug/jobs"
+                    ):
+                        code, body, ctype = plane.debug_passthrough(path)
                     else:
                         code, body, ctype = 404, b"not found\n", "text/plain"
                 except Exception as exc:
                     log.error("fleet health view failed", exc=exc)
                     code, body, ctype = 500, b"internal error\n", "text/plain"
+                self._reply(code, body, ctype)
+
+            def do_POST(self):
+                profiling.ROLES.register_current("health-server")
+                try:
+                    if self.path == "/debug/incident":
+                        bundle = plane.capture_fleet_incident(
+                            "operator-requested fleet capture "
+                            "(POST /debug/incident)",
+                            trigger="manual",
+                        )
+                        payload = {
+                            "id": bundle["id"] if bundle else None,
+                            "workers": sorted(
+                                (bundle or {})
+                                .get("extra", {})
+                                .get("workers", {})
+                            ),
+                        }
+                        code = 200
+                        body = (json.dumps(payload) + "\n").encode()
+                        ctype = "application/json"
+                    else:
+                        code, body, ctype = 404, b"not found\n", "text/plain"
+                except Exception as exc:
+                    log.error("fleet health view failed", exc=exc)
+                    code, body, ctype = 500, b"internal error\n", "text/plain"
+                self._reply(code, body, ctype)
+
+            def _reply(self, code, body, ctype):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
@@ -937,20 +1062,51 @@ def run_fleet(
 
     # the supervisor's own telemetry plane: its registry carries the
     # fleet_* series, the TSDB gives the flapping rule its windowed
-    # rate, and the alert engine pages when restarts churn
+    # rate — and the fleet debug plane promotes it to the FLEET's
+    # telemetry: the aggregator collector folds every worker's SLO
+    # histograms (summed + per-instance) into the supervisor's TSDB,
+    # the alert engine runs fleet burn + worker-outlier rules over
+    # them, and a firing fleet rule captures one cross-worker incident
+    from .fleetplane import (
+        FleetAggregator, FleetQueryPlane, fleet_alert_rules,
+    )
+
     metrics.FEDERATION.instance = "fleet"
     watchdog.MONITOR.configure(
         stall_s=watchdog.stall_from_env(), action="log"
     )
     watchdog.MONITOR.start()
+
+    supervisor = FleetSupervisor(config, token=token, worker_env=worker_env)
+    plane = FleetQueryPlane(
+        supervisor.ready_workers,
+        timeout_s=config.scrape_timeout_s,
+        engine=alerts.ENGINE,
+    )
+    aggregator = FleetAggregator(plane, store=tsdb.STORE)
     tsdb.STORE.configure(interval_s=tsdb.interval_from_env())
+    tsdb.STORE.register_collector("fleet-aggregator", aggregator.collect)
     tsdb.STORE.start()
+    fast_window, slow_window = alerts.windows_from_env()
+    slo_interactive_s, slo_bulk_s = alerts.slo_targets_from_env()
     alerts.ENGINE.configure(
-        rules=alerts.fleet_rules(), interval_s=alerts.interval_from_env()
+        rules=alerts.fleet_rules(fast_window)
+        + fleet_alert_rules(
+            aggregator,
+            slo_interactive_s=slo_interactive_s,
+            slo_bulk_s=slo_bulk_s,
+            objective=alerts.objective_from_env(),
+            fast_window_s=fast_window,
+            slow_window_s=slow_window,
+            factor=alerts.burn_factor_from_env(),
+            outlier_ratio=config.outlier_ratio,
+        ),
+        interval_s=alerts.interval_from_env(),
+        on_fire=plane.alert_fired,
+        exemplar_source=aggregator.exemplars_for,
     )
     alerts.ENGINE.start()
 
-    supervisor = FleetSupervisor(config, token=token, worker_env=worker_env)
     health = None
     health_port = _int_env(os.environ, "HEALTH_PORT", 0)
     if health_port > 0:
@@ -958,11 +1114,13 @@ def run_fleet(
             supervisor,
             health_port,
             os.environ.get("HEALTH_HOST", "127.0.0.1"),
+            plane=plane,
         ).start()
     try:
         return supervisor.run()
     finally:
         alerts.ENGINE.stop()
+        tsdb.STORE.unregister_collector("fleet-aggregator")
         tsdb.STORE.stop()
         watchdog.MONITOR.stop()
         if health is not None:

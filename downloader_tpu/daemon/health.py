@@ -37,9 +37,18 @@ server exposes:
   on-CPU samples, off-CPU waits (lock/io/queue, named locks
   included), or tracemalloc allocation sites; ``role=`` filters to
   one thread role, ``window=`` seconds bounds the sample window.
+- ``GET /debug/exemplars`` — recent trace-id exemplars per histogram
+  family (utils/metrics.py): the metric→trace back-link, scraped by
+  the fleet supervisor's aggregator so a FLEET-level burn alert links
+  to example traces on the worker that recorded them.
 - ``GET /metrics/federate`` — this worker's exposition merged with
   every registered child-worker source, per-sample ``instance``
   labels (the fleet-aggregation groundwork for ROADMAP item 1).
+
+The fleet supervisor's ``FleetHealthServer`` (daemon/fleet.py) serves
+the same ``/debug/*`` paths FLEET-scoped: each one fans out to every
+ready worker's health port and merges with instance attribution
+(daemon/fleetplane.py).
 
 The server is a ``ThreadingHTTPServer`` (daemon threads) on purpose: a
 slow ``/debug/trace`` serialization or a fat incident bundle must
@@ -109,6 +118,8 @@ class HealthServer:
                         code, body, ctype = health._debug_admission()
                     elif path == "/debug/logs":
                         code, body, ctype = health._debug_logs()
+                    elif path == "/debug/exemplars":
+                        code, body, ctype = health._debug_exemplars()
                     elif path == "/debug/incidents":
                         code, body, ctype = health._debug_incidents()
                     elif path.startswith("/debug/incidents/"):
@@ -361,6 +372,17 @@ class HealthServer:
         return (
             200,
             (json.dumps(payload, indent=1, default=str) + "\n").encode(),
+            "application/json",
+        )
+
+    def _debug_exemplars(self) -> tuple[int, bytes, str]:
+        """Recent trace-id exemplars per histogram family — what the
+        fleet aggregator scrapes beside /metrics so fleet burn alerts
+        link straight to example traces."""
+        payload = {"exemplars": metrics.GLOBAL.exemplars_snapshot()}
+        return (
+            200,
+            (json.dumps(payload, indent=1) + "\n").encode(),
             "application/json",
         )
 

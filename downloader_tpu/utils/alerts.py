@@ -137,17 +137,37 @@ def slo_targets_from_env(environ=None) -> "tuple[float, float]":
 
 class RegistryView:
     """What a rule condition may read: live gauges from the registry
-    (a threshold on a level must see NOW, not the last scrape) and
-    windowed counter rates / histogram deltas from the TSDB."""
+    (a threshold on a level must see NOW, not the last scrape),
+    windowed counter rates / histogram deltas from the TSDB, and
+    recent trace-id exemplars for a histogram family (local registry
+    first; ``exemplar_source`` covers series that live only in the
+    TSDB, like the fleet supervisor's aggregated worker sums)."""
 
-    def __init__(self, store: "tsdb.TimeSeriesStore"):
+    def __init__(
+        self,
+        store: "tsdb.TimeSeriesStore",
+        exemplar_source=None,
+    ):
         self._store = store
+        self._exemplar_source = exemplar_source
 
     def gauge(self, name: str) -> float | None:
         gauges = metrics.GLOBAL.gauges()
         if name in gauges:
             return gauges[name]
         return self._store.latest(name)
+
+    def exemplars(self, name: str) -> list[dict]:
+        """Recent {trace_id, value, ts} exemplars for ``name`` — the
+        metric→trace back-link a firing burn alert serves."""
+        out = metrics.GLOBAL.exemplars(name)
+        if not out and self._exemplar_source is not None:
+            try:
+                out = list(self._exemplar_source(name) or [])
+            except Exception:
+                # exemplars are evidence garnish, never a verdict input
+                out = []
+        return out
 
     def counter_rate(
         self, name: str, window_s: float, now: float
@@ -350,14 +370,21 @@ class BurnRateRule(AlertRule):
         fast_window_s: float = DEFAULT_FAST_WINDOW_S,
         slow_window_s: float = DEFAULT_SLOW_WINDOW_S,
         factor: float = DEFAULT_BURN_FACTOR,
+        seed_registry: bool = True,
         **kwargs,
     ):
+        """``seed_registry=False`` marks a series whose samples come
+        from a TSDB collector rather than the local registry (the fleet
+        supervisor's aggregated worker sums): seeding a zeroed registry
+        histogram under that name would make the scrape loop record a
+        second, always-zero series that fights the collector's."""
         super().__init__(name, series, **kwargs)
         self.target_s = target_s
         self.objective = objective
         self.fast_window_s = fast_window_s
         self.slow_window_s = max(slow_window_s, fast_window_s)
         self.factor = factor
+        self.seed_registry = seed_registry
 
     def _condition(self, view: RegistryView, now: float):
         fast = view.error_burn(
@@ -375,6 +402,12 @@ class BurnRateRule(AlertRule):
             "burn_fast": None if fast is None else round(fast, 3),
             "burn_slow": None if slow is None else round(slow, 3),
         }
+        # the metric→trace link: recent exemplars for the watched
+        # series ride the detail, so /debug/alerts and the incident
+        # bundle point straight at example traces of the burn
+        exemplars = view.exemplars(self.series)
+        if exemplars:
+            detail["exemplars"] = exemplars
         if fast is None or slow is None:
             return False, detail
         return fast >= self.factor and slow >= self.factor, detail
@@ -423,6 +456,68 @@ class ThresholdRule(AlertRule):
         if self.op == ">=":
             return value >= self.threshold, detail
         return value <= self.threshold, detail
+
+
+class WorkerOutlierRule(AlertRule):
+    """One fleet member far from the fleet median NAMES the instance:
+    ``provider()`` returns ``{instance: value}`` (per-worker windowed
+    p99 or error rate, computed by the fleet aggregator); the rule
+    fires when the worst instance sits at ``ratio`` × its PEERS'
+    median or beyond. Needs at least two reporting instances (one worker has
+    no fleet to be an outlier of) and an absolute ``min_value`` floor
+    so microsecond-scale medians cannot page on noise ratios."""
+
+    kind = "worker-outlier"
+
+    def __init__(
+        self,
+        name: str,
+        series: str,
+        provider,
+        ratio: float = 4.0,
+        min_value: float = 0.05,
+        **kwargs,
+    ):
+        super().__init__(name, series, **kwargs)
+        self._provider = provider
+        self.ratio = max(1.0, ratio)
+        self.min_value = min_value
+
+    def _condition(self, view: RegistryView, now: float):
+        import statistics
+
+        raw = self._provider() or {}
+        values = {
+            instance: value
+            for instance, value in raw.items()
+            if value is not None
+        }
+        detail: dict = {
+            "ratio": self.ratio,
+            "min_value": self.min_value,
+            "values": {
+                instance: round(value, 4)
+                for instance, value in sorted(values.items())
+            },
+        }
+        if len(values) < 2:
+            return False, detail
+        worst_instance, worst = max(values.items(), key=lambda kv: kv[1])
+        # median of the PEERS: including the candidate itself would
+        # let a 2-worker fleet's outlier drag the median halfway to
+        # its own value and never trip the ratio
+        median = statistics.median(
+            value
+            for instance, value in values.items()
+            if instance != worst_instance
+        )
+        detail["median"] = round(median, 4)
+        detail["instance"] = worst_instance
+        detail["worst"] = round(worst, 4)
+        breached = worst >= self.min_value and worst >= max(
+            median * self.ratio, self.min_value
+        )
+        return breached, detail
 
 
 def default_rules(
@@ -561,12 +656,22 @@ class AlertEngine:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None  # guarded-by: _lock
         self._evals = 0  # guarded-by: _lock
+        # firing hand-off override: the fleet supervisor installs a
+        # cross-worker capture here (every worker's POST /debug/incident
+        # bundled under one fleet id); None keeps the local flight-
+        # recorder capture
+        self._on_fire = None  # guarded-by: _lock
+        # exemplar lookup for series that live only in the TSDB (the
+        # supervisor's fleet-aggregated sums); None = registry only
+        self._exemplar_source = None  # guarded-by: _lock
 
     def configure(
         self,
         rules: "list[AlertRule] | None" = None,
         interval_s: float | None = None,
         store: "tsdb.TimeSeriesStore | None" = None,
+        on_fire=None,
+        exemplar_source=None,
     ) -> None:
         with self._lock:
             if rules is not None:
@@ -575,15 +680,21 @@ class AlertEngine:
                 self._rules = list(rules)
             if store is not None:
                 self._store = store
+            if on_fire is not None:
+                self._on_fire = on_fire
+            if exemplar_source is not None:
+                self._exemplar_source = exemplar_source
             installed = list(self._rules)
         if interval_s is not None:
             self.interval_s = interval_s
         # burn windows are DELTAS between registry snapshots, so each
         # watched histogram must exist (zeroed) before its first
         # observation: otherwise the family's first sample already
-        # carries the whole burst and no in-window delta ever shows it
+        # carries the whole burst and no in-window delta ever shows it.
+        # Collector-fed series (seed_registry=False) are the exception:
+        # a zeroed registry twin would fight the collector's samples.
         for rule in installed:
-            if isinstance(rule, BurnRateRule):
+            if isinstance(rule, BurnRateRule) and rule.seed_registry:
                 metrics.GLOBAL.ensure_histogram(rule.series)
 
     @property
@@ -602,6 +713,8 @@ class AlertEngine:
             rules = list(self._rules)
             self._history.clear()
             self._evals = 0
+            self._on_fire = None
+            self._exemplar_source = None
         for rule in rules:
             rule.reset()
         metrics.GLOBAL.gauge_set("alerts_firing", 0)
@@ -612,10 +725,12 @@ class AlertEngine:
         """One pass over the rules; returns rules that transitioned to
         firing this pass (tests drive this synchronously)."""
         now = time.time() if now is None else now
-        view = RegistryView(self._store)
         with self._lock:
             rules = list(self._rules)
+            exemplar_source = self._exemplar_source
+            on_fire = self._on_fire
             self._evals += 1
+        view = RegistryView(self._store, exemplar_source=exemplar_source)
         fired: "list[AlertRule]" = []
         for rule in rules:
             transition = rule.evaluate(view, now)
@@ -644,7 +759,18 @@ class AlertEngine:
         metrics.GLOBAL.gauge_set("alerts_firing", firing_now)
         for rule in fired:
             metrics.GLOBAL.add("alerts_fired")
-            self._capture_async(rule)
+            if on_fire is not None:
+                # the installed hand-off owns its own threading (the
+                # fleet capture fans out HTTP posts); its bug must cost
+                # the capture, never the evaluator
+                try:
+                    on_fire(rule)
+                except Exception as exc:
+                    log.with_fields(rule=rule.name).warning(
+                        f"alert on_fire hand-off failed: {exc}"
+                    )
+            else:
+                self._capture_async(rule)
         return fired
 
     def _capture_async(self, rule: AlertRule) -> None:

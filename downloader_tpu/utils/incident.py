@@ -386,4 +386,22 @@ class IncidentRecorder:
         return None
 
 
+def merge_incident_indexes(
+    indexes_by_instance: "dict[str, list[dict]]",
+) -> list[dict]:
+    """One fleet incident index from per-worker ``/debug/incidents``
+    listings (plus the supervisor's own under its instance): every
+    summary tagged with the worker that owns the bundle, sorted by id
+    (ids embed the capture timestamp, so this is capture order).
+    Fetch-by-id then routes to the tagged owner."""
+    merged: list[dict] = []
+    for instance in sorted(indexes_by_instance):
+        for summary in indexes_by_instance[instance] or []:
+            entry = dict(summary)
+            entry["instance"] = instance
+            merged.append(entry)
+    merged.sort(key=lambda e: (str(e.get("id", "")), e.get("instance", "")))
+    return merged
+
+
 RECORDER = IncidentRecorder()

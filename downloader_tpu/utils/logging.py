@@ -94,6 +94,50 @@ def ring_tail(limit: int | None = None) -> list[dict]:
     return records
 
 
+def merge_ring_records(
+    records_by_instance: "dict[str, list[dict]]",
+    limit: int | None = None,
+) -> list[dict]:
+    """K-way merge of per-worker log rings by wall-clock ``ts``, each
+    record tagged with its worker ``instance`` — the fleet /debug/logs
+    view. The merge is a heads-only k-way merge, so it is STABLE under
+    clock skew: a worker's records keep their original relative order
+    no matter what its clock says (only cross-worker interleaving
+    follows the timestamps, which is the best any merge can honestly
+    do with skewed clocks). ``limit`` keeps the newest records."""
+    import heapq
+
+    heap: list = []
+    for index, instance in enumerate(sorted(records_by_instance)):
+        source = iter(records_by_instance[instance] or [])
+        first = next(source, None)
+        if first is not None:
+            # (ts, source index, per-source counter) is a unique key, so
+            # heapq never falls through to comparing the record dicts
+            heapq.heappush(
+                heap,
+                (first.get("ts", 0.0), index, 0, instance, first, source),
+            )
+    merged: list[dict] = []
+    while heap:
+        _, index, n, instance, record, source = heapq.heappop(heap)
+        tagged = dict(record)
+        tagged.setdefault("instance", instance)
+        merged.append(tagged)
+        following = next(source, None)
+        if following is not None:
+            heapq.heappush(
+                heap,
+                (
+                    following.get("ts", 0.0), index, n + 1,
+                    instance, following, source,
+                ),
+            )
+    if limit is not None and limit >= 0:
+        merged = merged[-limit:] if limit > 0 else []
+    return merged
+
+
 class _Config:
     level: int = _LEVELS["info"]
     json_format: bool = False

@@ -18,7 +18,8 @@ as Prometheus metrics once prefixed, e.g. ``torrent_bytes_served`` →
 from __future__ import annotations
 
 import threading
-from collections import defaultdict
+import time
+from collections import defaultdict, deque
 
 # histogram buckets (seconds) for job-scale latencies: sub-second jobs
 # land in the fine buckets, torrent jobs in the coarse tail
@@ -210,6 +211,20 @@ HELP = {
         "workers that exited during startup without ever heartbeating "
         "(fatal-after-M slots escalate instead of restart-looping)"
     ),
+    # fleet debug plane (daemon/fleetplane.py)
+    "fleet_scrape_failures": (
+        "per-worker scrapes that failed or timed out during a fleet "
+        "fan-out (federation child sources and /debug/* queries; a "
+        "wedged worker costs its timeout slice, never the response)"
+    ),
+    "fleet_debug_fanouts": (
+        "fleet debug-plane fan-out queries served (each one concurrent "
+        "scrape per ready worker)"
+    ),
+    "fleet_incidents": (
+        "cross-worker incident bundles captured by the fleet supervisor "
+        "(every worker's POST /debug/incident snapshot under one id)"
+    ),
     "multipart_stale_aborts": (
         "stale multipart uploads aborted by the crash janitor (orphans "
         "of workers that died mid-stream)"
@@ -274,6 +289,12 @@ class Federation:
 FEDERATION = Federation()
 
 
+# recent exemplars retained per histogram family: enough to link a
+# firing burn alert to a handful of example traces, small enough that
+# the registry's memory stays fixed
+EXEMPLARS_PER_FAMILY = 4
+
+
 class Counters:
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -283,6 +304,9 @@ class Counters:
         self._hists: dict[  # guarded-by: _lock
             str, tuple[tuple[float, ...], list[int], float, int]
         ] = {}
+        # name -> recent {trace_id, value, ts} exemplars (observe() with
+        # exemplar=): the metric -> trace back-link a burn alert serves
+        self._exemplars: dict[str, "deque[dict]"] = {}  # guarded-by: _lock
 
     def add(self, name: str, value: int = 1) -> None:
         with self._lock:
@@ -316,12 +340,16 @@ class Counters:
         name: str,
         value: float,
         buckets: tuple[float, ...] = LATENCY_BUCKETS,
+        exemplar: str | None = None,
     ) -> None:
         """Record one sample into the fixed-bucket histogram ``name``
         (cumulative le-buckets, Prometheus semantics). ``buckets`` is
         fixed at the first observation; later calls reuse the stored
         bounds (mixing bucket layouts per series is undefined in
-        Prometheus anyway)."""
+        Prometheus anyway). ``exemplar`` (a trace id) is retained in a
+        tiny per-family ring so a firing burn alert links straight to
+        example traces — one deque append, nothing on the hot path
+        when no exemplar is passed."""
         with self._lock:
             bounds, counts, total, count = self._hists.get(
                 name, (buckets, [0] * len(buckets), 0.0, 0)
@@ -330,6 +358,37 @@ class Counters:
                 if value <= le:
                     counts[i] += 1
             self._hists[name] = (bounds, counts, total + value, count + 1)
+            if exemplar:
+                ring = self._exemplars.get(name)
+                if ring is None:
+                    ring = self._exemplars[name] = deque(
+                        maxlen=EXEMPLARS_PER_FAMILY
+                    )
+                ring.append(
+                    {
+                        "trace_id": exemplar,
+                        "value": round(value, 6),
+                        "ts": time.time(),
+                    }
+                )
+
+    def exemplars(self, name: str) -> list[dict]:
+        """Recent exemplars for histogram family ``name`` (oldest
+        first); empty when none were recorded."""
+        with self._lock:
+            ring = self._exemplars.get(name)
+            return [dict(entry) for entry in ring] if ring else []
+
+    def exemplars_snapshot(self) -> dict[str, list[dict]]:
+        """Every family's recent exemplars — what the worker's
+        ``/debug/exemplars`` endpoint serves so the fleet aggregator
+        can link fleet-level burn alerts to per-worker traces."""
+        with self._lock:
+            return {
+                name: [dict(entry) for entry in ring]
+                for name, ring in sorted(self._exemplars.items())
+                if ring
+            }
 
     def snapshot(self) -> dict[str, int]:
         with self._lock:
@@ -355,6 +414,7 @@ class Counters:
             self._values.clear()
             self._gauges.clear()
             self._hists.clear()
+            self._exemplars.clear()
 
 
 GLOBAL = Counters()

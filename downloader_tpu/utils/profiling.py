@@ -815,6 +815,21 @@ class SamplingProfiler:
 PROFILER = SamplingProfiler()
 
 
+def merge_folded(
+    stacks_by_instance: "dict[str, dict[str, int]]",
+) -> dict[str, int]:
+    """Sum per-worker folded-stack aggregates into one fleet profile:
+    identical collapsed stacks add their weights, so the merged total
+    equals the sum of every worker's total (the fleet /debug/profile
+    fold — per-instance attribution rides beside it in the JSON view,
+    this is just the flamegraph's shared denominator)."""
+    merged: dict[str, int] = {}
+    for stacks in stacks_by_instance.values():
+        for stack, weight in (stacks or {}).items():
+            merged[stack] = merged.get(stack, 0) + int(weight)
+    return merged
+
+
 def configure(**kwargs) -> None:
     """Module-level convenience mirroring tsdb/alerts: serve() and
     tests configure the process-wide profiler (and the plane's

@@ -281,6 +281,12 @@ class Span:
         end = self.end if self.end is not None else time.monotonic()
         return end - self.start
 
+    @property
+    def trace_id(self) -> str:
+        """The owning trace's propagated id — what an SLO exemplar
+        records so a histogram links back to example traces."""
+        return self._trace.trace_id
+
     def to_dict(self, t0: float) -> dict:
         entry = {
             "name": self.name,
@@ -306,6 +312,7 @@ class _NoopSpan:
     meta = None
     children: list = []
     duration = 0.0
+    trace_id = ""
 
     def __enter__(self) -> "_NoopSpan":
         return self
@@ -669,6 +676,10 @@ class OpenTrace:
     def status(self) -> str:
         return self._trace.status if self._trace is not None else "noop"
 
+    @property
+    def trace_id(self) -> str:
+        return self._trace.trace_id if self._trace is not None else ""
+
     def activate(self) -> "adopt":
         """Context manager installing the job root as the calling
         thread's current span, so ``span()`` calls nest under it."""
@@ -683,6 +694,48 @@ class OpenTrace:
 
 
 NOOP_OPEN_TRACE = OpenTrace(None, None)
+
+
+def _tag_span_tree(node: dict, instance: str) -> dict:
+    """Copy a serialized span tree tagging every node with the worker
+    instance it was recorded on — a stitched cross-process lineage must
+    say per SPAN which process did the work, not just per attempt."""
+    tagged = dict(node)
+    tagged["instance"] = instance
+    children = node.get("children")
+    if children:
+        tagged["children"] = [
+            _tag_span_tree(child, instance) for child in children
+        ]
+    return tagged
+
+
+def stitch_lineage(
+    trace_id: str, attempts_by_instance: "dict[str, list[dict]]"
+) -> dict:
+    """One logical trace across worker processes: each instance's
+    ``lineage()`` attempts (as served by its ``/debug/trace?trace_id=``)
+    merged into a single ordered lineage, every attempt and every span
+    tagged with the instance that recorded it. Ordering is (attempt
+    ordinal, wall start) — a redelivered attempt that re-ran on a
+    second worker after a SIGKILL sorts after the run it replaced."""
+    merged: list[dict] = []
+    for instance in sorted(attempts_by_instance):
+        for attempt in attempts_by_instance[instance] or []:
+            entry = dict(attempt)
+            entry["instance"] = instance
+            spans = entry.get("spans")
+            if isinstance(spans, dict):
+                entry["spans"] = _tag_span_tree(spans, instance)
+            merged.append(entry)
+    merged.sort(
+        key=lambda a: (a.get("attempt", 0), a.get("wall_start", 0.0))
+    )
+    return {
+        "trace_id": trace_id,
+        "attempts": merged,
+        "instances": sorted({a["instance"] for a in merged}),
+    }
 
 
 TRACER = Tracer()

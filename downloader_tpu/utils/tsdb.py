@@ -176,6 +176,12 @@ class TimeSeriesStore:
         self._lock = threading.Lock()
         self._series: dict[str, _Series] = {}  # guarded-by: _lock
         self._scrapes = 0  # guarded-by: _lock
+        # extra sample sources beyond the registry: name -> callable
+        # returning (name, kind, value) batch entries, folded into
+        # every scrape. The fleet supervisor registers its worker
+        # aggregator here so fleet-summed series get the same windowed
+        # rate/quantile machinery local families do.
+        self._collectors: dict[str, object] = {}  # guarded-by: _lock
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None  # guarded-by: _lock
 
@@ -203,6 +209,22 @@ class TimeSeriesStore:
         with self._lock:
             self._series.clear()
             self._scrapes = 0
+            self._collectors.clear()
+
+    # -- extra sample sources ----------------------------------------------
+
+    def register_collector(self, name: str, fn) -> None:
+        """``fn() -> iterable of (name, kind, value)`` entries folded
+        into every scrape beside the registry's own — histogram values
+        are ``(bounds, (counts tuple, sum, count))`` exactly like the
+        registry snapshot's. A collector that raises costs its entries
+        for that scrape, never the scrape."""
+        with self._lock:
+            self._collectors[name] = fn
+
+    def unregister_collector(self, name: str) -> None:
+        with self._lock:
+            self._collectors.pop(name, None)
 
     # -- scraping ----------------------------------------------------------
 
@@ -223,6 +245,18 @@ class TimeSeriesStore:
             batch.append(
                 (name, "histogram", (bounds, (tuple(counts), total, count)))
             )
+        # registered collectors run OUTSIDE our lock (a fleet
+        # aggregator's collect() performs bounded-timeout HTTP
+        # scrapes); each one's failure costs its entries, not the scrape
+        with self._lock:
+            collectors = list(self._collectors.items())
+        for collector_name, fn in collectors:
+            try:
+                batch.extend(fn() or ())
+            except Exception as exc:
+                log.with_fields(collector=collector_name).warning(
+                    f"tsdb collector failed: {exc}"
+                )
         with self._lock:
             downsample = self._downsample
             coarse_len = max(2, self._samples * 4 // max(1, downsample))
@@ -430,6 +464,11 @@ class TimeSeriesStore:
                     "p50": quantile(w_bounds, cumulative, d_count, 0.50),
                     "p95": quantile(w_bounds, cumulative, d_count, 0.95),
                     "p99": quantile(w_bounds, cumulative, d_count, 0.99),
+                    # the windowed CUMULATIVE bucket deltas themselves:
+                    # a fleet merge sums these across workers and
+                    # re-derives true fleet percentiles (averaging
+                    # per-worker p99s would be statistically wrong)
+                    "buckets": list(cumulative),
                 }
             if bounds is not None:
                 out["le"] = list(bounds)

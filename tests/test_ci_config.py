@@ -406,3 +406,66 @@ def test_circleci_runs_mirror_failover_smoke():
     )
     assert "test_multisource.py" in commands
     assert "test_primary_death_e2e_zero_dangling_multiparts" in commands
+
+
+def test_circleci_runs_fleet_debug_plane_smoke_and_artifact():
+    """The fleet debug plane's CI surface (ISSUE 15): the SIGKILL-
+    mid-multipart e2e (one stitched cross-worker trace) and the
+    wedged-worker fan-out budget proof run as a named step, and the
+    stitched trace JSON the e2e writes is uploaded as an artifact."""
+    yaml = pytest.importorskip("yaml")
+    ci = yaml.safe_load(CONFIG.read_text())
+    steps = ci["jobs"]["tests"]["steps"]
+    commands = " ".join(
+        s["run"]["command"]
+        for s in steps
+        if isinstance(s, dict) and "run" in s
+    )
+    assert (
+        "test_fleetplane.py::"
+        "test_e2e_fleet_debug_plane_sigkill_stitches_cross_worker_trace"
+        in commands
+    )
+    assert (
+        "test_fleetplane.py::"
+        "test_fanout_wedged_worker_costs_one_timeout_slice"
+        in commands
+    )
+    assert "FLEET_TRACE_ARTIFACT_DIR=/tmp/fleetplane" in commands
+    artifact_paths = [
+        s["store_artifacts"]["path"]
+        for s in steps
+        if isinstance(s, dict) and "store_artifacts" in s
+    ]
+    assert "/tmp/fleetplane" in artifact_paths
+
+
+def test_bench_digest_picks_up_fleet_scrape_arm():
+    """The fleet fan-out arm's contract numbers — healthy vs
+    one-wedged-worker wall time and the within-one-timeout verdict —
+    must survive into the digest line."""
+    import sys
+
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench_digest
+    finally:
+        sys.path.remove(str(REPO))
+
+    report = {
+        "value": 100.0,
+        "extra_metrics": [
+            {
+                "metric": "fleet_scrape",
+                "workers": 4,
+                "timeout_s": 0.5,
+                "healthy_ms": 2.1,
+                "wedged_ms": 503.0,
+                "within_one_timeout_budget": True,
+            }
+        ],
+    }
+    digest = bench_digest.digest_line(report)
+    assert digest["fleet_scrape_ms"] == 2.1
+    assert digest["fleet_scrape_wedged_ms"] == 503.0
+    assert digest["fleet_scrape_budget_ok"] is True

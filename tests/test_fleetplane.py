@@ -1,0 +1,1231 @@
+"""Fleet debug plane (daemon/fleetplane.py, ISSUE 15).
+
+Four layers:
+
+- pure merge semantics: the log k-way merge is stable under clock skew
+  between workers, the profile fold-sum preserves totals, lineage
+  stitching orders attempts and tags every span with its instance,
+  and the incident index merge tags owners;
+- stub-worker HTTP proofs: a wedged worker costs ONE scrape-timeout
+  slice (never the response), incident fetch-by-id routes to the
+  owning worker, fleet tsdb rates equal the sum of per-instance rates
+  with percentiles re-derived from summed bucket deltas, the
+  aggregator folds worker /metrics into fleet-summed TSDB series a
+  burn rule fires over (exemplars riding along), and a stale
+  federation source cannot poison /metrics/federate or hang it;
+- the tier-1 cost guard: SLO exemplar recording plus a LIVE fleet
+  aggregation loop stays under the 0.5 ms/job budget (same bar as the
+  watchdog/telemetry/profiler guards);
+- the e2e acceptance: 2 real ``serve()`` workers, one SIGKILLed
+  mid-multipart — the fleet ``/debug/trace?trace_id=`` serves ONE
+  stitched lineage spanning both instances, fleet ``/debug/tsdb``
+  rates equal the per-worker sum, and a tripped fleet burn rule
+  captures one cross-worker incident bundle naming the rule and
+  containing both workers' snapshots.
+"""
+
+import http.client
+import http.server
+import json
+import os
+import signal
+import socketserver
+import threading
+import time
+import urllib.parse
+
+import pytest
+
+from downloader_tpu.daemon.fleet import (
+    FleetConfig,
+    FleetHealthServer,
+    FleetSupervisor,
+)
+from downloader_tpu.daemon.fleetplane import (
+    FleetAggregator,
+    FleetQueryPlane,
+    fleet_alert_rules,
+    fleet_series,
+    instance_series,
+    parse_exposition_histograms,
+)
+from downloader_tpu.daemon.health import render_federated, render_metrics
+from downloader_tpu.queue.amqp_server import AmqpServerStub
+from downloader_tpu.store.credentials import Credentials
+from downloader_tpu.store.stub import S3Stub
+from downloader_tpu.utils import alerts, incident, metrics, profiling
+from downloader_tpu.utils import tracing, tsdb
+from downloader_tpu.utils.logging import merge_ring_records
+from downloader_tpu.wire import Convert, Download, Media
+
+CREDS = Credentials(access_key="ak", secret_key="sk")
+BUCKET = "plane-bkt"
+
+
+def _wait(predicate, timeout: float, what: str, interval: float = 0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(interval)
+    raise AssertionError(f"timed out after {timeout}s waiting for {what}")
+
+
+@pytest.fixture(autouse=True)
+def _plane_isolation():
+    yield
+    metrics.FEDERATION.reset()
+    metrics.GLOBAL.reset()
+    # fleet captures land in the process-wide flight recorder; a stale
+    # bundle must not satisfy a later suite's "was an incident
+    # captured" wait before its own capture lands
+    incident.RECORDER.reset()
+
+
+# -- pure merge semantics -----------------------------------------------------
+
+
+def test_log_merge_stable_under_clock_skew():
+    """A worker's records keep their own order no matter what its
+    clock says: the k-way merge only ever compares HEADS, so a skewed
+    (even regressing) per-worker clock can reorder the interleaving
+    but never the worker's own sequence."""
+    # worker-a's clock regresses mid-stream; worker-b sits 100s behind
+    by_instance = {
+        "worker-a": [
+            {"ts": 50.0, "msg": "a1"},
+            {"ts": 10.0, "msg": "a2"},  # clock jumped backward
+            {"ts": 60.0, "msg": "a3"},
+        ],
+        "worker-b": [
+            {"ts": 12.0, "msg": "b1"},
+            {"ts": 55.0, "msg": "b2"},
+        ],
+    }
+    merged = merge_ring_records(by_instance)
+    order_a = [r["msg"] for r in merged if r["instance"] == "worker-a"]
+    order_b = [r["msg"] for r in merged if r["instance"] == "worker-b"]
+    assert order_a == ["a1", "a2", "a3"], "worker-a's own order reordered"
+    assert order_b == ["b1", "b2"]
+    assert len(merged) == 5
+    assert all("instance" in r for r in merged)
+    # limit keeps the newest tail
+    assert [r["msg"] for r in merge_ring_records(by_instance, limit=2)] == [
+        r["msg"] for r in merged[-2:]
+    ]
+
+
+def test_profile_fold_sum_preserves_totals():
+    w0 = {"a;b;c": 10, "a;b;d": 4}
+    w1 = {"a;b;c": 7, "x;y": 5}
+    merged = profiling.merge_folded({"w0": w0, "w1": w1})
+    assert merged == {"a;b;c": 17, "a;b;d": 4, "x;y": 5}
+    assert sum(merged.values()) == sum(w0.values()) + sum(w1.values())
+    assert profiling.merge_folded({}) == {}
+    assert profiling.merge_folded({"w0": None}) == {}
+
+
+def test_stitch_lineage_orders_attempts_and_tags_every_span():
+    stitched = tracing.stitch_lineage(
+        "t" * 32,
+        {
+            "worker-1": [
+                {
+                    "attempt": 1,
+                    "wall_start": 200.0,
+                    "status": "ok",
+                    "spans": {
+                        "name": "job",
+                        "children": [{"name": "fetch"}],
+                    },
+                }
+            ],
+            "worker-0": [
+                {
+                    "attempt": 0,
+                    "wall_start": 100.0,
+                    "status": "retried",
+                    "spans": {"name": "job"},
+                }
+            ],
+        },
+    )
+    assert [a["attempt"] for a in stitched["attempts"]] == [0, 1]
+    assert [a["instance"] for a in stitched["attempts"]] == [
+        "worker-0", "worker-1",
+    ]
+    assert stitched["instances"] == ["worker-0", "worker-1"]
+    tree = stitched["attempts"][1]["spans"]
+    assert tree["instance"] == "worker-1"
+    assert tree["children"][0]["instance"] == "worker-1"
+
+
+def test_incident_index_merge_tags_owner():
+    merged = incident.merge_incident_indexes(
+        {
+            "worker-1": [{"id": "incident-20260804T000002-0001"}],
+            "worker-0": [{"id": "incident-20260804T000001-0001"}],
+            "fleet": [],
+        }
+    )
+    assert [e["id"] for e in merged] == [
+        "incident-20260804T000001-0001",
+        "incident-20260804T000002-0001",
+    ]
+    assert [e["instance"] for e in merged] == ["worker-0", "worker-1"]
+
+
+def test_parse_exposition_histograms_shapes():
+    text = "\n".join(
+        [
+            "# HELP downloader_slo_job_duration_seconds_bulk x",
+            "# TYPE downloader_slo_job_duration_seconds_bulk histogram",
+            'downloader_slo_job_duration_seconds_bulk_bucket{le="0.01"} 1',
+            'downloader_slo_job_duration_seconds_bulk_bucket{le="1"} 3',
+            'downloader_slo_job_duration_seconds_bulk_bucket{le="+Inf"} 4',
+            "downloader_slo_job_duration_seconds_bulk_sum 5.5",
+            "downloader_slo_job_duration_seconds_bulk_count 4",
+            "downloader_unrelated_total 9",
+            "garbage line",
+        ]
+    )
+    parsed = parse_exposition_histograms(text)
+    assert parsed == {
+        "slo_job_duration_seconds_bulk": ((0.01, 1.0), (1, 3), 5.5, 4)
+    }
+
+
+# -- stub workers over real HTTP ----------------------------------------------
+
+
+class _StubWorker:
+    """A fake worker health endpoint: ``routes`` maps a path (query
+    ignored) to (code, body, ctype) — mutable live — and paths in
+    ``wedge`` accept the request then hold until released (the wedged-
+    worker case the scrape budget must bound)."""
+
+    def __init__(self, routes=None, wedge=()):
+        stub = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _serve(self):
+                path = urllib.parse.urlsplit(self.path).path
+                if path in stub.wedge:
+                    stub.release.wait(30.0)
+                entry = stub.routes.get(path)
+                if entry is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                code, body, ctype = entry
+                if isinstance(body, str):
+                    body = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = _serve
+            do_POST = _serve
+
+        self.routes = dict(routes or {})
+        self.wedge = set(wedge)
+        self.release = threading.Event()
+        self._server = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", 0), Handler
+        )
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.release.set()
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def _json_route(payload):
+    return (200, json.dumps(payload), "application/json")
+
+
+def test_fanout_wedged_worker_costs_one_timeout_slice():
+    """ISSUE 15 bench bar as a test: with one wedged worker in the
+    fleet, the fan-out returns within ~one scrape-timeout budget, the
+    healthy workers' data is served, and the wedged one degrades to a
+    counted error entry."""
+    logs = _json_route({"records": [{"ts": 1.0, "msg": "healthy"}]})
+    with _StubWorker({"/debug/logs": logs}) as healthy, _StubWorker(
+        {"/debug/logs": logs}, wedge={"/debug/logs"}
+    ) as wedged:
+        plane = FleetQueryPlane(
+            lambda: [("worker-0", healthy.port), ("worker-1", wedged.port)],
+            timeout_s=0.4,
+        )
+        before = metrics.GLOBAL.snapshot().get("fleet_scrape_failures", 0)
+        started = time.monotonic()
+        code, body, _ = plane.debug_logs()
+        wall = time.monotonic() - started
+        assert code == 200
+        assert wall < 2.0, f"fan-out took {wall:.2f}s with one wedged worker"
+        payload = json.loads(body)
+        assert [r["instance"] for r in payload["records"]] == ["worker-0"]
+        assert "worker-1" in payload["errors"]
+        after = metrics.GLOBAL.snapshot().get("fleet_scrape_failures", 0)
+        assert after > before
+
+
+def test_incident_fetch_by_id_routes_to_owning_worker():
+    bundle_0 = {"id": "incident-20260804T000001-0001", "reason": "w0"}
+    bundle_1 = {"id": "incident-20260804T000002-0001", "reason": "w1"}
+    with _StubWorker(
+        {
+            "/debug/incidents": _json_route(
+                {"incidents": [{"id": bundle_0["id"]}]}
+            ),
+            f"/debug/incidents/{bundle_0['id']}": _json_route(bundle_0),
+        }
+    ) as w0, _StubWorker(
+        {
+            "/debug/incidents": _json_route(
+                {"incidents": [{"id": bundle_1["id"]}]}
+            ),
+            f"/debug/incidents/{bundle_1['id']}": _json_route(bundle_1),
+        }
+    ) as w1:
+        plane = FleetQueryPlane(
+            lambda: [("worker-0", w0.port), ("worker-1", w1.port)],
+            timeout_s=1.0,
+        )
+        code, body, _ = plane.debug_incidents()
+        assert code == 200
+        index = json.loads(body)["incidents"]
+        owners = {e["id"]: e["instance"] for e in index}
+        assert owners[bundle_0["id"]] == "worker-0"
+        assert owners[bundle_1["id"]] == "worker-1"
+        # fetch-by-id lands on the owner, tagged
+        code, body, _ = plane.debug_incident(bundle_1["id"])
+        assert code == 200
+        served = json.loads(body)
+        assert served["instance"] == "worker-1"
+        assert served["reason"] == "w1"
+        code, _, _ = plane.debug_incident("incident-nope")
+        assert code == 404
+
+
+def test_tsdb_fleet_rate_is_sum_and_percentiles_from_summed_buckets():
+    le = [0.1, 1.0, 5.0]
+    counter_0 = {
+        "name": "tsdb_scrapes", "kind": "counter", "window_s": 60.0,
+        "points": [], "rate_per_s": 2.0,
+    }
+    counter_1 = dict(counter_0, rate_per_s=3.5)
+    hist_0 = {
+        "name": "h", "kind": "histogram", "window_s": 60.0, "le": le,
+        "points": [],
+        "window": {"count": 2, "sum": 0.3, "p99": 0.2, "buckets": [1, 2, 2]},
+    }
+    hist_1 = {
+        "name": "h", "kind": "histogram", "window_s": 60.0, "le": le,
+        "points": [],
+        "window": {"count": 5, "sum": 9.0, "p99": 4.0, "buckets": [0, 3, 5]},
+    }
+    with _StubWorker() as w0, _StubWorker() as w1:
+        plane = FleetQueryPlane(
+            lambda: [("worker-0", w0.port), ("worker-1", w1.port)],
+            timeout_s=1.0,
+        )
+        w0.routes["/debug/tsdb"] = _json_route(counter_0)
+        w1.routes["/debug/tsdb"] = _json_route(counter_1)
+        code, body, _ = plane.debug_tsdb({"name": ["tsdb_scrapes"]})
+        assert code == 200
+        payload = json.loads(body)
+        assert payload["rates"] == {"worker-0": 2.0, "worker-1": 3.5}
+        assert payload["rate_per_s"] == pytest.approx(
+            sum(payload["rates"].values())
+        )
+        w0.routes["/debug/tsdb"] = _json_route(hist_0)
+        w1.routes["/debug/tsdb"] = _json_route(hist_1)
+        code, body, _ = plane.debug_tsdb({"name": ["h"]})
+        payload = json.loads(body)
+        window = payload["window"]
+        assert window["buckets"] == [1, 5, 7]
+        assert window["count"] == 7
+        assert window["sum"] == pytest.approx(9.3)
+        expected_p99 = tsdb.quantile(tuple(le), [1, 5, 7], 7, 0.99)
+        assert window["p99"] == pytest.approx(expected_p99)
+        assert payload["per_instance"]["worker-0"]["count"] == 2
+        # a series nobody serves is a 404, not an empty merge
+        w0.routes.pop("/debug/tsdb")
+        w1.routes.pop("/debug/tsdb")
+        code, _, _ = plane.debug_tsdb({"name": ["gone"]})
+        assert code == 404
+
+
+def _exposition(count_below_001, count_below_1, count, total):
+    name = "downloader_slo_job_duration_seconds_bulk"
+    return "\n".join(
+        [
+            f"# HELP {name} x",
+            f"# TYPE {name} histogram",
+            f'{name}_bucket{{le="0.01"}} {count_below_001}',
+            f'{name}_bucket{{le="1"}} {count_below_1}',
+            f'{name}_bucket{{le="+Inf"}} {count}',
+            f"{name}_sum {total}",
+            f"{name}_count {count}",
+            "",
+        ]
+    )
+
+
+def test_aggregator_sums_worker_histograms_and_burn_rule_fires():
+    """The supervisor-side loop end to end (no processes): worker
+    /metrics expositions fold into fleet-summed + per-instance TSDB
+    series; the fleet burn rule fires on the SUM; its detail carries
+    instance-tagged worker exemplars; the outlier rule names the slow
+    instance."""
+    trace_id = "ab" * 16
+    exemplars = _json_route(
+        {
+            "exemplars": {
+                "slo_job_duration_seconds_bulk": [
+                    {"trace_id": trace_id, "value": 8.0, "ts": 1.0}
+                ]
+            }
+        }
+    )
+    with _StubWorker() as w0, _StubWorker() as w1:
+        for stub in (w0, w1):
+            stub.routes["/metrics"] = (
+                200, _exposition(0, 0, 0, 0.0), "text/plain"
+            )
+            stub.routes["/debug/exemplars"] = exemplars
+        plane = FleetQueryPlane(
+            lambda: [("worker-0", w0.port), ("worker-1", w1.port)],
+            timeout_s=1.0,
+        )
+        store = tsdb.TimeSeriesStore(interval_s=0)  # sampled by hand
+        aggregator = FleetAggregator(plane, store=store)
+        store.register_collector("fleet", aggregator.collect)
+        t0 = time.time()
+        store.sample(now=t0)  # zero baseline
+        # worker-0 stays fast (20 sub-10ms jobs); worker-1 blows the
+        # target on every one of its 20
+        w0.routes["/metrics"] = (
+            200, _exposition(20, 20, 20, 0.1), "text/plain"
+        )
+        w1.routes["/metrics"] = (
+            200, _exposition(0, 0, 20, 160.0), "text/plain"
+        )
+        store.sample(now=t0 + 5)
+        store.sample(now=t0 + 10)
+        series = fleet_series("slo_job_duration_seconds_bulk")
+        window = store.histogram_window(series, 60.0, now=t0 + 10)
+        assert window is not None
+        _, cumulative, _, count = window
+        assert count == 40  # fleet-summed delta
+        assert store.histogram_window(
+            instance_series("slo_job_duration_seconds_bulk", "worker-1"),
+            60.0,
+            now=t0 + 10,
+            min_samples=2,
+        ) is not None
+        rules = fleet_alert_rules(
+            aggregator,
+            slo_bulk_s=0.05,
+            objective=0.9,
+            fast_window_s=60.0,
+            slow_window_s=120.0,
+            factor=1.2,
+            outlier_ratio=3.0,
+        )
+        engine = alerts.AlertEngine(
+            rules=rules, interval_s=0, store=store
+        )
+        # on_fire stub: this unit asserts the VERDICT, not the capture
+        # hand-off (the e2e owns that); the default local capture would
+        # drop a stray bundle into the global flight recorder
+        engine.configure(
+            exemplar_source=aggregator.exemplars_for,
+            on_fire=lambda rule: None,
+        )
+        fired = engine.evaluate(now=t0 + 10)
+        names = {rule.name for rule in fired}
+        assert "fleet-bulk-latency-burn" in names
+        burn = next(r for r in fired if r.name == "fleet-bulk-latency-burn")
+        assert any(
+            e.get("trace_id") == trace_id and e.get("instance")
+            for e in burn.last_detail.get("exemplars", [])
+        ), "fleet burn detail does not link worker exemplars"
+        # the outlier rule names worker-1 (its p99 is ~8s against a
+        # fleet median dragged down by worker-0's sub-10ms jobs)
+        outlier = next(
+            r for r in engine.rules()
+            if r.name == "fleet-worker-latency-outlier"
+        )
+        assert outlier.state in ("pending", "firing") or (
+            outlier.last_detail.get("instance") == "worker-1"
+        )
+        assert outlier.last_detail.get("instance") == "worker-1"
+        engine.reset()
+        store.reset()
+
+
+def test_aggregator_fleet_totals_survive_worker_death():
+    """The fleet series must be MONOTONIC (review finding): summing the
+    LIVE workers' cumulative histograms would drop when a worker dies,
+    and the tsdb window's >=0 clamp would then read the delta as zero
+    across the very SIGKILL window the fleet burn rules page on. The
+    aggregator folds per-instance INCREASES into running totals, so a
+    death never lowers the fleet series and the survivor's fresh
+    completions still register."""
+    with _StubWorker() as w0, _StubWorker() as w1:
+        w0.routes["/metrics"] = (200, _exposition(0, 10, 10, 5.0), "text/plain")
+        w1.routes["/metrics"] = (200, _exposition(0, 10, 10, 5.0), "text/plain")
+        members = [("worker-0", w0.port), ("worker-1", w1.port)]
+        plane = FleetQueryPlane(lambda: list(members), timeout_s=1.0)
+        store = tsdb.TimeSeriesStore(interval_s=0)
+        aggregator = FleetAggregator(plane, store=store)
+        store.register_collector("fleet", aggregator.collect)
+        t0 = time.time()
+        store.sample(now=t0)
+        series = fleet_series("slo_job_duration_seconds_bulk")
+        window = store.histogram_window(series, 600.0, now=t0)
+        assert window is not None and window[3] == 20
+        # worker-1 dies: the fleet series must hold (the buggy
+        # sum-of-live-cumulatives would DROP 20 -> 10 here, and the
+        # window clamp would then hide the survivor's next completions)
+        members.remove(("worker-1", w1.port))
+        store.sample(now=t0 + 5)
+        window = store.histogram_window(
+            series, 600.0, now=t0 + 5, min_samples=2
+        )
+        assert window[3] == 0, "fleet series moved on a death alone"
+        # the survivor keeps completing slow jobs: the window delta
+        # registers them despite the death in the middle
+        w0.routes["/metrics"] = (200, _exposition(0, 12, 15, 9.0), "text/plain")
+        store.sample(now=t0 + 10)
+        window = store.histogram_window(
+            series, 600.0, now=t0 + 10, min_samples=2
+        )
+        assert window[3] == 5, "survivor completions lost after a death"
+        # the restarted worker re-counts from zero: counted in full,
+        # never negative
+        w1.routes["/metrics"] = (200, _exposition(0, 2, 3, 1.0), "text/plain")
+        members.append(("worker-1", w1.port))
+        store.sample(now=t0 + 15)
+        window = store.histogram_window(
+            series, 600.0, now=t0 + 15, min_samples=2
+        )
+        assert window[3] == 8
+        store.reset()
+
+
+def test_worker_outlier_rule_unit():
+    rule = alerts.WorkerOutlierRule(
+        "outlier", "series", provider=lambda: {"w0": 0.1, "w1": 2.0},
+        ratio=4.0, min_value=0.05,
+    )
+    view = alerts.RegistryView(tsdb.TimeSeriesStore(interval_s=0))
+    breached, detail = rule._condition(view, time.time())
+    assert breached and detail["instance"] == "w1"
+    # one reporting instance: no fleet to be an outlier of
+    rule._provider = lambda: {"w0": 9.0, "w1": None}
+    breached, _ = rule._condition(view, time.time())
+    assert not breached
+    # everyone equally slow is a burn problem, not an outlier
+    rule._provider = lambda: {"w0": 2.0, "w1": 2.1}
+    breached, _ = rule._condition(view, time.time())
+    assert not breached
+
+
+def test_stale_federation_source_cannot_poison_or_hang_federate():
+    """ISSUE 15 satellite: a wedged (or dead) child source costs its
+    samples, a federate_source_errors + fleet_scrape_failures bump,
+    and at most one scrape-timeout slice — never the render. A reaped
+    worker's source deregisters entirely."""
+    with _StubWorker(wedge={"/metrics"}) as wedged:
+        supervisor = FleetSupervisor(
+            FleetConfig(workers=1, scrape_timeout_s=0.3)
+        )
+        slot = supervisor._slots[0]
+        with supervisor._lock:
+            slot.health_port = wedged.port
+        supervisor._register_federation(slot)
+        assert "worker-0" in metrics.FEDERATION.sources()
+        before_scrape = metrics.GLOBAL.snapshot().get(
+            "fleet_scrape_failures", 0
+        )
+        before_fed = metrics.GLOBAL.snapshot().get(
+            "federate_source_errors", 0
+        )
+        started = time.monotonic()
+        body = render_federated(render_metrics()).decode()
+        wall = time.monotonic() - started
+        assert wall < 2.0, f"wedged source hung the render {wall:.2f}s"
+        assert "downloader_fleet_workers_target" in body
+        counters = metrics.GLOBAL.snapshot()
+        assert counters.get("fleet_scrape_failures", 0) > before_scrape
+        assert counters.get("federate_source_errors", 0) > before_fed
+        # retiring the handle deregisters the source
+        from downloader_tpu.daemon.fleet import WorkerHandle
+
+        handle = WorkerHandle("worker-0", ["true"], {})
+        supervisor._retire_handle(handle)
+        assert "worker-0" not in metrics.FEDERATION.sources()
+
+
+def test_exemplars_recorded_bounded_and_served():
+    metrics.GLOBAL.reset()
+    for i in range(10):
+        metrics.GLOBAL.observe(
+            "slo_job_duration_seconds_bulk", 0.1, exemplar=f"{i:032x}"
+        )
+    exemplars = metrics.GLOBAL.exemplars("slo_job_duration_seconds_bulk")
+    assert len(exemplars) == metrics.EXEMPLARS_PER_FAMILY
+    assert exemplars[-1]["trace_id"] == f"{9:032x}"
+    snapshot = metrics.GLOBAL.exemplars_snapshot()
+    assert "slo_job_duration_seconds_bulk" in snapshot
+    # no exemplar, no storage
+    metrics.GLOBAL.observe("job_duration_seconds", 0.1)
+    assert metrics.GLOBAL.exemplars("job_duration_seconds") == []
+
+
+# -- the cost guard -----------------------------------------------------------
+
+
+def test_exemplar_and_aggregation_overhead_bounded():
+    """ISSUE 15 bench satellite's tier-1 half: a job recording its SLO
+    observation WITH an exemplar, while a live fleet aggregation loop
+    (TSDB scraping two real stub workers through the fan-out plane)
+    runs in the background, must cost <= 0.5 ms at the median — the
+    same bar the watchdog/telemetry/profiler guards pin. The fleet
+    plane's whole design is that aggregation rides the supervisor's
+    scrape thread, NOT the job path; this guard is the proof."""
+    body = _exposition(5, 10, 12, 4.0)
+    with _StubWorker({"/metrics": (200, body, "text/plain")}) as w0, (
+        _StubWorker({"/metrics": (200, body, "text/plain")})
+    ) as w1:
+        plane = FleetQueryPlane(
+            lambda: [("worker-0", w0.port), ("worker-1", w1.port)],
+            timeout_s=0.5,
+        )
+        store = tsdb.TimeSeriesStore(interval_s=0.05)
+        aggregator = FleetAggregator(plane, store=store)
+        store.register_collector("fleet", aggregator.collect)
+        store.start()
+        inbound = tracing.TraceContext.mint()
+
+        def one_job(i: int) -> None:
+            with tracing.TRACER.job(f"guard-{i}", context=inbound) as root:
+                with tracing.span("fetch"):
+                    pass
+                root.set_status("ok")
+                metrics.GLOBAL.observe(
+                    "slo_job_duration_seconds_bulk",
+                    0.01,
+                    exemplar=root.trace_id,
+                )
+
+        try:
+            deadline = time.monotonic() + 30.0
+            while True:
+                one_job(0)  # warm
+                laps = []
+                for i in range(200):
+                    started = time.perf_counter()
+                    one_job(i)
+                    laps.append(time.perf_counter() - started)
+                laps.sort()
+                median_ms = laps[len(laps) // 2] * 1000
+                if median_ms < 0.5:
+                    break
+                # a noisy 1-vCPU host can blow any budget; the guard
+                # asks whether the plane CAN hit it — remeasure
+                assert time.monotonic() < deadline, (
+                    f"exemplars + fleet aggregation cost {median_ms:.3f} "
+                    "ms/job — over the 0.5 ms budget (ISSUE 15)"
+                )
+        finally:
+            store.reset()
+            tracing.TRACER.clear()
+
+
+# -- e2e: 2 real workers, SIGKILL mid-multipart, stitched trace ---------------
+
+
+class _FleetOrigin:
+    """HTTP origin whose per-path behavior the test drives live:
+    ``404`` paths refuse GETs (HEAD still announces the size, so the
+    probe admits the job), ``wedge`` paths stream a first chunk then
+    hold until released (completing on release), ``serve`` paths
+    stream at a byte-rate throttle."""
+
+    def __init__(self, objects):
+        origin = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def do_HEAD(self):
+                payload = origin.objects.get(self.path)
+                if payload is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.send_header("Accept-Ranges", "bytes")
+                self.end_headers()
+
+            def do_GET(self):
+                payload = origin.objects.get(self.path)
+                mode = origin.modes.get(self.path, "serve")
+                with origin.lock:
+                    origin.gets[self.path] = origin.gets.get(self.path, 0) + 1
+                if payload is None or mode == "404":
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                try:
+                    if mode == "wedge":
+                        first = payload[:1024]
+                        self.wfile.write(first)
+                        self.wfile.flush()
+                        origin.releases[self.path].wait(240.0)
+                        self.wfile.write(payload[1024:])
+                        return
+                    rate = origin.rates.get(self.path, 0.0)
+                    chunk = 64 * 1024
+                    for offset in range(0, len(payload), chunk):
+                        piece = payload[offset:offset + chunk]
+                        self.wfile.write(piece)
+                        self.wfile.flush()
+                        if rate > 0:
+                            time.sleep(len(piece) / rate)
+                except OSError:
+                    return
+
+        self.objects = dict(objects)
+        self.modes = {}
+        self.rates = {}
+        self.releases = {path: threading.Event() for path in objects}
+        self.gets = {}
+        self.lock = threading.Lock()
+        self._server = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", 0), Handler
+        )
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def get_count(self, path: str) -> int:
+        with self.lock:
+            return self.gets.get(path, 0)
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        for event in self.releases.values():
+            event.set()
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def _worker_env(broker, s3, base_dir, **extra):
+    env = {
+        "BROKER": "amqp",
+        "RABBITMQ_ENDPOINT": broker.endpoint,
+        "RABBITMQ_USERNAME": "",
+        "RABBITMQ_PASSWORD": "",
+        "S3_ENDPOINT": f"http://{s3.endpoint}",
+        "S3_ACCESS_KEY": CREDS.access_key,
+        "S3_SECRET_KEY": CREDS.secret_key,
+        "BUCKET": BUCKET,
+        "DOWNLOAD_DIR": base_dir,
+        "JOB_CONCURRENCY": "1",
+        "PREFETCH": "1",
+        "BATCH_JOBS": "1",
+        "HTTP_SEGMENTS": "1",
+        "S3_MULTIPART_THRESHOLD": str(128 * 1024),
+        "S3_PART_SIZE": str(128 * 1024),
+        "PROFILE": "0",
+        "TSDB_INTERVAL": "0.3",
+        "ALERT_INTERVAL": "off",
+        "LSD": "off",
+        "DHT_BOOTSTRAP": "off",
+        "WATCHDOG_STALL_S": "600",
+        "MAX_JOB_RETRIES": "50",
+        "RETRY_DELAY": "0.3",
+        "RETRY_DELAY_CAP": "1.0",
+        "PUBLISH_CONFIRM_TIMEOUT": "10",
+        "FAILPOINT_SPEC": "",
+        "LOG_LEVEL": "info",
+    }
+    env.update(extra)
+    return env
+
+
+def _declare_topology(channel, topic):
+    channel.declare_exchange(topic)
+    for index in range(2):
+        name = f"{topic}-{index}"
+        channel.declare_queue(name)
+        channel.bind_queue(name, topic, name)
+
+
+def _publish_job(broker, media_id, url):
+    context = tracing.TraceContext.mint()
+    connection = broker.broker.connect()
+    try:
+        channel = connection.channel()
+        _declare_topology(channel, "v1.download")
+        channel.publish(
+            "v1.download",
+            "v1.download-0",
+            Download(media=Media(id=media_id, source_uri=url)).marshal(),
+            headers={
+                tracing.TRACE_CONTEXT_HEADER: context.header_value()
+            },
+            persistent=True,
+        )
+        channel.close()
+    finally:
+        connection.close()
+    return context
+
+
+class _ConvertSink:
+    def __init__(self, broker):
+        self.received = []
+        self._lock = threading.Lock()
+        self._connection = broker.broker.connect()
+        channel = self._connection.channel()
+        channel.set_prefetch(100)
+        _declare_topology(channel, "v1.convert")
+
+        def on_message(message, ch=channel):
+            convert = Convert.unmarshal(message.body)
+            context = tracing.TraceContext.parse(
+                message.headers.get(tracing.TRACE_CONTEXT_HEADER)
+            )
+            with self._lock:
+                self.received.append(
+                    (
+                        convert.media.id if convert.media else "",
+                        context.trace_id if context else "",
+                    )
+                )
+            ch.ack(message.delivery_tag)
+
+        for index in range(2):
+            channel.consume(f"v1.convert-{index}", on_message)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self.received)
+
+    def close(self):
+        self._connection.close()
+
+
+def _fleet_get(port: int, path: str, timeout: float = 10.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        response = conn.getresponse()
+        return response.status, response.read()
+    finally:
+        conn.close()
+
+
+def _in_flight_jobs(port: int) -> set:
+    try:
+        status, body = _fleet_get(port, "/debug/jobs", timeout=2.0)
+        if status != 200:
+            return set()
+        payload = json.loads(body)
+    except Exception:
+        return set()
+    return {
+        t.get("job_id") for t in payload.get("in_flight", []) if t.get("job_id")
+    }
+
+
+
+
+def _worker_lineage(port: int, trace_id: str) -> list:
+    try:
+        status, body = _fleet_get(
+            port, f"/debug/trace?trace_id={trace_id}", timeout=2.0
+        )
+        if status != 200:
+            return []
+        return json.loads(body).get("attempts") or []
+    except Exception:
+        return []
+
+
+def test_e2e_fleet_debug_plane_sigkill_stitches_cross_worker_trace(tmp_path):
+    """The ISSUE 15 acceptance walk, robust to broker placement: retry
+    republishes re-shard the topic, so no FIFO choreography can pin
+    which worker takes which attempt — instead the scenario LOOPS
+    until the interesting distribution exists, which redelivery
+    randomness can only delay, never prevent.
+
+    1. The stitch origin WEDGES every GET; the workers' 2 s stall
+       watchdog cancels each wedged attempt into the retry path, so
+       attempts of ONE logical trace ping-pong across the fleet until
+       BOTH instances hold retried attempts in their rings.
+    2. The origin flips to a throttled stream; mid-multipart the
+       streaming worker is SIGKILLed (the origin flips back to wedge
+       first, so nothing can complete during the restart window).
+    3. The supervisor restarts the dead worker; the wedge/cancel
+       ping-pong resumes until the RESTARTED instance holds an
+       attempt again (its pre-kill ring died with it).
+    4. The origin serves for real: the job completes under the
+       ORIGINAL trace id, the dead worker's multipart orphan is
+       reclaimed, and the fleet /debug/trace?trace_id= stitches ONE
+       lineage spanning BOTH instances, every span instance-tagged.
+    5. Fleet /debug/tsdb rates equal the per-worker sum; the fleet
+       burn rule over the AGGREGATED SLO histograms trips on fresh
+       slow completions and captures one cross-worker incident
+       naming the rule and containing both workers' snapshots.
+    """
+    stitch_payload = os.urandom(1536 * 1024)
+    objects = {
+        "/stitch.mp4": stitch_payload,
+        # a second wedge-cycling job: with two hot traces in flight the
+        # survivor's per-shard windows are routinely BOTH occupied at
+        # republish time, so the broker's first-consumer-with-capacity
+        # rule must hand attempts to the other (restarted) worker —
+        # without it, a single cycling job's republishes deterministically
+        # starve a worker whose consumers re-registered last
+        "/decoy.bin": os.urandom(256 * 1024),
+        "/coda0.bin": os.urandom(96 * 1024),
+        "/coda1.bin": os.urandom(96 * 1024),
+    }
+    with S3Stub(CREDS) as s3, AmqpServerStub() as broker, _FleetOrigin(
+        objects
+    ) as origin:
+        origin.modes["/stitch.mp4"] = "wedge"
+        origin.modes["/decoy.bin"] = "wedge"
+        origin.rates["/stitch.mp4"] = 300 * 1024
+        origin.rates["/decoy.bin"] = 128 * 1024
+        origin.rates["/coda0.bin"] = 64 * 1024
+        origin.rates["/coda1.bin"] = 64 * 1024
+        supervisor = FleetSupervisor(
+            FleetConfig(
+                workers=2,
+                heartbeat_s=0.2,
+                stall_s=3.0,
+                publisher_down_s=30.0,
+                restart_backoff_s=0.1,
+                restart_backoff_cap_s=0.5,
+                start_grace_s=40.0,
+                drain_s=10.0,
+                scrape_timeout_s=2.0,
+            ),
+            worker_env=_worker_env(
+                broker,
+                s3,
+                str(tmp_path),
+                WATCHDOG_STALL_S="2",
+                WATCHDOG_ACTION="cancel",
+                MAX_JOB_RETRIES="200",
+            ),
+        )
+        sink = None
+        store = tsdb.TimeSeriesStore(interval_s=0.25)
+        engine = alerts.AlertEngine(interval_s=0.25, store=store)
+        saved_interval = incident.RECORDER.min_auto_interval
+        incident.RECORDER.min_auto_interval = 0.0
+        pre_existing = {
+            b["id"] for b in incident.RECORDER.list_incidents()
+        }
+
+        def ports_now() -> dict:
+            return {
+                s["instance"]: s["health_port"]
+                for s in supervisor.snapshot()["slots"]
+            }
+
+        try:
+            supervisor.start()
+            _wait(
+                lambda: all(
+                    s["ready"] for s in supervisor.snapshot()["slots"]
+                ),
+                60.0,
+                "both real workers ready",
+            )
+            instances = sorted(ports_now())
+            # supervisor-side fleet aggregation starts NOW so the burn
+            # windows get a zero baseline before any job completes
+            plane = FleetQueryPlane(
+                supervisor.ready_workers, timeout_s=2.0, engine=engine
+            )
+            aggregator = FleetAggregator(plane, store=store)
+            store.register_collector("fleet", aggregator.collect)
+            store.start()
+            sink = _ConvertSink(broker)
+
+            # 1. wedge/cancel ping-pong until BOTH instances hold
+            # attempts of the one trace (the decoy keeps both workers'
+            # windows contended so attempts spread across the fleet)
+            context = _publish_job(
+                broker, "stitch-1", f"{origin.url}/stitch.mp4"
+            )
+            _publish_job(broker, "decoy-1", f"{origin.url}/decoy.bin")
+            _wait(
+                lambda: all(
+                    _worker_lineage(port, context.trace_id)
+                    for port in ports_now().values()
+                ),
+                120.0,
+                "attempts of the one trace on BOTH instances",
+                interval=0.2,
+            )
+
+            # 2. stream, then SIGKILL mid-multipart (wedge re-armed
+            # first so nothing completes during the restart window)
+            origin.modes["/stitch.mp4"] = "serve"
+            victim = _wait(
+                lambda: (
+                    s3.list_multipart_uploads()
+                    and [
+                        inst
+                        for inst, port in ports_now().items()
+                        if "stitch-1" in _in_flight_jobs(port)
+                    ]
+                ),
+                60.0,
+                "a worker streaming the stitch job mid-multipart",
+                interval=0.1,
+            )[0]
+            origin.modes["/stitch.mp4"] = "wedge"
+            victim_pid = next(
+                s["pid"]
+                for s in supervisor.snapshot()["slots"]
+                if s["instance"] == victim
+            )
+            os.kill(victim_pid, signal.SIGKILL)
+
+            # 3. restart + ping-pong until the RESTARTED instance holds
+            # an attempt again (its pre-kill ring died with it)
+            _wait(
+                lambda: all(
+                    s["ready"] and s["pid"] and s["pid"] != victim_pid
+                    or s["instance"] != victim
+                    for s in supervisor.snapshot()["slots"]
+                )
+                and all(
+                    s["ready"] for s in supervisor.snapshot()["slots"]
+                ),
+                60.0,
+                "the killed worker to restart and heartbeat",
+            )
+            _wait(
+                lambda: _worker_lineage(
+                    ports_now().get(victim, 0), context.trace_id
+                ),
+                120.0,
+                "the restarted instance to hold an attempt again",
+                interval=0.2,
+            )
+
+            # 4. serve for real: completion under the ORIGINAL id (the
+            # decoy unwedges too, so the fleet drains clean)
+            origin.modes["/stitch.mp4"] = "serve"
+            origin.modes["/decoy.bin"] = "serve"
+            _wait(
+                lambda: ("stitch-1", context.trace_id) in sink.snapshot(),
+                120.0,
+                "the stitch job to complete under the original trace id",
+            )
+            foreign = [
+                entry
+                for entry in sink.snapshot()
+                if entry[0] == "stitch-1" and entry[1] != context.trace_id
+            ]
+            assert not foreign, f"foreign trace ids: {foreign}"
+            assert stitch_payload in s3.buckets.get(BUCKET, {}).values()
+            # the dead worker's multipart orphan was reclaimed: zero
+            # dangling is a FLEET invariant, not a process one
+            _wait(
+                lambda: not s3.list_multipart_uploads(),
+                30.0,
+                "the SIGKILLed worker's multipart orphan to be reclaimed",
+            )
+
+            # 5. the fleet debug plane over real HTTP
+            health = FleetHealthServer(supervisor, 0, "127.0.0.1").start()
+            try:
+                started = time.monotonic()
+                status, body = _fleet_get(
+                    health.port,
+                    f"/debug/trace?trace_id={context.trace_id}",
+                )
+                fanout_wall = time.monotonic() - started
+                assert status == 200
+                stitched = json.loads(body)
+                seen = {a["instance"] for a in stitched["attempts"]}
+                assert seen == set(instances), (
+                    f"stitched lineage spans {seen}, want {instances}"
+                )
+                assert any(
+                    a["status"] == "ok" for a in stitched["attempts"]
+                ), "no completed attempt in the stitched lineage"
+                assert any(
+                    a["status"] in ("retried", "requeued")
+                    for a in stitched["attempts"]
+                ), "no retried attempt in the stitched lineage"
+                ordinals = [a["attempt"] for a in stitched["attempts"]]
+                assert ordinals == sorted(ordinals)
+                for attempt in stitched["attempts"]:
+                    assert attempt["spans"]["instance"] == (
+                        attempt["instance"]
+                    ), "span tree not tagged with its instance"
+                # concurrent fan-out: ~one scrape budget, not N
+                assert fanout_wall < 6.0, (
+                    f"fleet trace fan-out took {fanout_wall:.1f}s"
+                )
+                if os.environ.get("FLEET_TRACE_ARTIFACT_DIR"):
+                    out_dir = os.environ["FLEET_TRACE_ARTIFACT_DIR"]
+                    os.makedirs(out_dir, exist_ok=True)
+                    with open(
+                        os.path.join(out_dir, "stitched-trace.json"), "w"
+                    ) as artifact:
+                        json.dump(stitched, artifact, indent=1)
+
+                # 6. fleet tsdb: rate == sum of per-instance rates
+                def fleet_rate():
+                    status, body = _fleet_get(
+                        health.port,
+                        "/debug/tsdb?name=tsdb_scrapes&window=120",
+                    )
+                    if status != 200:
+                        return None
+                    payload = json.loads(body)
+                    measured = [
+                        r
+                        for r in payload.get("rates", {}).values()
+                        if r is not None
+                    ]
+                    if len(measured) != 2 or not payload.get("rate_per_s"):
+                        return None
+                    return payload
+
+                payload = _wait(
+                    fleet_rate,
+                    60.0,
+                    "both workers' tsdb rates to be measurable",
+                )
+                measured = [
+                    r for r in payload["rates"].values() if r is not None
+                ]
+                assert payload["rate_per_s"] == pytest.approx(
+                    sum(measured)
+                )
+                assert payload["rate_per_s"] > 0
+
+                # 7. fleet burn over the AGGREGATED SLO sums: two fresh
+                # slow codas land right before the evaluation, so the
+                # windows are guaranteed an in-window delta even when
+                # the earlier waits ran long
+                _publish_job(broker, "coda-0", f"{origin.url}/coda0.bin")
+                _publish_job(broker, "coda-1", f"{origin.url}/coda1.bin")
+                _wait(
+                    lambda: {
+                        media for media, _ in sink.snapshot()
+                    } >= {"coda-0", "coda-1"},
+                    60.0,
+                    "the coda jobs to complete",
+                )
+                engine.configure(
+                    rules=fleet_alert_rules(
+                        aggregator,
+                        slo_interactive_s=0.05,
+                        slo_bulk_s=0.05,
+                        objective=0.9,
+                        fast_window_s=30.0,
+                        slow_window_s=60.0,
+                        factor=1.2,
+                    ),
+                    on_fire=plane.alert_fired,
+                    exemplar_source=aggregator.exemplars_for,
+                )
+                engine.start()
+
+                def fleet_bundle():
+                    for summary in incident.RECORDER.list_incidents():
+                        if summary["id"] in pre_existing:
+                            continue
+                        bundle = incident.RECORDER.get(summary["id"])
+                        if (
+                            bundle
+                            and bundle.get("trigger") == "fleet-alert"
+                        ):
+                            return bundle
+                    return None
+
+                bundle = _wait(
+                    fleet_bundle,
+                    60.0,
+                    "a fleet burn rule to fire and capture a "
+                    "cross-worker incident",
+                )
+                extra = bundle.get("extra", {})
+                assert str(extra.get("rule", "")).startswith("fleet-"), (
+                    f"bundle does not name the fleet rule: {extra}"
+                )
+                workers = extra.get("workers", {})
+                assert set(workers) == set(instances), (
+                    f"bundle spans {set(workers)}, want {instances}"
+                )
+                for instance, snapshot in workers.items():
+                    assert "threads" in snapshot, (
+                        f"{instance}'s snapshot is not a full bundle: "
+                        f"{list(snapshot)[:5]}"
+                    )
+            finally:
+                health.stop()
+        finally:
+            for event in origin.releases.values():
+                event.set()
+            engine.reset()
+            store.reset()
+            incident.RECORDER.min_auto_interval = saved_interval
+            if sink is not None:
+                sink.close()
+            supervisor.drain()
