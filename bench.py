@@ -60,7 +60,15 @@ which must be zero),
 BENCH_FLEETPLANE=0 to skip the fleet debug-plane fan-out arm
 (BENCH_FLEETPLANE_WORKERS stub worker endpoints, one wedged, scraped
 under the BENCH_FLEETPLANE_TIMEOUT_S per-worker budget; the wedged
-fan-out must stay within ~one timeout slice).
+fan-out must stay within ~one timeout slice),
+BENCH_FLOW=0 to skip the flow-accounting flash-crowd arm
+(BENCH_ZIPF_OBJECTS objects with zipf-skewed sizes at skew
+BENCH_ZIPF_SKEW and mean BENCH_ZIPF_BYTES bytes, fetched by
+BENCH_ZIPF_WORKERS sequential cache-less simulated workers plus
+BENCH_ZIPF_REQUESTS seeded zipf replay requests per worker; reports
+fleet origin amplification ≈ worker count from the summed-bytes merge
+beside the ~1.0 naive ratio average; deterministic via
+FAILPOINT_SEED).
 
 On the measurement noise: this box's absolute throughput swings ~3x on
 multi-second timescales (the same configuration has measured 85 and 580
@@ -1796,6 +1804,147 @@ def run_fleet_scrape_arm(
             server.server_close()
 
 
+def zipf_object_sizes(
+    count: int, skew: float, mean_bytes: int, seed: int
+) -> "list[int]":
+    """Zipf-skewed object sizes for the flash-crowd workload: rank r
+    carries weight r^-skew, scaled so the MEAN object is ~mean_bytes
+    (total work stays fixed as the skew knob moves). Which OBJECT gets
+    which rank is decided by hashing ``sha256(seed:zipf:i)`` — the
+    failpoint registry's derivation discipline (utils/failpoints.py
+    decision()), so the hot object's identity is a pure function of
+    the seed and a run reproduces bit-for-bit from FAILPOINT_SEED."""
+    import hashlib
+
+    weights = [(r + 1) ** -skew for r in range(count)]
+    scale = mean_bytes * count / sum(weights)
+    sizes_by_rank = [max(1024, int(w * scale)) for w in weights]
+    order = sorted(
+        range(count),
+        key=lambda i: hashlib.sha256(f"{seed}:zipf:{i}".encode()).digest(),
+    )
+    sizes = [0] * count
+    for rank, index in enumerate(order):
+        sizes[index] = sizes_by_rank[rank]
+    return sizes
+
+
+def zipf_sample(
+    sizes: "list[int]", seed: int, site: str, count: int
+) -> "list[int]":
+    """``count`` object indices drawn from the size-weighted zipf
+    distribution, deterministically: draw ``n`` maps
+    ``sha256(seed:site:n)`` to a [0,1) fraction walked through the
+    cumulative weights — the exact shape of the failpoint decision
+    function, so replay waves reproduce from the seed alone."""
+    import hashlib
+
+    total = float(sum(sizes))
+    out: "list[int]" = []
+    for n in range(count):
+        digest = hashlib.sha256(f"{seed}:{site}:{n}".encode()).digest()
+        fraction = int.from_bytes(digest[:8], "big") / 2**64
+        acc = 0.0
+        pick = len(sizes) - 1
+        for index, size in enumerate(sizes):
+            acc += size / total
+            if fraction < acc:
+                pick = index
+                break
+        out.append(pick)
+    return out
+
+
+def run_flow_accounting_arm(
+    site: str,
+    objects: int = 16,
+    skew: float = 1.1,
+    mean_bytes: int = 64 * 1024,
+    workers: int = 2,
+    requests: int = 0,
+) -> dict:
+    """Flow-accounting arm (ISSUE 16): a zipf-sized flash crowd served
+    by a CACHE-LESS fleet of W workers, each fetching every object from
+    the one origin through the real small-object fast path — so every
+    ledger seam (probe, pooled GET, note_ingress/note_unique) is the
+    production code. Workers run SEQUENTIALLY against a reset ledger
+    (per-process ledgers, exactly the production shape) and the fleet
+    view comes from ``flows.merge_flow_snapshots``: the contract number
+    is fleet origin amplification ≈ W (W workers each fetched the same
+    unique byte population once), computed from SUMMED bytes. The
+    naive average of per-worker ratios reads ~1.0 on the same run —
+    reported beside it as the standing proof of why the merge rule
+    matters. BENCH_ZIPF_REQUESTS>0 adds sampled repeat waves per
+    worker (zipf-weighted replays, seeded like everything else), which
+    push per-worker amplification above 1.0 too."""
+    from downloader_tpu.utils import flows
+    from downloader_tpu.utils.failpoints import seed_from_env
+
+    seed = seed_from_env()
+    sizes = zipf_object_sizes(objects, skew, mean_bytes, seed)
+    for index, size in enumerate(sizes):
+        with open(os.path.join(site, f"flow_{index:03d}.bin"), "wb") as sink:
+            sink.write(os.urandom(size))
+    proc, port = _spawn_server(_RANGE_SERVER, site, "0")
+    urls = [
+        f"http://127.0.0.1:{port}/flow_{index:03d}.bin"
+        for index in range(objects)
+    ]
+    max_bytes = max(sizes) + 1
+    snapshots: "dict[str, dict]" = {}
+    start = time.monotonic()
+    try:
+        for w in range(workers):
+            flows.LEDGER.reset()
+            backend = HTTPBackend()
+            workdir = tempfile.mkdtemp(prefix=f"flow-w{w}-", dir=site)
+            token = CancelToken()
+            try:
+                wave = list(range(objects)) + zipf_sample(
+                    sizes, seed, f"flow:w{w}", requests
+                )
+                for index in wave:
+                    if not backend.fetch_small(
+                        token, workdir, lambda *_args: None, urls[index],
+                        max_bytes,
+                    ):
+                        raise RuntimeError(
+                            f"fetch_small refused {urls[index]}"
+                        )
+            finally:
+                backend.close()
+                shutil.rmtree(workdir, ignore_errors=True)
+            snapshots[f"w{w}"] = flows.LEDGER.snapshot()
+    finally:
+        proc.kill()
+        flows.LEDGER.reset()
+    elapsed = time.monotonic() - start
+    fleet = flows.merge_flow_snapshots(snapshots)
+    worker_ratios = [
+        snap["origin_amplification"] for snap in snapshots.values()
+    ]
+    return {
+        "metric": "flow_accounting",
+        "unit": "ratio",
+        "workers": workers,
+        "objects": objects,
+        "skew": skew,
+        "requests_per_worker": requests,
+        "seed": seed,
+        "elapsed_s": round(elapsed, 2),
+        "origin_amplification": fleet["origin_amplification"],
+        "hot_object_share": fleet["hot_object_share"],
+        "ingress_bytes": fleet["ingress_bytes"],
+        "unique_bytes": fleet["unique_bytes"],
+        # the wrong aggregation, kept on display: averaging per-worker
+        # ratios hides exactly the redundancy the fleet merge exposes
+        "naive_ratio_average": round(
+            sum(worker_ratios) / max(1, len(worker_ratios)), 6
+        ),
+        "heavy_hitters": fleet["heavy_hitters"][:4],
+    }
+
+
 def main() -> None:
     jobs = int(os.environ.get("BENCH_JOBS", 24))
     mb_per_job = int(os.environ.get("BENCH_MB", 48))
@@ -2126,6 +2275,43 @@ def main() -> None:
                 f"{fleet_scrape['within_one_timeout_budget']})"
             )
 
+        flow_accounting = None
+        if os.environ.get("BENCH_FLOW", "1") != "0":
+            zipf_objects = max(
+                2, int(os.environ.get("BENCH_ZIPF_OBJECTS", 16))
+            )
+            zipf_skew = float(os.environ.get("BENCH_ZIPF_SKEW", 1.1))
+            zipf_bytes = max(
+                1024, int(os.environ.get("BENCH_ZIPF_BYTES", 64 * 1024))
+            )
+            zipf_workers = max(
+                2, int(os.environ.get("BENCH_ZIPF_WORKERS", 2))
+            )
+            zipf_requests = max(
+                0, int(os.environ.get("BENCH_ZIPF_REQUESTS", 0))
+            )
+            _log(
+                f"bench: flow-accounting arm, {zipf_workers} cache-less "
+                f"workers x {zipf_objects} zipf-sized objects "
+                f"(skew {zipf_skew:g}, mean {zipf_bytes} B, "
+                f"{zipf_requests} replay requests per worker)"
+            )
+            flow_accounting = run_flow_accounting_arm(
+                site,
+                objects=zipf_objects,
+                skew=zipf_skew,
+                mean_bytes=zipf_bytes,
+                workers=zipf_workers,
+                requests=zipf_requests,
+            )
+            _log(
+                "bench: flow accounting fleet amplification "
+                f"{flow_accounting['origin_amplification']} "
+                f"(naive ratio average "
+                f"{flow_accounting['naive_ratio_average']}), hot object "
+                f"share {flow_accounting['hot_object_share']}"
+            )
+
         extra_metrics = [
             {
                 "metric": "job_overhead_latency_ms",
@@ -2173,6 +2359,8 @@ def main() -> None:
             extra_metrics.append(fleet_chaos)
         if fleet_scrape is not None:
             extra_metrics.append(fleet_scrape)
+        if flow_accounting is not None:
+            extra_metrics.append(flow_accounting)
         if os.environ.get("BENCH_DIGEST", "1") != "0":
             _log("bench: digest kernel micro-benchmark (pallas vs hashlib)")
             try:

@@ -120,6 +120,9 @@ def digest_line(report: dict) -> dict:
             out["fleet_scrape_budget_ok"] = extra.get(
                 "within_one_timeout_budget"
             )
+        elif metric == "flow_accounting":
+            out["origin_amplification"] = extra.get("origin_amplification")
+            out["hot_object_share"] = extra.get("hot_object_share")
     return out
 
 

@@ -1243,6 +1243,13 @@ def serve(
     # of FAILPOINT_SEED so a chaos run reproduces from its seed
     FAILPOINTS.configure_from_env()
 
+    # flow accounting (utils/flows.py): the byte-attribution ledger the
+    # fetch/store seams report into; sizing knobs (hitters, origin and
+    # object cardinality caps) come from FLOW_* env vars
+    from ..utils import flows
+
+    flows.LEDGER.configure_from_env()
+
     # telemetry plane: the local time-series store samples the registry
     # on an interval, and the alert engine evaluates burn-rate/threshold
     # rules over it — both liveness-watched loops, both off when their

@@ -962,6 +962,10 @@ class FleetHealthServer:
                         code, body, ctype = plane.debug_profile(query)
                     elif path == "/debug/alerts":
                         code, body, ctype = plane.debug_alerts()
+                    elif path == "/debug/flows":
+                        code, body, ctype = plane.debug_flows(query)
+                    elif path == "/debug/critpath":
+                        code, body, ctype = plane.debug_critpath(query)
                     elif path == "/debug/incidents":
                         code, body, ctype = plane.debug_incidents()
                     elif path.startswith("/debug/incidents/"):

@@ -48,7 +48,7 @@ import threading
 import time
 import urllib.parse
 
-from ..utils import alerts, incident, metrics, profiling, tracing, tsdb
+from ..utils import alerts, flows, incident, metrics, profiling, tracing, tsdb
 from ..utils.logging import get_logger, merge_ring_records
 
 log = get_logger("fleetplane")
@@ -590,6 +590,45 @@ class FleetQueryPlane:
             payload["errors"] = errors
         return _json_body(payload)
 
+    def debug_flows(
+        self, query: "dict | None" = None
+    ) -> "tuple[int, bytes, str]":
+        """The fleet flow ledger: every worker's ``/debug/flows``
+        snapshot folded through ``flows.merge_flow_snapshots`` — fleet
+        amplification is fleet ingress over fleet UNIQUE bytes (each
+        object's unique contribution MAXed across the workers that
+        materialized it), never an average of per-worker ratios, and
+        the heavy-hitter sketches merge exactly (union + summed
+        estimates), so the fleet's hottest objects are named even when
+        no single worker saw them dominate."""
+        raw = (query or {}).get("hitters", [""])[0]
+        try:
+            hitters = max(1, int(raw)) if raw else 16
+        except ValueError:
+            hitters = 16
+        payloads, errors = self._split(
+            self.fanout(f"/debug/flows?hitters={hitters}")
+        )
+        payload = flows.merge_flow_snapshots(payloads)
+        payload["heavy_hitters"] = payload["sketch"]["items"][:hitters]
+        if errors:
+            payload["errors"] = errors
+        return _json_body(payload)
+
+    def debug_critpath(
+        self, query: "dict | None" = None
+    ) -> "tuple[int, bytes, str]":
+        """The fleet latency waterfall: per-job gating chains from
+        every worker combined (instance-tagged) and the "where does
+        p99 live" aggregation RECOMPUTED over the merged population —
+        the fleet p99 comes from the combined duration distribution,
+        never from averaging per-worker p99s."""
+        payloads, errors = self._split(self.fanout("/debug/critpath"))
+        payload = flows.merge_critpath_payloads(payloads)
+        if errors:
+            payload["errors"] = errors
+        return _json_body(payload)
+
     def debug_passthrough(self, path: str) -> "tuple[int, bytes, str]":
         """Per-instance passthrough for the views with no cross-worker
         merge semantics (watchdog, admission, jobs): one fan-out, each
@@ -711,10 +750,11 @@ class FleetAggregator:
         would cost the scrape tick two wedged-worker slices), returning
         histogram entries in the registry-snapshot shape the store's
         scrape loop records."""
-        # one-element holder, assigned WHOLESALE by the thread: a
+        # one-element holders, assigned WHOLESALE by their threads: a
         # straggling fan-out past the join deadline must never mutate
         # a dict the main path is iterating
         exemplar_holder: "list[dict[str, dict]]" = [{}]
+        flow_holder: "list[dict[str, dict]]" = [{}]
 
         def fetch_exemplars() -> None:
             try:
@@ -724,14 +764,30 @@ class FleetAggregator:
                 # this tick's exemplars, never the histogram fold
                 log.debug(f"exemplar fan-out failed: {exc}")
 
-        exemplar_thread = threading.Thread(  # thread-role: fleet-scraper
-            target=fetch_exemplars, name="fleet-exemplars", daemon=True
-        )
-        exemplar_thread.start()
-        profiling.ROLES.register_thread(exemplar_thread, "fleet-scraper")
+        def fetch_flows() -> None:
+            try:
+                flow_holder[0] = self._plane.fanout("/debug/flows")
+            except Exception as exc:
+                # same garnish contract as exemplars: a failed flow
+                # fan-out costs this tick's fleet flow gauges only
+                log.debug(f"flow fan-out failed: {exc}")
+
+        side_threads = []
+        for name, target in (
+            ("fleet-exemplars", fetch_exemplars),
+            ("fleet-flows", fetch_flows),
+        ):
+            thread = threading.Thread(  # thread-role: fleet-scraper
+                target=target, name=name, daemon=True
+            )
+            thread.start()
+            profiling.ROLES.register_thread(thread, "fleet-scraper")
+            side_threads.append(thread)
         results = self._plane.fanout("/metrics")
-        # deadline: the exemplar fan-out is itself bounded by the plane's per-worker scrape timeout + join grace
-        exemplar_thread.join(timeout=self._plane.timeout_s + 2 * _JOIN_GRACE_S)
+        # deadline: each side fan-out is itself bounded by the plane's per-worker scrape timeout + join grace
+        deadline = time.monotonic() + self._plane.timeout_s + 2 * _JOIN_GRACE_S
+        for thread in side_threads:
+            thread.join(timeout=max(0.0, deadline - time.monotonic()))
         batch: list = []
         live: "list[str]" = []
         with self._lock:
@@ -768,6 +824,27 @@ class FleetAggregator:
                 )
             self._instances = live
             self._exemplars = self._merge_exemplars(exemplar_holder[0])
+        # fleet flow gauges: fold the workers' flow snapshots with the
+        # one correct merge (summed bytes over MAXed unique bytes —
+        # utils/flows.py) and record the RATIOS as supervisor gauges;
+        # the fleet amplification/concentration rules threshold these
+        flow_payloads, _ = self._plane._split(flow_holder[0])
+        if flow_payloads:
+            merged = flows.merge_flow_snapshots(flow_payloads)
+            batch.append(
+                (
+                    fleet_series("flow_origin_amplification"),
+                    "gauge",
+                    float(merged["origin_amplification"]),
+                )
+            )
+            batch.append(
+                (
+                    fleet_series("flow_hot_object_share"),
+                    "gauge",
+                    float(merged["hot_object_share"]),
+                )
+            )
         return batch
 
     def _fold_increase(  # holds: _lock
@@ -920,6 +997,29 @@ def fleet_alert_rules(
             description=(
                 "one worker's windowed SLO p99 sits far above the fleet "
                 "median — the detail names the instance"
+            ),
+        ),
+        alerts.ThresholdRule(
+            "fleet-origin-amplification-burn",
+            fleet_series("flow_origin_amplification"),
+            threshold=flows.amplification_alert_from_env(),
+            for_s=alerts.AMPLIFICATION_BURN_FOR_S,
+            description=(
+                "the FLEET is fetching far more origin bytes than the "
+                "unique bytes it serves (ratio from summed bytes, not "
+                "averaged worker ratios — N cold workers each looking "
+                "fine IS the amplification this rule pages on)"
+            ),
+        ),
+        alerts.ThresholdRule(
+            "fleet-hot-object-concentration",
+            fleet_series("flow_hot_object_share"),
+            threshold=flows.hot_share_alert_from_env(),
+            severity="ticket",
+            description=(
+                "one object dominates fleet-wide ingress (merged "
+                "heavy-hitter sketches) — a flash crowd concentrating "
+                "on a single key"
             ),
         ),
     ]

@@ -69,7 +69,7 @@ import threading
 import urllib.parse
 
 from ..utils import (
-    admission, alerts, get_logger, incident, metrics, profiling,
+    admission, alerts, flows, get_logger, incident, metrics, profiling,
     tracing, tsdb, watchdog,
 )
 from ..utils.logging import ring_tail
@@ -120,6 +120,10 @@ class HealthServer:
                         code, body, ctype = health._debug_logs()
                     elif path == "/debug/exemplars":
                         code, body, ctype = health._debug_exemplars()
+                    elif path == "/debug/flows":
+                        code, body, ctype = health._debug_flows(query)
+                    elif path == "/debug/critpath":
+                        code, body, ctype = health._debug_critpath()
                     elif path == "/debug/incidents":
                         code, body, ctype = health._debug_incidents()
                     elif path.startswith("/debug/incidents/"):
@@ -380,6 +384,36 @@ class HealthServer:
         fleet aggregator scrapes beside /metrics so fleet burn alerts
         link straight to example traces."""
         payload = {"exemplars": metrics.GLOBAL.exemplars_snapshot()}
+        return (
+            200,
+            (json.dumps(payload, indent=1) + "\n").encode(),
+            "application/json",
+        )
+
+    def _debug_flows(self, query: dict | None = None) -> tuple[int, bytes, str]:
+        """The flow ledger (utils/flows.py): per-origin ingress,
+        per-object demand vs unique bytes, the live origin-amplification
+        ratio, and the heavy-hitter sketch (``?hitters=`` bounds the
+        displayed top-k; the mergeable sketch rides along for the fleet
+        fold)."""
+        raw = (query or {}).get("hitters", [""])[0]
+        try:
+            hitters = max(1, int(raw)) if raw else 16
+        except ValueError:
+            hitters = 16
+        payload = flows.LEDGER.snapshot(hitters=hitters)
+        return (
+            200,
+            (json.dumps(payload, indent=1) + "\n").encode(),
+            "application/json",
+        )
+
+    def _debug_critpath(self) -> tuple[int, bytes, str]:
+        """Per-job gating chains over the tracer's completed ring plus
+        the aggregated "where does p99 live" waterfall (utils/flows.py
+        critical-path extraction — a pure function of the span trees
+        /debug/jobs already serves)."""
+        payload = flows.critpath_payload(tracing.TRACER.recent())
         return (
             200,
             (json.dumps(payload, indent=1) + "\n").encode(),

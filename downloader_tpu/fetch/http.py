@@ -32,7 +32,7 @@ try:
 except ImportError:  # non-Unix: the splice path is gated off with it
     fcntl = None  # type: ignore[assignment]
 
-from ..utils import get_logger, metrics, tracing, watchdog
+from ..utils import flows, get_logger, metrics, tracing, watchdog
 from ..utils.netio import SocketWaiter
 from ..utils.cancel import Cancelled, CancelToken
 from . import progress as transfer_progress
@@ -351,6 +351,12 @@ class HTTPBackend:
         # bump per flushed chunk, captured once so the hot loop never
         # touches thread-local state
         fetch_hb = watchdog.current().heartbeat("fetch")
+        # flow ledger attribution (utils/flows.py): the single-stream
+        # lane is an origin ingress path like any other — same object
+        # key as the segmented/batched lanes so a retry that switches
+        # lanes still lands on one ledger row
+        flow_obj = flows.object_key(tracing.redact_url(url))
+        flow_host = flows.host_of(url)
         announced = False
         reported_high = 0
         sink_file: list = [None]  # the open part file, for flush-before-report
@@ -448,6 +454,9 @@ class HTTPBackend:
                         if token.cancelled():
                             raise Cancelled()
                         fetch_hb.beat(got)
+                        flows.LEDGER.note_ingress(
+                            flow_obj, flow_host, "mirror", got
+                        )
                         offset += got
                         if announced and offset > reported_high:
                             # only fd-flushed bytes may be advertised: a
@@ -554,6 +563,9 @@ class HTTPBackend:
             break
 
         sink_file[0] = None
+        # one complete copy served: max semantics, so a broker retry
+        # re-fetching this object inflates demand, never unique bytes
+        flows.LEDGER.note_unique(flow_obj, offset)
         os.replace(part_path, final_path)
         try:
             # a stale span journal from an earlier segmented attempt

@@ -14,7 +14,7 @@ import os
 import threading
 
 from ..parallel import DigestEngine, default_engine
-from ..utils import get_logger, metrics, watchdog
+from ..utils import flows, get_logger, metrics, watchdog
 from . import progress as transfer_progress
 from .http import TransferError
 from .peerwire import PeerProtocolError
@@ -89,6 +89,14 @@ class PieceStore:
                 f"{expected_pieces} pieces"
             )
         self.have = [False] * len(self.piece_hashes)
+        # flow-ledger identity: one torrent = one object, shared by the
+        # swarm's SourceBoard (demand side) and the verified-piece path
+        # (unique side) so amplification compares like with like
+        self.flow_key = flows.object_key(
+            f"torrent:{name}:{self.total_length}"
+        )
+        self._flow_lock = threading.Lock()
+        self._verified_bytes = 0  # guarded-by: _flow_lock
         # serializes write_piece file IO: concurrent peer workers would
         # otherwise race the exists()/"wb" decision and truncate each
         # other's bytes in shared files
@@ -159,13 +167,20 @@ class PieceStore:
         job's transfer sink (streaming upload): per overlapped file,
         the file-relative span the piece covers. Pad ranges are never
         on disk and never advertised."""
+        size = self.piece_size(index)
         # forward progress for the stall watchdog: a verified piece is
         # the torrent backend's unit of durable progress
-        self._fetch_hb.beat(self.piece_size(index))
+        self._fetch_hb.beat(size)
+        # unique object bytes for the flow ledger: verified-once bytes,
+        # reported as a running total (note_unique's max semantics make
+        # out-of-order delivery from racing workers harmless)
+        with self._flow_lock:
+            self._verified_bytes += size
+            verified = self._verified_bytes
+        flows.LEDGER.note_unique(self.flow_key, verified)
         if self._transfer_sink is transfer_progress.NOOP:
             return  # keep the per-piece hot path free of the file walk
         offset = index * self.piece_length
-        size = self.piece_size(index)
         file_start = 0
         for (path, length), is_pad in zip(self.files, self.pad_file):
             file_end = file_start + length

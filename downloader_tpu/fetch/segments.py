@@ -62,7 +62,7 @@ import urllib.parse
 import urllib.request
 
 from ..utils import (
-    admission, get_logger, incident, metrics, profiling, tracing,
+    admission, flows, get_logger, incident, metrics, profiling, tracing,
     watchdog,
 )
 from ..utils.cancel import Cancelled, CancelToken
@@ -412,6 +412,10 @@ class _FetchState:
         self.board = source_accounting.SourceBoard(
             demote_ratio=getattr(fetcher, "_demote_ratio", None),
             retire_errors=getattr(fetcher, "_retire_errors", None),
+            # flow-ledger attribution: every byte any source moves for
+            # this transfer counts against ONE object identity — the
+            # primary URL's — regardless of which mirror served it
+            flow_object=flows.object_key(tracing.redact_url(url)),
         )
         self.primary = self.board.add(
             source_accounting.KIND_MIRROR, tracing.redact_url(url),
@@ -1146,6 +1150,12 @@ class SegmentedFetcher:
         metrics.GLOBAL.add("http_bytes_fetched", probe.total - resumed_bytes)
         metrics.GLOBAL.add("http_files_fetched")
         metrics.GLOBAL.add("http_segmented_fetches")
+        # one complete copy of the object served: unique bytes are the
+        # amplification ratio's denominator (max semantics — a broker
+        # retry re-fetching this object inflates demand, never unique)
+        flows.LEDGER.note_unique(
+            flows.object_key(tracing.redact_url(url)), probe.total
+        )
         progress(url, 100.0)
         return True
 
@@ -1359,6 +1369,14 @@ class SegmentedFetcher:
         metrics.GLOBAL.add("http_bytes_fetched", got)
         metrics.GLOBAL.add("http_files_fetched")
         metrics.GLOBAL.add("http_small_fetches")
+        # the batched lane bypasses the SourceBoard, so it feeds the
+        # flow ledger directly: whole-object GET = demand AND one
+        # served copy in one note pair
+        small_obj = flows.object_key(tracing.redact_url(url))
+        flows.LEDGER.note_ingress(
+            small_obj, probe.host, source_accounting.KIND_MIRROR, got
+        )
+        flows.LEDGER.note_unique(small_obj, got)
         progress(url, 100.0)
         return True
 

@@ -33,7 +33,7 @@ import os
 import threading
 import time
 
-from ..utils import get_logger, metrics, profiling
+from ..utils import flows, get_logger, metrics, profiling
 
 log = get_logger("fetch.sources")
 
@@ -232,13 +232,18 @@ class Source:
 
     __slots__ = (
         "kind", "name", "payload", "meter", "state", "inflight", "errors",
-        "demotions",
+        "demotions", "host", "origin_label",
     )
 
     def __init__(self, kind: str, name: str, payload=None, clock=time.monotonic):
         self.kind = kind
         self.name = name
         self.payload = payload
+        # origin identity, computed ONCE at registration (never on the
+        # per-chunk byte path): the flow ledger's attribution host and
+        # the bounded metric label the per-origin counters ride
+        self.host = flows.host_of(name)
+        self.origin_label = flows.origin_label(self.host)
         self.meter = SourceMeter(clock)  # mutated under the board's lock
         self.state = ACTIVE  # mutated under the board's lock
         self.inflight = 0  # mutated under the board's lock
@@ -264,8 +269,13 @@ class SourceBoard:
         demote_ratio: float | None = None,
         retire_errors: int | None = None,
         clock=time.monotonic,
+        flow_object: str = "",
     ):
         self._clock = clock
+        # the flow ledger's object attribution for every byte this
+        # board accounts (segments pass the primary URL's key, swarms
+        # the torrent's) — empty attributes to the anonymous object
+        self._flow_object = flow_object
         self._demote_ratio = (
             demote_ratio_from_env() if demote_ratio is None else demote_ratio
         )
@@ -312,6 +322,16 @@ class SourceBoard:
         with self._lock:
             source.meter.note(count)
         metrics.GLOBAL.add(f"source_bytes_total_{source.kind}", count)
+        # the per-origin-host dimension (ISSUE 16 satellite): bounded
+        # by the flow plane's origin-label registry, so demotions can
+        # be read against origin identity without unbounded series
+        metrics.GLOBAL.add(
+            f"source_bytes_total_{source.kind}_origin_{source.origin_label}",
+            count,
+        )
+        flows.LEDGER.note_ingress(
+            self._flow_object, source.host, source.kind, count
+        )
 
     def note_success(self, source: Source) -> None:
         """A claim completed cleanly: the consecutive-error score that

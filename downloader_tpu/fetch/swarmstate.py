@@ -129,7 +129,12 @@ class _SwarmState:
         # workers register here so swarm traffic lands on the same
         # per-kind rate/demotion board as the HTTP span scheduler —
         # one /metrics story for mirror, webseed, and peer bytes
-        self.sources = source_accounting.SourceBoard()
+        self.sources = source_accounting.SourceBoard(
+            # webseed and peer bytes attribute to the torrent's one
+            # flow-ledger object, the same identity the verified-piece
+            # path reports unique bytes against
+            flow_object=getattr(store, "flow_key", ""),
+        )
 
     def register(self, conn) -> None:
         """Track a live connection; its (HAVE-updated) bitfield feeds

@@ -46,7 +46,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from ..fetch.progress import SpanSet  # noqa: F401  (re-export: span math lives with the writers)
 from ..scan import MEDIA_EXTENSIONS
 from ..utils import (
-    admission, get_logger, incident, metrics, profiling, tracing,
+    admission, flows, get_logger, incident, metrics, profiling, tracing,
     watchdog,
 )
 from ..utils.cancel import Cancelled, CancelToken
@@ -134,6 +134,9 @@ class _FileStream:
         self.total = total
         self.key = key
         self.upload_id = upload_id
+        # flow-ledger egress identity, computed once per stream (the
+        # ship path runs per part on the upload pool)
+        self._flow_object = flows.object_key(key)
         self.plan = PartPlan(total, part_size)
         self.spans = SpanSet()  # guarded-by: _session._lock
         self.submitted: set[int] = set()  # guarded-by: _session._lock
@@ -222,6 +225,9 @@ class _FileStream:
             # a completed part is the streaming path's unit of upload
             # progress for the stall watchdog
             session._upload_hb.beat()
+            # egress accounting: one shipped part's bytes, attributed
+            # to the destination object
+            flows.LEDGER.note_egress(self._flow_object, length)
         except (S3Error, OSError, ValueError, Cancelled) as exc:
             with session._lock:
                 if not self.failed:

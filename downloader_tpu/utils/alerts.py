@@ -35,7 +35,7 @@ import threading
 import time
 from collections import deque
 
-from . import metrics, profiling, tsdb, watchdog
+from . import flows, metrics, profiling, tsdb, watchdog
 from .logging import get_logger
 
 log = get_logger("alerts")
@@ -58,6 +58,10 @@ QUEUE_DEPTH_THRESHOLD = 1000.0
 # the publisher gauge reads 0 during normal reconnects; only a dead
 # publisher that stays dead should page
 PUBLISHER_DOWN_FOR_S = 30.0
+# origin amplification legitimately spikes while a cold worker warms
+# (every first fetch is "redundant" until the object is unique-counted);
+# only a SUSTAINED ratio is an origin-bill burn worth paging on
+AMPLIFICATION_BURN_FOR_S = 120.0
 
 _STATES = ("inactive", "pending", "firing", "resolved")
 
@@ -588,6 +592,28 @@ def default_rules(
             description=(
                 "the publisher thread has been down longer than a "
                 "reconnect should take; Convert hand-offs are buffering"
+            ),
+        ),
+        ThresholdRule(
+            "origin-amplification-burn",
+            "flow_origin_amplification",
+            threshold=flows.amplification_alert_from_env(),
+            for_s=AMPLIFICATION_BURN_FOR_S,
+            description=(
+                "this worker is fetching far more origin bytes than the "
+                "unique object bytes it serves (dead cache layer, "
+                "refetch loop, or a flash crowd hitting a cold fleet) — "
+                "sustained, so it's the origin bill burning, not warmup"
+            ),
+        ),
+        ThresholdRule(
+            "hot-object-concentration",
+            "flow_hot_object_share",
+            threshold=flows.hot_share_alert_from_env(),
+            severity="ticket",
+            description=(
+                "a single object dominates ingress (heavy-hitter "
+                "sketch); a flash crowd or a stuck refetch on one key"
             ),
         ),
     ]

@@ -238,7 +238,7 @@ class IncidentRecorder:
         stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime(now))
         bundle_id = f"incident-{stamp}-{seq:04d}"
 
-        from . import profiling, tracing, watchdog
+        from . import flows, profiling, tracing, watchdog
 
         counters = metrics.GLOBAL.snapshot()
         deltas = {
@@ -271,6 +271,14 @@ class IncidentRecorder:
             # threads ARE, the profile says where they have BEEN
             "profile": profiling.PROFILER.incident_tail(),
             "watchdog": watchdog.MONITOR.snapshot(),
+            # what the worker was FETCHING when this wedged: origin
+            # amplification, heavy hitters, and the per-job gating
+            # stages (utils/flows.py) — an amplification burn's evidence
+            # lands in the bundle without a second capture
+            "flows": flows.LEDGER.incident_snapshot(),
+            "critpath": flows.critpath_payload(
+                tracing.TRACER.recent(), per_job=False
+            ),
             "metrics": {
                 "counters": dict(sorted(counters.items())),
                 "gauges": dict(sorted(metrics.GLOBAL.gauges().items())),

@@ -135,6 +135,30 @@ HELP = {
     "source_bytes_total_mirror": "bytes fetched from HTTP mirror sources",
     "source_bytes_total_webseed": "bytes fetched from webseed sources",
     "source_bytes_total_peer": "bytes fetched from torrent peer sources",
+    # flow-accounting plane (utils/flows.py); per-origin variants of the
+    # source_bytes families are name-encoded with a bounded label set
+    # (source_bytes_total_<kind>_origin_<label>, strangers -> overflow)
+    # and carry the derived help line
+    "flow_origin_bytes_total": (
+        "bytes fetched FROM origins (flow-ledger ingress, all source "
+        "kinds; the numerator of origin amplification)"
+    ),
+    "flow_unique_bytes_total": (
+        "unique object bytes first materialized on this worker (the "
+        "denominator of origin amplification; refetches don't count)"
+    ),
+    "flow_egress_bytes_total": (
+        "bytes shipped to the object store (flow-ledger egress at "
+        "pipeline ship)"
+    ),
+    "flow_origin_amplification": (
+        "live origin-amplification ratio: origin bytes fetched over "
+        "unique object bytes served (1.0 = no redundant fetching)"
+    ),
+    "flow_hot_object_share": (
+        "share of all ingress bytes attributed to the single hottest "
+        "object (heavy-hitter sketch top estimate over total)"
+    ),
     "source_demotions_total_mirror": (
         "mirror sources demoted to the trickle lane (slow or erroring; "
         "recovery re-promotes)"

@@ -1,0 +1,887 @@
+"""Flow accounting & critical-path plane (utils/flows.py, ISSUE 16).
+
+Four layers:
+
+- sketch proofs: the space-saving sketch honors its error bound
+  (estimate ≤ true + total/capacity) under an adversarial rotating
+  stream, never loses a key whose true weight exceeds the bound, and
+  its merge is exactly associative because capacity is enforced at
+  offer time, never in the fold;
+- ledger semantics: ``note_unique`` max semantics (a re-fetch inflates
+  demand, never unique bytes), bounded origin/object cardinality
+  folding strangers into ``__overflow__`` with exact totals, and the
+  fleet-merge regression pinning that fleet amplification comes from
+  SUMMED bytes — averaging per-worker ratios reports ~1.0 for exactly
+  the redundant-fetch fleet the instrument exists to expose;
+- critical-path proofs on hand-built span trees: the backward sweep
+  credits each child with the slice of its parent it actually gated
+  (so a dominant SEQUENTIAL stage gates, not merely the stage that
+  finished last), the chain agrees with the tree, and the waterfall's
+  slow cohort names the p99 story; plus the tier-1 ≤0.5 ms/job
+  overhead guard over the whole instrument;
+- the e2e acceptance: 2 real ``serve()`` workers drain a zipf flash
+  crowd (every object demanded twice), and the fleet ``/debug/flows``
+  reports origin amplification within 10% of the worker count with the
+  hot object named, while ``/debug/critpath`` names ``fetch`` as the
+  gating stage of the throttled wave.
+"""
+
+import http.client
+import http.server
+import json
+import os
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from downloader_tpu.daemon.fleet import (
+    FleetConfig,
+    FleetHealthServer,
+    FleetSupervisor,
+)
+from downloader_tpu.daemon.health import HealthServer
+from downloader_tpu.queue.amqp_server import AmqpServerStub
+from downloader_tpu.store.credentials import Credentials
+from downloader_tpu.store.stub import S3Stub
+from downloader_tpu.utils import flows, metrics, tracing
+from downloader_tpu.wire import Convert, Download, Media
+
+CREDS = Credentials(access_key="ak", secret_key="sk")
+BUCKET = "flow-bkt"
+
+
+def _wait(predicate, timeout: float, what: str, interval: float = 0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(interval)
+    raise AssertionError(f"timed out after {timeout}s waiting for {what}")
+
+
+@pytest.fixture(autouse=True)
+def _flow_isolation():
+    yield
+    flows.LEDGER.reset()
+    flows.LEDGER.configure(
+        enabled=True,
+        hitters=flows.DEFAULT_HITTERS,
+        max_origins=flows.DEFAULT_MAX_ORIGINS,
+        max_objects=flows.DEFAULT_MAX_OBJECTS,
+    )
+    flows.reset_origin_labels()
+    metrics.GLOBAL.reset()
+
+
+# -- the heavy-hitter sketch --------------------------------------------------
+
+
+def test_sketch_error_bound_under_adversarial_rotating_stream():
+    """The Metwally guarantees under the worst stream for a capacity-8
+    sketch: a rotating parade of strangers (each arrival evicts the
+    current minimum) interleaved with a few true heavies. Every
+    monitored estimate must overshoot its key's TRUE weight by at most
+    total/capacity, and every key whose true weight exceeds that bound
+    must still be monitored at the end."""
+    capacity = 8
+    sketch = flows.SpaceSaving(capacity)
+    true: "dict[str, int]" = {}
+
+    def offer(key, weight):
+        true[key] = true.get(key, 0) + weight
+        sketch.offer(key, weight)
+
+    for round_index in range(50):
+        for stranger in range(20):
+            offer(f"cold-{round_index}-{stranger}", 17)
+        offer("hot-a", 900)
+        offer("hot-b", 500)
+    total = sum(true.values())
+    assert sketch.total == total
+    bound = total / capacity
+    monitored = {
+        item["key"]: item for item in sketch.heavy_hitters(capacity)
+    }
+    for key, item in monitored.items():
+        assert item["bytes"] >= true[key], (
+            f"{key}: estimate {item['bytes']} undershoots true {true[key]}"
+        )
+        assert item["bytes"] - true[key] <= bound, (
+            f"{key}: overshoot {item['bytes'] - true[key]} > {bound}"
+        )
+        assert item["error"] <= bound
+    for key, weight in true.items():
+        if weight > bound:
+            assert key in monitored, (
+                f"true heavy {key} ({weight} > {bound}) lost by the sketch"
+            )
+    # the heavies rank first, by estimate
+    ranked = sketch.heavy_hitters(2)
+    assert [item["key"] for item in ranked] == ["hot-a", "hot-b"]
+
+
+def test_sketch_replay_is_deterministic():
+    """Identical streams produce identical snapshots: evictions
+    tie-break on the key, not dict order or randomness."""
+
+    def run():
+        sketch = flows.SpaceSaving(4)
+        for index in range(200):
+            sketch.offer(f"k{index % 13}", 5)
+            sketch.offer(f"stranger-{index}", 5)
+        return sketch.snapshot()
+
+    assert run() == run()
+
+
+def test_sketch_merge_is_associative_and_untruncated():
+    """The fleet fold: capacity is enforced at offer, never at merge,
+    so merging is exactly associative (and the merged item set may
+    exceed one sketch's capacity — display truncates, the fold does
+    not)."""
+    snaps = []
+    for worker in range(3):
+        sketch = flows.SpaceSaving(4)
+        for index in range(40):
+            sketch.offer(f"w{worker}-obj{index % 7}", (worker + 1) * 10)
+        snaps.append(sketch.snapshot())
+    a, b, c = snaps
+    merge = flows.SpaceSaving.merge
+    left = merge([merge([a, b]), c])
+    right = merge([a, merge([b, c])])
+    flat = merge([a, b, c])
+    assert left == right == flat
+    assert flat["total"] == sum(s["total"] for s in snaps)
+    # three capacity-4 sketches over disjoint key spaces: the fold
+    # keeps all of them
+    assert len(flat["items"]) > 4
+    # estimates sum with absent-as-zero; order is deterministic
+    assert flat["items"] == sorted(
+        flat["items"], key=lambda item: (-item["bytes"], item["key"])
+    )
+
+
+# -- ledger semantics ---------------------------------------------------------
+
+
+def test_note_unique_max_semantics_refetch_inflates_demand_only():
+    ledger = flows.FlowLedger()
+    obj = flows.object_key("http://origin/video.mp4")
+    # first fetch: 100 bytes in, the whole object served
+    ledger.note_ingress(obj, "origin", "mirror", 100)
+    ledger.note_unique(obj, 100)
+    snap = ledger.snapshot()
+    assert snap["ingress_bytes"] == 100
+    assert snap["unique_bytes"] == 100
+    assert snap["origin_amplification"] == pytest.approx(1.0)
+    # the same object fetched again: demand doubles, unique does not
+    ledger.note_ingress(obj, "origin", "mirror", 100)
+    ledger.note_unique(obj, 100)
+    snap = ledger.snapshot()
+    assert snap["ingress_bytes"] == 200
+    assert snap["unique_bytes"] == 100
+    assert snap["origin_amplification"] == pytest.approx(2.0)
+    # a RUNNING total that grows (torrent verified-bytes path) adds
+    # only the delta
+    ledger.note_unique(obj, 150)
+    assert ledger.snapshot()["unique_bytes"] == 150
+    # and egress is its own dimension
+    ledger.note_egress(obj, 150)
+    assert ledger.snapshot()["egress_bytes"] == 150
+
+
+def test_ledger_bounded_cardinality_folds_overflow_with_exact_totals():
+    ledger = flows.FlowLedger(max_origins=2, max_objects=2)
+    for index in range(5):
+        ledger.note_ingress(f"obj-{index}", f"host-{index}", "mirror", 10)
+        ledger.note_unique(f"obj-{index}", 10)
+    snap = ledger.snapshot()
+    # ingress stays exact past the bound
+    assert snap["ingress_bytes"] == 50
+    # per-key attribution degrades into the overflow bucket
+    assert set(snap["origins"]) == {"host-0", "host-1", flows.OVERFLOW_KEY}
+    assert snap["origins"][flows.OVERFLOW_KEY]["ingress_bytes"] == 30
+    by_key = {item["key"]: item for item in snap["objects"]}
+    assert set(by_key) == {"obj-0", "obj-1", flows.OVERFLOW_KEY}
+    assert by_key[flows.OVERFLOW_KEY]["demand_bytes"] == 30
+    # THE bounded-cardinality discipline: five distinct objects each
+    # fetched ONCE is a healthy workload. The overflow bucket cannot
+    # dedupe per-stranger running totals (the three strangers max-fold
+    # into one slot), so folded bytes stay OUT of the ratio — a merely
+    # diverse workload must read ~1.0, not phantom amplification
+    assert snap["origin_amplification"] == pytest.approx(1.0)
+    # re-fetching a TRACKED object still moves the needle
+    ledger.note_ingress("obj-0", "host-0", "mirror", 10)
+    assert ledger.snapshot()["origin_amplification"] == pytest.approx(1.5)
+    # and the same discipline holds through the fleet fold
+    merged = flows.merge_flow_snapshots({"w0": ledger.snapshot()})
+    assert merged["origin_amplification"] == pytest.approx(1.5)
+
+
+def test_origin_label_bounded_past_max_origins():
+    flows.reset_origin_labels()
+    flows.LEDGER.configure(max_origins=2)
+    try:
+        assert flows.origin_label("cdn-a.example.com") == "cdn_a_example_com"
+        assert flows.origin_label("cdn-b.example.com") == "cdn_b_example_com"
+        # the third stranger shares the overflow label...
+        assert flows.origin_label("cdn-c.example.com") == flows.OVERFLOW_LABEL
+        # ...but an already-admitted host keeps its own
+        assert flows.origin_label("cdn-a.example.com") == "cdn_a_example_com"
+    finally:
+        flows.LEDGER.configure(max_origins=flows.DEFAULT_MAX_ORIGINS)
+        flows.reset_origin_labels()
+
+
+def test_fleet_merge_sums_bytes_never_averages_ratios():
+    """THE regression this plane exists for: two workers each fetch the
+    same object once. Each worker's local amplification is a healthy
+    1.0 — the fleet fetched the object twice to serve ONE unique copy,
+    so fleet amplification is 2.0. Averaging the per-worker ratios
+    would report 1.0 and hide the redundancy entirely."""
+    obj = flows.object_key("http://origin/hot.bin")
+    snaps = {}
+    for worker in ("worker-0", "worker-1"):
+        ledger = flows.FlowLedger()
+        ledger.note_ingress(obj, "origin", "mirror", 1000)
+        ledger.note_unique(obj, 1000)
+        snaps[worker] = ledger.snapshot()
+    naive_average = sum(
+        s["origin_amplification"] for s in snaps.values()
+    ) / len(snaps)
+    merged = flows.merge_flow_snapshots(snaps)
+    assert naive_average == pytest.approx(1.0)
+    assert merged["workers"] == 2
+    assert merged["ingress_bytes"] == 2000
+    assert merged["unique_bytes"] == 1000  # MAX per object, then summed
+    assert merged["origin_amplification"] == pytest.approx(2.0)
+    assert merged["origin_amplification"] != pytest.approx(naive_average)
+    # per-instance ratios ride along for the debug view
+    assert set(merged["instances"]) == {"worker-0", "worker-1"}
+
+    # and when each worker is ITSELF amplified (each fetched the same
+    # object twice), the fleet ratio compounds: 4 fetches, one copy
+    for worker, snap in list(snaps.items()):
+        ledger = flows.FlowLedger()
+        ledger.note_ingress(obj, "origin", "mirror", 2000)
+        ledger.note_unique(obj, 1000)
+        snaps[worker] = ledger.snapshot()
+    merged = flows.merge_flow_snapshots(snaps)
+    assert merged["origin_amplification"] == pytest.approx(4.0)
+    assert sum(
+        s["origin_amplification"] for s in snaps.values()
+    ) / len(snaps) == pytest.approx(2.0)
+
+
+def test_fleet_merge_folds_origins_and_sketches():
+    ledger_a = flows.FlowLedger()
+    ledger_b = flows.FlowLedger()
+    ledger_a.note_ingress("obj-a", "host-1", "mirror", 300)
+    ledger_a.note_unique("obj-a", 300)
+    ledger_b.note_ingress("obj-a", "host-1", "webseed", 300)
+    ledger_b.note_ingress("obj-b", "host-2", "peer", 100)
+    ledger_b.note_unique("obj-b", 100)
+    merged = flows.merge_flow_snapshots(
+        {"w0": ledger_a.snapshot(), "w1": ledger_b.snapshot()}
+    )
+    assert merged["origins"]["host-1"]["ingress_bytes"] == 600
+    assert merged["origins"]["host-1"]["by_kind"] == {
+        "mirror": 300, "webseed": 300,
+    }
+    assert merged["origins"]["host-2"]["by_kind"] == {"peer": 100}
+    # obj-a took 600 of 700 demanded bytes: it IS the hot object
+    assert merged["heavy_hitters"][0]["key"] == "obj-a"
+    assert merged["hot_object_share"] == pytest.approx(600 / 700)
+    # ingress 700 over unique 400
+    assert merged["origin_amplification"] == pytest.approx(700 / 400)
+
+
+# -- critical-path extraction -------------------------------------------------
+
+
+def _span(name, start, dur, children=()):
+    return {
+        "name": name,
+        "start_ms": start,
+        "duration_ms": dur,
+        "children": list(children),
+    }
+
+
+def test_critical_path_names_dominant_sequential_stage():
+    """Sequential stages fetch→scan→upload→publish: the stage that
+    finished LAST (publish) is not the story — the backward sweep
+    credits each stage with the slice of the job it gated, and the
+    chain descends into the dominant one (fetch)."""
+    root = _span("job", 0.0, 1000.0, [
+        _span("fetch", 0.0, 700.0),
+        _span("scan", 700.0, 100.0),
+        _span("upload", 800.0, 150.0),
+        _span("publish", 950.0, 50.0),
+    ])
+    chain = flows.critical_path(root)
+    assert [entry["name"] for entry in chain] == ["job", "fetch"]
+    assert chain[0]["critical_ms"] == pytest.approx(1000.0)
+    # every instant of the job was gated by SOME child
+    assert chain[0]["exclusive_ms"] == pytest.approx(0.0)
+    assert chain[1]["critical_ms"] == pytest.approx(700.0)
+    assert chain[1]["exclusive_ms"] == pytest.approx(700.0)
+
+
+def test_critical_path_agrees_with_hand_built_tree():
+    # nested descent: fetch's own gating child is the longer segment
+    root = _span("job", 0.0, 100.0, [
+        _span("fetch", 0.0, 80.0, [
+            _span("seg0", 0.0, 30.0),
+            _span("seg1", 30.0, 50.0),
+        ]),
+        _span("publish", 80.0, 20.0),
+    ])
+    chain = flows.critical_path(root)
+    assert [entry["name"] for entry in chain] == ["job", "fetch", "seg1"]
+    assert [entry["depth"] for entry in chain] == [0, 1, 2]
+    assert chain[1]["exclusive_ms"] == pytest.approx(0.0)
+    assert chain[2]["critical_ms"] == pytest.approx(50.0)
+
+    # a gap no child covers belongs to the parent's exclusive time;
+    # overlapping children split the timeline at the later one's start
+    root = _span("job", 0.0, 100.0, [
+        _span("a", 0.0, 40.0),
+        _span("b", 10.0, 60.0),
+    ])
+    chain = flows.critical_path(root)
+    assert chain[0]["exclusive_ms"] == pytest.approx(30.0)  # 70..100
+    assert chain[1]["name"] == "b"
+    assert chain[1]["critical_ms"] == pytest.approx(60.0)
+
+    # equal slices tie-break toward the LATER stage in the timeline
+    root = _span("job", 0.0, 100.0, [
+        _span("x", 0.0, 50.0),
+        _span("y", 50.0, 50.0),
+    ])
+    assert flows.critical_path(root)[1]["name"] == "y"
+
+    # a leaf root is its own chain
+    chain = flows.critical_path(_span("job", 5.0, 20.0))
+    assert chain == [{
+        "name": "job", "depth": 0, "start_ms": 5.0, "end_ms": 25.0,
+        "duration_ms": 20.0, "critical_ms": 20.0, "exclusive_ms": 20.0,
+    }]
+    # degenerate inputs never throw
+    assert flows.critical_path(None) == []
+    assert flows.critical_path({"name": "x", "duration_ms": "bogus"}) == []
+
+
+def test_waterfall_slow_cohort_names_the_p99_stage():
+    """99 fast upload-gated jobs and one slow fetch-gated straggler:
+    the overall stage table is upload's, but the slow cohort — where
+    the p99 story lives — names fetch."""
+    traces = []
+    for index in range(99):
+        traces.append({
+            "job_id": f"fast-{index}", "status": "ok", "attempt": 1,
+            "spans": _span("job", 0.0, 100.0, [
+                _span("fetch", 0.0, 20.0),
+                _span("upload", 20.0, 80.0),
+            ]),
+        })
+    traces.append({
+        "job_id": "slow-0", "status": "ok", "attempt": 1,
+        "spans": _span("job", 0.0, 5000.0, [
+            _span("fetch", 0.0, 4900.0),
+            _span("upload", 4900.0, 100.0),
+        ]),
+    })
+    payload = flows.critpath_payload(traces)
+    assert payload["jobs"] == 100
+    assert payload["p99_ms"] == pytest.approx(5000.0)
+    assert payload["slow"]["jobs"] == 1
+    assert payload["slow"]["gating_stage"] == "fetch"
+    assert payload["stages"]["upload"]["jobs_gated"] == 99
+    shares = [stage["share"] for stage in payload["stages"].values()]
+    assert sum(shares) == pytest.approx(1.0, abs=0.01)
+    # per-job chains ride along on the worker view...
+    assert len(payload["per_job"]) == 100
+    # ...and the fleet merge recomputes over the COMBINED population,
+    # tagging each job with its instance
+    merged = flows.merge_critpath_payloads(
+        {"w0": payload, "w1": payload}
+    )
+    assert merged["workers"] == 2
+    assert merged["jobs"] == 200
+    assert merged["slow"]["gating_stage"] == "fetch"
+    assert {job["instance"] for job in merged["per_job"]} == {"w0", "w1"}
+    # incident bundles keep only the aggregation
+    compact = flows.critpath_payload(traces, per_job=False)
+    assert "per_job" not in compact
+
+
+# -- the worker debug endpoints -----------------------------------------------
+
+
+class _FakeDaemonStats:
+    processed = 0
+    failed = 0
+    retried = 0
+    dropped = 0
+    shed = 0
+
+
+class _FakeDaemon:
+    stats = _FakeDaemonStats()
+    worker_count = 1
+
+
+class _FakeQueueStats:
+    published = 0
+    delivered = 0
+    publish_retries = 0
+    reconnects = 0
+    consumer_errors = 0
+
+
+class _FakeClient:
+    stats = _FakeQueueStats()
+
+    def connected(self):
+        return True
+
+
+def test_worker_debug_flows_and_critpath_views():
+    flows.LEDGER.reset()
+    obj = flows.object_key("http://origin/clip.mp4")
+    flows.LEDGER.note_ingress(obj, "origin", "mirror", 2048)
+    flows.LEDGER.note_unique(obj, 1024)
+    server = HealthServer(_FakeDaemon(), _FakeClient(), 0)
+    try:
+        code, body, ctype = server._debug_flows({"hitters": ["1"]})
+        assert code == 200 and ctype == "application/json"
+        payload = json.loads(body)
+        assert payload["origin_amplification"] == pytest.approx(2.0)
+        assert len(payload["heavy_hitters"]) == 1
+        assert payload["heavy_hitters"][0]["key"] == obj
+        # the mergeable sketch rides along untruncated
+        assert payload["sketch"]["total"] == 2048
+        # a bogus ?hitters= falls back to the default
+        code, body, _ = server._debug_flows({"hitters": ["bogus"]})
+        assert code == 200
+        code, body, ctype = server._debug_critpath()
+        assert code == 200 and ctype == "application/json"
+        critpath = json.loads(body)
+        assert "stages" in critpath and "slow" in critpath
+    finally:
+        server._httpd.server_close()
+
+
+# -- the tier-1 overhead guard ------------------------------------------------
+
+
+def test_flow_accounting_overhead_under_half_millisecond_per_job():
+    """The whole instrument — 64 ingress notes, the unique/egress
+    notes, and a critical-path extraction over a 10-span tree — stays
+    under the 0.5 ms/job bar every other observability plane in this
+    codebase is held to."""
+    ledger = flows.FlowLedger()
+    tree = _span("job", 0.0, 1000.0, [
+        _span(name, index * 100.0, 100.0, [
+            _span(f"{name}-sub", index * 100.0, 60.0),
+        ])
+        for index, name in enumerate(
+            ("fetch", "scan", "upload", "publish")
+        )
+    ])
+
+    def one_job(serial):
+        obj = f"obj-{serial % 32}"
+        for chunk in range(64):
+            ledger.note_ingress(obj, "origin", "mirror", 65536)
+        ledger.note_unique(obj, 64 * 65536)
+        ledger.note_egress(obj, 64 * 65536)
+        chain = flows.critical_path(tree)
+        assert chain
+
+    deadline = time.monotonic() + 30.0
+    while True:
+        one_job(0)  # warm
+        laps = []
+        for serial in range(200):
+            started = time.perf_counter()
+            one_job(serial)
+            laps.append(time.perf_counter() - started)
+        laps.sort()
+        median_ms = laps[100] * 1000
+        if median_ms < 0.5:
+            break
+        assert time.monotonic() < deadline, (
+            f"flow accounting costs {median_ms:.3f} ms/job (budget 0.5)"
+        )
+
+
+# -- the zipf workload generator (bench.py satellite) -------------------------
+
+
+def test_bench_zipf_generator_is_deterministic_under_seed():
+    """Satellite: the flash-crowd generator replays byte-identically
+    under FAILPOINT_SEED — run twice in fresh interpreters (bench.py
+    configures process-wide logging at import, so it stays out of this
+    process)."""
+    probe = (
+        "import json, bench\n"
+        "sizes = bench.zipf_object_sizes(12, 1.1, 65536, 509)\n"
+        "picks = bench.zipf_sample(sizes, 509, 'w0', 20)\n"
+        "print(json.dumps({'sizes': sizes, 'picks': picks}))\n"
+    )
+    env = {**os.environ, "FAILPOINT_SEED": "509", "JAX_PLATFORMS": "cpu"}
+
+    def run():
+        return subprocess.run(
+            [sys.executable, "-c", probe],
+            capture_output=True, text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            timeout=120, check=True,
+        ).stdout
+
+    first, second = run(), run()
+    assert first == second
+    payload = json.loads(first)
+    assert len(payload["sizes"]) == 12
+    assert all(size >= 1024 for size in payload["sizes"])
+    # skew > 0: the head object outweighs the tail
+    assert max(payload["sizes"]) > min(payload["sizes"])
+    assert all(0 <= pick < 12 for pick in payload["picks"])
+
+
+# -- the e2e acceptance -------------------------------------------------------
+
+
+class _FlowOrigin:
+    """Throttled HTTP/1.1 origin: HEAD announces size + ranges, GET
+    streams at a byte-rate cap so ``fetch`` is each job's dominant
+    stage."""
+
+    def __init__(self, objects, rate):
+        origin = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def do_HEAD(self):
+                payload = origin.objects.get(self.path)
+                if payload is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.send_header("Accept-Ranges", "bytes")
+                self.end_headers()
+
+            def do_GET(self):
+                payload = origin.objects.get(self.path)
+                with origin.lock:
+                    origin.gets[self.path] = (
+                        origin.gets.get(self.path, 0) + 1
+                    )
+                if payload is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                try:
+                    chunk = 16 * 1024
+                    for offset in range(0, len(payload), chunk):
+                        piece = payload[offset:offset + chunk]
+                        self.wfile.write(piece)
+                        self.wfile.flush()
+                        time.sleep(len(piece) / origin.rate)
+                except OSError:
+                    return
+
+        self.objects = dict(objects)
+        self.rate = float(rate)
+        self.gets = {}
+        self.lock = threading.Lock()
+        self._server = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", 0), Handler
+        )
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def _worker_env(broker, s3, base_dir):
+    return {
+        "BROKER": "amqp",
+        "RABBITMQ_ENDPOINT": broker.endpoint,
+        "RABBITMQ_USERNAME": "",
+        "RABBITMQ_PASSWORD": "",
+        "S3_ENDPOINT": f"http://{s3.endpoint}",
+        "S3_ACCESS_KEY": CREDS.access_key,
+        "S3_SECRET_KEY": CREDS.secret_key,
+        "BUCKET": BUCKET,
+        "DOWNLOAD_DIR": base_dir,
+        "JOB_CONCURRENCY": "1",
+        "PREFETCH": "1",
+        "BATCH_JOBS": "1",
+        "HTTP_SEGMENTS": "1",
+        "S3_MULTIPART_THRESHOLD": str(512 * 1024),
+        "S3_PART_SIZE": str(512 * 1024),
+        "PROFILE": "0",
+        "TSDB_INTERVAL": "0.3",
+        "ALERT_INTERVAL": "off",
+        "LSD": "off",
+        "DHT_BOOTSTRAP": "off",
+        "WATCHDOG_STALL_S": "600",
+        "MAX_JOB_RETRIES": "50",
+        "RETRY_DELAY": "0.3",
+        "RETRY_DELAY_CAP": "1.0",
+        "PUBLISH_CONFIRM_TIMEOUT": "10",
+        "FAILPOINT_SPEC": "",
+        "LOG_LEVEL": "info",
+    }
+
+
+def _declare_topology(channel, topic):
+    channel.declare_exchange(topic)
+    for index in range(2):
+        name = f"{topic}-{index}"
+        channel.declare_queue(name)
+        channel.bind_queue(name, topic, name)
+
+
+def _publish_job(broker, media_id, url):
+    context = tracing.TraceContext.mint()
+    connection = broker.broker.connect()
+    try:
+        channel = connection.channel()
+        _declare_topology(channel, "v1.download")
+        channel.publish(
+            "v1.download",
+            "v1.download-0",
+            Download(media=Media(id=media_id, source_uri=url)).marshal(),
+            headers={
+                tracing.TRACE_CONTEXT_HEADER: context.header_value()
+            },
+            persistent=True,
+        )
+        channel.close()
+    finally:
+        connection.close()
+    return context
+
+
+class _ConvertSink:
+    def __init__(self, broker):
+        self.received = []
+        self._lock = threading.Lock()
+        self._connection = broker.broker.connect()
+        channel = self._connection.channel()
+        channel.set_prefetch(100)
+        _declare_topology(channel, "v1.convert")
+
+        def on_message(message, ch=channel):
+            convert = Convert.unmarshal(message.body)
+            with self._lock:
+                self.received.append(
+                    convert.media.id if convert.media else ""
+                )
+            ch.ack(message.delivery_tag)
+
+        for index in range(2):
+            channel.consume(f"v1.convert-{index}", on_message)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self.received)
+
+    def close(self):
+        self._connection.close()
+
+
+def _fleet_get(port: int, path: str, timeout: float = 10.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        response = conn.getresponse()
+        return response.status, response.read()
+    finally:
+        conn.close()
+
+
+def _zipf_sizes(count: int, mean_bytes: int) -> "list[int]":
+    """An inline zipf(1.1) size ladder (bench.py's generator stays out
+    of this process — it configures logging at import)."""
+    weights = [(rank + 1) ** -1.1 for rank in range(count)]
+    scale = mean_bytes * count / sum(weights)
+    return [max(16 * 1024, int(weight * scale)) for weight in weights]
+
+
+def test_e2e_fleet_flows_zipf_wave_amplification(tmp_path):
+    """The ISSUE 16 acceptance walk: 2 real workers drain a zipf flash
+    crowd in which every object is demanded TWICE. Whichever worker
+    takes which copy, the fleet fetched each object twice to serve one
+    unique copy — so the fleet ``/debug/flows`` must report origin
+    amplification within 10% of the worker count (the per-object MAX
+    merge rule), name the head-of-zipf object as the top heavy hitter,
+    and ``/debug/critpath`` must name the throttled ``fetch`` stage as
+    where the wave's p99 lives."""
+    sizes = _zipf_sizes(6, 48 * 1024)
+    objects = {
+        f"/zipf_{index:03d}.bin": os.urandom(size)
+        for index, size in enumerate(sizes)
+    }
+    total_unique = sum(sizes)
+    with S3Stub(CREDS) as s3, AmqpServerStub() as broker, _FlowOrigin(
+        objects, rate=192 * 1024
+    ) as origin:
+        supervisor = FleetSupervisor(
+            FleetConfig(
+                workers=2,
+                heartbeat_s=0.2,
+                stall_s=30.0,
+                restart_backoff_s=0.1,
+                restart_backoff_cap_s=0.5,
+                start_grace_s=40.0,
+                drain_s=10.0,
+                scrape_timeout_s=2.0,
+            ),
+            worker_env=_worker_env(broker, s3, str(tmp_path)),
+        )
+        sink = None
+        health = None
+        try:
+            supervisor.start()
+            _wait(
+                lambda: all(
+                    slot["ready"]
+                    for slot in supervisor.snapshot()["slots"]
+                ),
+                60.0,
+                "both real workers ready",
+            )
+            sink = _ConvertSink(broker)
+            # the flash crowd: every object published twice
+            expected = set()
+            for index, path in enumerate(sorted(objects)):
+                for copy in ("a", "b"):
+                    media_id = f"zipf-{index}-{copy}"
+                    expected.add(media_id)
+                    _publish_job(broker, media_id, f"{origin.url}{path}")
+            _wait(
+                lambda: set(sink.snapshot()) >= expected,
+                120.0,
+                "the whole zipf wave to complete",
+            )
+
+            health = FleetHealthServer(supervisor, 0, "127.0.0.1").start()
+            status, body = _fleet_get(health.port, "/debug/flows")
+            assert status == 200
+            fleet = json.loads(body)
+            assert fleet["workers"] == 2
+            assert not fleet.get("errors")
+            # each object fetched twice, one unique copy: amplification
+            # within 10% of the worker count
+            assert fleet["unique_bytes"] == total_unique
+            assert fleet["ingress_bytes"] >= 2 * total_unique
+            amplification = fleet["origin_amplification"]
+            assert amplification == pytest.approx(2.0, rel=0.1), (
+                f"fleet amplification {amplification}, want ~2.0"
+            )
+            # the head-of-zipf object is NAMED, not just counted
+            hitters = fleet["heavy_hitters"]
+            assert hitters, "no heavy hitters over a 12-job wave"
+            assert hitters[0]["key"].endswith("zipf_000.bin")
+            assert hitters[0]["bytes"] >= 2 * sizes[0]
+            # the origin host dimension survived the fold
+            assert any(
+                entry["by_kind"].get("mirror")
+                for entry in fleet["origins"].values()
+            ), f"no mirror-lane origin attribution: {fleet['origins']}"
+
+            # the ?hitters= bound caps the fleet listing too
+            status, body = _fleet_get(
+                health.port, "/debug/flows?hitters=2"
+            )
+            assert status == 200
+            assert len(json.loads(body)["heavy_hitters"]) <= 2
+
+            status, body = _fleet_get(health.port, "/debug/critpath")
+            assert status == 200
+            critpath = json.loads(body)
+            assert critpath["workers"] == 2
+            completed = [
+                job for job in critpath["per_job"]
+                if job["status"] == "ok"
+            ]
+            assert len(completed) >= len(expected)
+            assert {job["instance"] for job in critpath["per_job"]} <= {
+                "worker-0", "worker-1",
+            }
+            # the throttled fetch gates the wave: the slow cohort names
+            # it, and it gates every completed job (the chain then
+            # descends INSIDE fetch — the dominant exclusive share
+            # lands on its transfer-loop descendant, naming where the
+            # wait actually lives)
+            assert critpath["slow"]["gating_stage"] == "fetch", (
+                f"slow cohort gated by {critpath['slow']['gating_stage']}"
+            )
+            assert critpath["stages"]["fetch"]["jobs_gated"] >= len(
+                expected
+            ), f"fetch does not gate the wave: {critpath['stages']}"
+            dominant = max(
+                critpath["stages"].items(), key=lambda kv: kv[1]["share"]
+            )[0]
+            fetch_chain_stages = {
+                entry["name"]
+                for job in completed
+                for entry in job["chain"]
+                if entry["depth"] > 0
+            }
+            assert dominant in fetch_chain_stages, (
+                f"dominant stage {dominant} not on the fetch-bound "
+                f"chains: {sorted(fetch_chain_stages)}"
+            )
+
+            if os.environ.get("FLOW_SMOKE_ARTIFACT_DIR"):
+                out_dir = os.environ["FLOW_SMOKE_ARTIFACT_DIR"]
+                os.makedirs(out_dir, exist_ok=True)
+                with open(
+                    os.path.join(out_dir, "flow-smoke.json"), "w"
+                ) as artifact:
+                    json.dump(
+                        {"flows": fleet, "critpath": critpath},
+                        artifact,
+                        indent=1,
+                    )
+        finally:
+            if health is not None:
+                health.stop()
+            if sink is not None:
+                sink.close()
+            supervisor.drain()

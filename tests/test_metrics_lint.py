@@ -95,6 +95,17 @@ def exposition():
             f"lock_wait_seconds_{lock_name}", 0.0005,
             buckets=metrics.LOCK_WAIT_BUCKETS,
         )
+    # the flow-accounting plane's families (utils/flows.py): the byte
+    # counters, the two alert-watched gauges, and one per-origin-host
+    # counter exactly as fetch/sources.py emits it (name-encoded label,
+    # derived HELP) so the lint walks the exposition a populated origin
+    # dimension would get
+    metrics.GLOBAL.add("flow_origin_bytes_total", 4096)
+    metrics.GLOBAL.add("flow_unique_bytes_total", 2048)
+    metrics.GLOBAL.add("flow_egress_bytes_total", 2048)
+    metrics.GLOBAL.gauge_set("flow_origin_amplification", 2.0)
+    metrics.GLOBAL.gauge_set("flow_hot_object_share", 0.5)
+    metrics.GLOBAL.add("source_bytes_total_mirror_origin_cdn_example_com", 4096)
     server = HealthServer(_FakeDaemon(), _FakeClient(), 0)
     try:
         code, body, ctype = server._metrics()
@@ -268,6 +279,51 @@ def test_profiling_families_carry_catalogued_help(exposition):
         "profile_heap_snapshots",
     ):
         assert name in HELP, f"{name} missing from the HELP catalog"
+
+
+def test_flow_families_carry_catalogued_help(exposition):
+    """Every flow-accounting family must have a CATALOGUED HELP line
+    (metrics.HELP) — the amplification/hot-share gauges are watched by
+    stock alert rules, so a missing catalog entry would trip the rule
+    lint below. The per-origin-host counters are the one sanctioned
+    derived-HELP family: their names are minted at runtime from a
+    BOUNDED label registry (flows.origin_label), so the catalog cannot
+    enumerate them — the lint asserts they still render well-formed."""
+    from downloader_tpu.utils.metrics import HELP
+
+    families, _ = _parse(exposition)
+    for name in (
+        "flow_origin_bytes_total",
+        "flow_unique_bytes_total",
+        "flow_egress_bytes_total",
+        "flow_origin_amplification",
+        "flow_hot_object_share",
+    ):
+        assert name in HELP, f"{name} missing from the HELP catalog"
+        exported = f"downloader_{name}"
+        assert exported in families, f"{exported} not exported"
+        assert families[exported]["help"] == HELP[name]
+    per_origin = "downloader_source_bytes_total_mirror_origin_cdn_example_com"
+    assert per_origin in families, "per-origin counter not exported"
+    assert families[per_origin]["type"] == "counter"
+    assert families[per_origin]["help"].strip()
+
+
+def test_flow_alert_rules_in_stock_set():
+    """The two flow rules ride in alerts.default_rules() (the generic
+    rule lint in test_alert_rules_reference_registered_families then
+    holds them to the catalog): amplification burn is page-severity
+    with a sustain window, concentration is a ticket."""
+    from downloader_tpu.utils import alerts, flows
+
+    rules = {rule.name: rule for rule in alerts.default_rules()}
+    burn = rules["origin-amplification-burn"]
+    assert burn.series == "flow_origin_amplification"
+    assert burn.threshold == flows.amplification_alert_from_env()
+    assert burn.for_s == alerts.AMPLIFICATION_BURN_FOR_S
+    hot = rules["hot-object-concentration"]
+    assert hot.series == "flow_hot_object_share"
+    assert hot.severity == "ticket"
 
 
 def test_alert_rules_reference_registered_families(exposition):

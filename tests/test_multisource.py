@@ -350,7 +350,11 @@ class TestFailover:
         from downloader_tpu.store.stub import S3Stub
 
         primary = _Origin(chunk_sleep=0.02)
-        mirror = _Origin()
+        # the mirror is throttled too (like the refetch test above):
+        # an unthrottled mirror can swallow the whole payload before
+        # the slow primary has served the killer's warm threshold,
+        # and the kill then never fires
+        mirror = _Origin(chunk_sleep=0.005)
         creds = Credentials(access_key="k", secret_key="s")
         killer = None
         try:
@@ -374,7 +378,7 @@ class TestFailover:
                 def kill_when_warm():
                     deadline = time.monotonic() + 20
                     while time.monotonic() < deadline:
-                        if primary.served_bytes >= 512 * 1024:
+                        if primary.served_bytes >= 256 * 1024:
                             primary.kill()
                             return
                         time.sleep(0.01)
@@ -395,7 +399,12 @@ class TestFailover:
                 assert (
                     open(job_dir + "/movie.mkv", "rb").read() == PAYLOAD
                 )
-                assert primary.dead.is_set()
+                assert primary.dead.is_set(), (
+                    f"primary served {primary.served_bytes}b over "
+                    f"{len(primary.requests)} requests "
+                    f"(mirror {mirror.served_bytes}b over "
+                    f"{len(mirror.requests)})"
+                )
                 # the acceptance bar: nothing dangling, however the
                 # stream ended (completed or invalidated mid-failover)
                 assert stub.list_multipart_uploads() == []
@@ -415,7 +424,11 @@ class TestFailover:
         mirror retires, the primary finishes the stripe — no job-wide
         single-stream fallback (that is last-source-standing behavior,
         pinned by test_segments)."""
-        primary = _Origin()
+        # the primary is throttled so the mirror stays in the claim
+        # rotation: its range drop must trip on a CLAIMED segment
+        # (the http_source_failovers path), not only in the endgame
+        # race, which retires without counting a failover
+        primary = _Origin(chunk_sleep=0.005)
         flaky = _Origin(drop_ranges_after=1)
         fetcher = make_fetcher()
         try:
