@@ -68,7 +68,15 @@ BENCH_ZIPF_WORKERS sequential cache-less simulated workers plus
 BENCH_ZIPF_REQUESTS seeded zipf replay requests per worker; reports
 fleet origin amplification ≈ worker count from the summed-bytes merge
 beside the ~1.0 naive ratio average; deterministic via
-FAILPOINT_SEED).
+FAILPOINT_SEED),
+BENCH_SINGLEFLIGHT=0 to skip the single-flight coalescing arm
+(BENCH_SINGLEFLIGHT_WORKERS real worker processes draining a zipf
+flash crowd of BENCH_SINGLEFLIGHT_OBJECTS objects — every object
+demanded once per worker, mean size BENCH_SINGLEFLIGHT_BYTES — from
+an origin throttled to BENCH_SINGLEFLIGHT_THROTTLE_MBPS, with the
+shared content-addressed cache off then on; reports origin bytes vs
+demand bytes from the fleet /debug/flows merge: amplification ~W off,
+~1.0 on, plus the cache hit ratio).
 
 On the measurement noise: this box's absolute throughput swings ~3x on
 multi-second timescales (the same configuration has measured 85 and 580
@@ -1945,6 +1953,286 @@ def run_flow_accounting_arm(
     }
 
 
+def run_single_flight_arm(
+    workers: int = 2,
+    objects: int = 3,
+    skew: float = 1.1,
+    mean_bytes: int = 512 * 1024,
+    throttle_mbps: float = 3.0,
+) -> dict:
+    """Single-flight coalescing arm (ISSUE 18): a zipf flash crowd —
+    every object demanded once per worker, sizes zipf-skewed so the
+    hot object carries most of the bytes — drained by a REAL W-worker
+    fleet against a throttled counting origin, once with the shared
+    data plane off and once with it on. The contract numbers come
+    from the fleet ``/debug/flows`` merge: origin bytes (summed
+    ingress) vs demand bytes (ingress + cache-hit lane). Cache off
+    every worker pays the origin for every object it drains, so fleet
+    amplification reads ~W; cache on the elected leader fetches once
+    and the crowd completes from the shared artifact, so origin GETs
+    collapse to ~one per object and amplification reads ~1.0."""
+    import http.client
+    import socketserver
+    import threading as threading_mod
+
+    from downloader_tpu.daemon.fleet import (
+        FleetConfig,
+        FleetHealthServer,
+        FleetSupervisor,
+    )
+    from downloader_tpu.queue.amqp_server import AmqpServerStub
+    from downloader_tpu.store.credentials import Credentials
+    from downloader_tpu.store.stub import S3Stub
+    from downloader_tpu.utils import tracing as tracing_mod
+    from downloader_tpu.utils.failpoints import seed_from_env
+
+    seed = seed_from_env()
+    sizes = zipf_object_sizes(objects, skew, mean_bytes, seed)
+    # .mp4: only media extensions survive the scan stage into S3
+    payloads = {
+        f"/crowd_{index:02d}.mp4": os.urandom(size)
+        for index, size in enumerate(sizes)
+    }
+    rate_bps = int(throttle_mbps * 1e6)
+    creds = Credentials(access_key="bench-ak", secret_key="bench-sk")
+    bucket = "bench-singleflight"
+    demand_bytes = workers * sum(sizes)
+
+    def run_arm(cache_on: bool) -> dict:
+        gets: "dict[str, int]" = {}
+        gets_lock = threading_mod.Lock()
+
+        class _Origin(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def do_HEAD(self):
+                payload = payloads.get(self.path)
+                if payload is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.send_header("Accept-Ranges", "bytes")
+                self.end_headers()
+
+            def do_GET(self):
+                payload = payloads.get(self.path)
+                with gets_lock:
+                    gets[self.path] = gets.get(self.path, 0) + 1
+                if payload is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.send_header("Accept-Ranges", "bytes")
+                self.end_headers()
+                chunk = 64 * 1024
+                for offset in range(0, len(payload), chunk):
+                    piece = payload[offset:offset + chunk]
+                    try:
+                        self.wfile.write(piece)
+                        self.wfile.flush()
+                    except OSError:
+                        return
+                    if rate_bps > 0:
+                        time.sleep(len(piece) / rate_bps)
+
+        origin = socketserver.ThreadingTCPServer(("127.0.0.1", 0), _Origin)
+        origin.daemon_threads = True
+        origin_thread = threading_mod.Thread(
+            target=origin.serve_forever, daemon=True
+        )
+        origin_thread.start()
+        origin_url = f"http://127.0.0.1:{origin.server_address[1]}"
+
+        site = tempfile.mkdtemp(prefix="bench-sf-", dir=_bench_root())
+        s3 = S3Stub(creds).start()
+        broker = AmqpServerStub().start()
+        done: "set[str]" = set()
+        done_lock = threading_mod.Lock()
+        supervisor = None
+        health = None
+        started = time.monotonic()
+        try:
+            sink_conn = broker.broker.connect()
+            sink_channel = sink_conn.channel()
+            sink_channel.set_prefetch(100)
+            for topic in ("v1.download", "v1.convert"):
+                sink_channel.declare_exchange(topic)
+                for index in range(2):
+                    name = f"{topic}-{index}"
+                    sink_channel.declare_queue(name)
+                    sink_channel.bind_queue(name, topic, name)
+
+            def on_convert(message, ch=sink_channel):
+                convert = Convert.unmarshal(message.body)
+                with done_lock:
+                    done.add(convert.media.id if convert.media else "")
+                ch.ack(message.delivery_tag)
+
+            for index in range(2):
+                sink_channel.consume(f"v1.convert-{index}", on_convert)
+
+            supervisor = FleetSupervisor(
+                FleetConfig(
+                    workers=workers,
+                    heartbeat_s=0.2,
+                    stall_s=30.0,
+                    restart_backoff_s=0.1,
+                    restart_backoff_cap_s=0.5,
+                    start_grace_s=60.0,
+                    drain_s=15.0,
+                    scrape_timeout_s=2.0,
+                ),
+                worker_env={
+                    "BROKER": "amqp",
+                    "RABBITMQ_ENDPOINT": broker.endpoint,
+                    "RABBITMQ_USERNAME": "",
+                    "RABBITMQ_PASSWORD": "",
+                    "S3_ENDPOINT": f"http://{s3.endpoint}",
+                    "S3_ACCESS_KEY": creds.access_key,
+                    "S3_SECRET_KEY": creds.secret_key,
+                    "BUCKET": bucket,
+                    "DOWNLOAD_DIR": site,
+                    "JOB_CONCURRENCY": "1",
+                    "PREFETCH": "1",
+                    "BATCH_JOBS": "1",
+                    "HTTP_SEGMENTS": "1",
+                    "S3_MULTIPART_THRESHOLD": str(256 * 1024),
+                    "S3_PART_SIZE": str(256 * 1024),
+                    "PROFILE": "0",
+                    "TSDB_INTERVAL": "off",
+                    "ALERT_INTERVAL": "off",
+                    "LSD": "off",
+                    "DHT_BOOTSTRAP": "off",
+                    "WATCHDOG_STALL_S": "600",
+                    "MAX_JOB_RETRIES": "8",
+                    "RETRY_DELAY": "0.1",
+                    "RETRY_DELAY_CAP": "0.5",
+                    "FAILPOINT_SPEC": "",
+                    "LOG_LEVEL": "error",
+                    "CACHE_DIR": (
+                        os.path.join(site, "shared-cache") if cache_on
+                        else ""
+                    ),
+                    "SINGLEFLIGHT_LEASE_S": "2.0",
+                    "SINGLEFLIGHT_WAIT_S": "120",
+                },
+            )
+            supervisor.start()
+            ready_deadline = time.monotonic() + 60.0
+            while time.monotonic() < ready_deadline and not all(
+                slot["ready"] for slot in supervisor.snapshot()["slots"]
+            ):
+                time.sleep(0.1)
+
+            # the flash crowd: the whole crowd for an object lands
+            # back-to-back, so its copies are in flight on different
+            # workers AT THE SAME TIME — the coalescing scenario, not
+            # a warm-cache replay
+            expected: "set[str]" = set()
+            for index, path in enumerate(sorted(payloads)):
+                for wave in range(workers):
+                    media_id = f"sf-{index}-{wave}"
+                    expected.add(media_id)
+                    context = tracing_mod.TraceContext.mint()
+                    sink_channel.publish(
+                        "v1.download",
+                        "v1.download-0",
+                        Download(
+                            media=Media(
+                                id=media_id,
+                                source_uri=f"{origin_url}{path}",
+                            )
+                        ).marshal(),
+                        headers={
+                            tracing_mod.TRACE_CONTEXT_HEADER: (
+                                context.header_value()
+                            )
+                        },
+                        persistent=True,
+                    )
+
+            drain_deadline = time.monotonic() + 180.0
+            while time.monotonic() < drain_deadline:
+                with done_lock:
+                    if done >= expected:
+                        break
+                time.sleep(0.2)
+            elapsed = time.monotonic() - started
+
+            health = FleetHealthServer(supervisor, 0, "127.0.0.1").start()
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", health.port, timeout=10.0
+            )
+            try:
+                conn.request("GET", "/debug/flows")
+                flows = json.loads(conn.getresponse().read())
+            finally:
+                conn.close()
+            with gets_lock:
+                origin_gets = sum(gets.values())
+            with done_lock:
+                completed = len(done & expected)
+            ingress = flows.get("ingress_bytes", 0)
+            hits = flows.get("cache_hit_bytes", 0)
+            return {
+                "cache": "on" if cache_on else "off",
+                "completed": f"{completed}/{len(expected)}",
+                "elapsed_s": round(elapsed, 2),
+                "origin_gets": origin_gets,
+                "origin_bytes": ingress,
+                "demand_bytes": ingress + hits,
+                "cache_hit_bytes": hits,
+                "amplification": flows.get("origin_amplification"),
+            }
+        finally:
+            if health is not None:
+                health.stop()
+            if supervisor is not None:
+                supervisor.drain()
+            try:
+                sink_conn.close()
+            except Exception:
+                _log("bench: single-flight sink close failed (already gone)")
+            broker.stop()
+            s3.stop()
+            origin.shutdown()
+            origin.server_close()
+            shutil.rmtree(site, ignore_errors=True)
+
+    off = run_arm(cache_on=False)
+    on = run_arm(cache_on=True)
+    hit_denominator = on["cache_hit_bytes"] + on["origin_bytes"]
+    return {
+        "metric": "single_flight",
+        "unit": "ratio",
+        "workers": workers,
+        "objects": objects,
+        "crowd_per_object": workers,
+        "jobs": workers * objects,
+        "skew": skew,
+        "seed": seed,
+        "object_bytes": sizes,
+        "demand_bytes_nominal": demand_bytes,
+        "cache_off": off,
+        "cache_on": on,
+        "cache_hit_ratio": (
+            round(on["cache_hit_bytes"] / hit_denominator, 6)
+            if hit_denominator else None
+        ),
+        "singleflight_amp": on["amplification"],
+        "singleflight_amp_off": off["amplification"],
+    }
+
+
 def main() -> None:
     jobs = int(os.environ.get("BENCH_JOBS", 24))
     mb_per_job = int(os.environ.get("BENCH_MB", 48))
@@ -2312,6 +2600,42 @@ def main() -> None:
                 f"share {flow_accounting['hot_object_share']}"
             )
 
+        single_flight = None
+        if os.environ.get("BENCH_SINGLEFLIGHT", "1") != "0":
+            sf_workers = max(
+                2, int(os.environ.get("BENCH_SINGLEFLIGHT_WORKERS", 2))
+            )
+            sf_objects = max(
+                1, int(os.environ.get("BENCH_SINGLEFLIGHT_OBJECTS", 3))
+            )
+            sf_bytes = max(
+                64 * 1024,
+                int(os.environ.get("BENCH_SINGLEFLIGHT_BYTES", 512 * 1024)),
+            )
+            sf_throttle = float(
+                os.environ.get("BENCH_SINGLEFLIGHT_THROTTLE_MBPS", 3.0)
+            )
+            _log(
+                f"bench: single-flight arm, {sf_workers} worker processes "
+                f"x {sf_objects} zipf-sized objects (mean {sf_bytes} B) "
+                f"demanded once per worker, origin at {sf_throttle:g} MB/s, "
+                "cache off then on"
+            )
+            single_flight = run_single_flight_arm(
+                workers=sf_workers,
+                objects=sf_objects,
+                mean_bytes=sf_bytes,
+                throttle_mbps=sf_throttle,
+            )
+            _log(
+                "bench: single-flight amplification "
+                f"{single_flight['singleflight_amp']} cache-on vs "
+                f"{single_flight['singleflight_amp_off']} cache-off "
+                f"(hit ratio {single_flight['cache_hit_ratio']}, origin "
+                f"GETs {single_flight['cache_on']['origin_gets']} on / "
+                f"{single_flight['cache_off']['origin_gets']} off)"
+            )
+
         extra_metrics = [
             {
                 "metric": "job_overhead_latency_ms",
@@ -2361,6 +2685,8 @@ def main() -> None:
             extra_metrics.append(fleet_scrape)
         if flow_accounting is not None:
             extra_metrics.append(flow_accounting)
+        if single_flight is not None:
+            extra_metrics.append(single_flight)
         if os.environ.get("BENCH_DIGEST", "1") != "0":
             _log("bench: digest kernel micro-benchmark (pallas vs hashlib)")
             try:

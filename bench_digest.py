@@ -123,6 +123,10 @@ def digest_line(report: dict) -> dict:
         elif metric == "flow_accounting":
             out["origin_amplification"] = extra.get("origin_amplification")
             out["hot_object_share"] = extra.get("hot_object_share")
+        elif metric == "single_flight":
+            out["cache_hit_ratio"] = extra.get("cache_hit_ratio")
+            out["singleflight_amp"] = extra.get("singleflight_amp")
+            out["singleflight_amp_off"] = extra.get("singleflight_amp_off")
     return out
 
 

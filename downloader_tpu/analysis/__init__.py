@@ -48,11 +48,12 @@ recorders' patch points, so tier-1 explores perturbed interleavings.
   not let exceptions escape (they kill the worker silently).
 - ``protocol`` — lifecycle typestate: every acquisition of a declared
   protocol (``# protocol: <name> acquire`` / ``release`` on the
-  defining methods; eight seeded — delivery-settle, ledger-charge,
+  defining methods; ten seeded — delivery-settle, ledger-charge,
   cancel-token, watchdog-watch, tracer-trace, source-claim,
-  alert-episode, multipart-upload) must reach a release on every path
-  or provably escape ownership; proven double releases are violations
-  too. The runtime ``ProtocolRecorder`` is the dynamic half.
+  alert-episode, worker-lifecycle, cache-lease, multipart-upload)
+  must reach a release on every path or provably escape ownership;
+  proven double releases are violations too. The runtime
+  ``ProtocolRecorder`` is the dynamic half.
 - ``blocking-deadline`` — every blocking call reachable (through the
   resolved call graph) from daemon/worker code must carry a finite
   timeout, a cancel hook, or a reasoned ``# deadline:`` annotation

@@ -257,6 +257,29 @@ RUNTIME_PROTOCOLS: dict[str, dict] = {
             },
         ],
     },
+    "cache-lease": {
+        "module": "downloader_tpu.fetch.singleflight",
+        "methods": [
+            # the fleet data plane's cross-process election: every
+            # leadership lease a process acquires (fresh or promoted
+            # over a stale owner) must reach exactly one release — a
+            # path that drops a lease strands every coalesced follower
+            # until the TTL expires it
+            {
+                "class": "LeaseRegistry",
+                "name": "acquire_lease",
+                "kind": "acquire",
+                "key": "result",
+                "conditional": True,
+            },
+            {
+                "class": "LeaseRegistry",
+                "name": "release_lease",
+                "kind": "release",
+                "key": "arg:lease",
+            },
+        ],
+    },
     "multipart-upload": {
         "module": "downloader_tpu.store.s3",
         "methods": [

@@ -1353,7 +1353,44 @@ def serve(
         pool_per_host=config.http_pool_per_host,
         pool_idle=config.http_pool_idle,
     ).info("http fetch: segmented ranges + keep-alive pool configured")
-    dispatcher = DispatchClient(token, config.base_dir, backends)
+    # fleet data plane (store/cas.py + fetch/singleflight.py): when a
+    # cache root is configured, both fetch lanes front origin with the
+    # shared content cache + cross-process single-flight election. The
+    # registry pins the lease index under the cache root unless the
+    # supervisor handed down an explicit SINGLEFLIGHT_DIR.
+    data_plane = None
+    if config.cache_dir:
+        from ..fetch.singleflight import (
+            CoalescingDataPlane,
+            LeaseRegistry,
+            activate,
+        )
+        from ..store.cas import ContentStore
+
+        registry = LeaseRegistry(
+            config.singleflight_dir
+            or os.path.join(os.path.abspath(config.cache_dir), "inflight"),
+            lease_ttl_s=config.singleflight_lease_s,
+            instance=config.instance,
+        )
+        content_store = ContentStore(
+            config.cache_dir,
+            max_bytes=config.cache_max_bytes,
+            ttl_s=config.cache_ttl_s,
+            pinned=registry.is_leased,
+        )
+        data_plane = CoalescingDataPlane(
+            content_store, registry, wait_s=config.singleflight_wait_s
+        )
+        activate(data_plane)
+        log.with_fields(
+            cache_dir=config.cache_dir,
+            max_bytes=config.cache_max_bytes,
+            lease_s=config.singleflight_lease_s,
+        ).info("fleet data plane: content cache + single-flight armed")
+    dispatcher = DispatchClient(
+        token, config.base_dir, backends, data_plane=data_plane
+    )
     uploader = Uploader.from_env(config.bucket)
 
     daemon = Daemon(token, client, dispatcher, uploader, config)
@@ -1393,4 +1430,11 @@ def serve(
             backend_close = getattr(backend, "close", None)
             if backend_close is not None:
                 backend_close()
+        if data_plane is not None:
+            from ..fetch.singleflight import activate
+
+            activate(None)
+            # refunds this process's ledger charges; entries stay on
+            # shared disk as idle capacity for the next life
+            data_plane.store.close()
     return 0

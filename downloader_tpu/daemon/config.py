@@ -132,6 +132,16 @@ class Config:
     # liveness verdicts. Empty = not a fleet member, no thread.
     fleet_heartbeat_file: str = ""
     fleet_heartbeat_s: float = 1.0
+    # fleet data plane (store/cas.py + fetch/singleflight.py): the
+    # shared content-addressed cache + single-flight election both
+    # fetch lanes front when cache_dir is set. Empty = disabled, every
+    # fetch goes to origin (the pre-data-plane behavior).
+    cache_dir: str = ""
+    cache_max_bytes: int = 2 * 1024**3
+    cache_ttl_s: float = 24 * 3600.0
+    singleflight_dir: str = ""  # empty derives <cache_dir>/inflight
+    singleflight_lease_s: float = 10.0
+    singleflight_wait_s: float = 120.0
 
     @property
     def dead_letter_queue(self) -> str:
@@ -262,4 +272,13 @@ class Config:
             env.get("FLEET_HEARTBEAT_FILE") or ""
         ).strip()
         config.fleet_heartbeat_s = heartbeat_from_env(env)
+        from ..fetch import singleflight
+        from ..store import cas
+
+        config.cache_dir = cas.dir_from_env(env)
+        config.cache_max_bytes = cas.max_bytes_from_env(env)
+        config.cache_ttl_s = cas.ttl_from_env(env)
+        config.singleflight_dir = singleflight.inflight_dir_from_env(env)
+        config.singleflight_lease_s = singleflight.lease_ttl_from_env(env)
+        config.singleflight_wait_s = singleflight.wait_from_env(env)
         return config

@@ -460,6 +460,16 @@ class FleetSupervisor:
                 "FLEET_WORKERS": "0",
             }
         )
+        # fleet data plane coordination: when a cache root is
+        # configured, every worker must agree on ONE on-disk store and
+        # ONE lease index regardless of its own cwd — the supervisor
+        # pins both paths absolute before the fork (see store/cas.py)
+        cache_dir = (env.get("CACHE_DIR") or "").strip()
+        if cache_dir:
+            cache_dir = os.path.abspath(cache_dir)
+            env["CACHE_DIR"] = cache_dir
+            if not (env.get("SINGLEFLIGHT_DIR") or "").strip():
+                env["SINGLEFLIGHT_DIR"] = os.path.join(cache_dir, "inflight")
         # the package must be importable in the child even when the
         # parent was launched from an arbitrary cwd (zipapp, test run)
         package_root = os.path.dirname(
@@ -973,7 +983,8 @@ class FleetHealthServer:
                             path[len("/debug/incidents/"):]
                         )
                     elif path in (
-                        "/debug/watchdog", "/debug/admission", "/debug/jobs"
+                        "/debug/watchdog", "/debug/admission",
+                        "/debug/jobs", "/debug/cache",
                     ):
                         code, body, ctype = plane.debug_passthrough(path)
                     else:

@@ -122,6 +122,8 @@ class HealthServer:
                         code, body, ctype = health._debug_exemplars()
                     elif path == "/debug/flows":
                         code, body, ctype = health._debug_flows(query)
+                    elif path == "/debug/cache":
+                        code, body, ctype = health._debug_cache()
                     elif path == "/debug/critpath":
                         code, body, ctype = health._debug_critpath()
                     elif path == "/debug/incidents":
@@ -405,6 +407,22 @@ class HealthServer:
         return (
             200,
             (json.dumps(payload, indent=1) + "\n").encode(),
+            "application/json",
+        )
+
+    def _debug_cache(self) -> tuple[int, bytes, str]:
+        """The fleet data plane's store + lease index (store/cas.py,
+        fetch/singleflight.py): entry counts and bytes, hit/miss/
+        eviction counters, and every live lease with its owner and
+        heartbeat age. ``{"enabled": false}`` when no CACHE_DIR is
+        configured."""
+        from ..fetch import singleflight
+
+        return (
+            200,
+            (
+                json.dumps(singleflight.debug_snapshot(), indent=1) + "\n"
+            ).encode(),
             "application/json",
         )
 

@@ -93,12 +93,17 @@ class DispatchClient:
         base_dir: str,
         backends: list[Backend],
         progress_interval: float = 5.0,
+        data_plane=None,
     ):
         if not base_dir or not os.path.isabs(base_dir):
             # reference rejects relative baseDir (downloader.go:76-78)
             raise ValueError("invalid base_dir: must be absolute")
         self._base_dir = base_dir
         self._token = token
+        # fleet data plane (fetch/singleflight.py): when configured,
+        # both lanes front their fetches with the shared content cache
+        # + single-flight election; None = every fetch goes to origin
+        self._data_plane = data_plane
         self._by_protocol: dict[str, list[Backend]] = {}
         self._by_extension: dict[str, list[Backend]] = {}
         self._progress = _Progress()
@@ -202,10 +207,17 @@ class DispatchClient:
             with tracing.span(
                 "backend", backend=backend.register().name, fast_path=True
             ):
-                done = fetch_small(
-                    token or self._token, job_dir, self._progress.update,
-                    url, max_bytes,
-                )
+                plane = self._data_plane
+                if plane is not None and plane.covers(backend, url):
+                    done = plane.fetch_small(
+                        backend, token or self._token, job_dir,
+                        self._progress.update, url, max_bytes,
+                    )
+                else:
+                    done = fetch_small(
+                        token or self._token, job_dir, self._progress.update,
+                        url, max_bytes,
+                    )
         finally:
             self._progress.update(url, 100.0)
         return job_dir if done else None
@@ -242,6 +254,16 @@ class DispatchClient:
             with tracing.span(
                 "backend", backend=backend.register().name
             ):
+                plane = self._data_plane
+                if plane is not None and plane.covers(backend, url):
+                    # served from cache or a coalesced fetch; a False
+                    # return (wait timeout, index failure) falls back
+                    # to the plain direct fetch below
+                    if plane.download(
+                        backend, token or self._token, job_dir,
+                        self._progress.update, url, mirrors=tuple(mirrors),
+                    ):
+                        return job_dir
                 if mirrors and getattr(backend, "supports_mirrors", False):
                     backend.download(
                         token or self._token, job_dir,

@@ -222,6 +222,10 @@ class HTTPBackend:
     # MIRROR_URLS config fallback) to download(); the segmented fetcher
     # races byte spans across every admitted mirror
     supports_mirrors = True
+    # http(s) artifacts are content-stable per normalized URL, so the
+    # fleet data plane (fetch/singleflight.py) may front this backend
+    # with the shared content cache + single-flight election
+    supports_cache = True
 
     def __init__(
         self,

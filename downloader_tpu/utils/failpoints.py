@@ -62,6 +62,18 @@ documents each with its natural failure):
 ``daemon.pre_ack``  after the confirmed publish, before the ack
                     (crash-matrix boundary: duplicate-delivery window)
 ``device.init``     inside the accelerator init probe (wedge target)
+``cas.lookup``      content-cache entry probe (store/cas.py): fail =
+                    forced miss (the unreadable-entry path)
+``cas.put``         content-cache write-through admission: fail =
+                    ENOSPC (the job completes uncached); kill dies
+                    between fetch-complete and the entry landing
+``coalesce.join``   a follower subscribing to an in-flight leader's
+                    fetch (fetch/singleflight.py): fail degrades to a
+                    direct uncoalesced fetch
+``coalesce.lead``   the moment of lease election/promotion: fail =
+                    lease-index IO error (degrades to direct fetch);
+                    kill dies HOLDING the lease, forcing a follower
+                    promotion
 ==================  ====================================================
 
 Wired in ``serve()`` from the environment; tests drive

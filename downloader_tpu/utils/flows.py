@@ -311,6 +311,10 @@ class FlowLedger:
         self._ingress_total = 0  # guarded-by: _lock
         self._unique_total = 0  # guarded-by: _lock
         self._egress_total = 0  # guarded-by: _lock
+        # bytes served from the shared content cache (the fleet data
+        # plane): they enter the ratio only through note_unique — this
+        # lane exists so the snapshot can show HOW demand was met
+        self._cache_hit_total = 0  # guarded-by: _lock
         # the ratio's inputs, TRACKED objects only: the overflow bucket
         # cannot dedupe re-fetches per stranger (no per-key state past
         # the bound), so folding it into the ratio would let a merely
@@ -364,6 +368,7 @@ class FlowLedger:
             self._ingress_total = 0
             self._unique_total = 0
             self._egress_total = 0
+            self._cache_hit_total = 0
             self._tracked_demand = 0
             self._tracked_unique = 0
             self._top_bytes = 0
@@ -444,6 +449,18 @@ class FlowLedger:
         metrics.GLOBAL.add("flow_unique_bytes_total", delta)
         metrics.GLOBAL.gauge_set("flow_origin_amplification", amplification)
 
+    def note_cache_hit(self, obj: str, count: int) -> None:
+        """``count`` bytes of object ``obj`` served from the shared
+        content cache instead of any origin. Pair with
+        :meth:`note_unique` — a cache serve is a unique-object serve
+        (the amplification denominator grows, the origin numerator
+        does not, which is the data plane's whole claim)."""
+        if not self.enabled or count <= 0:
+            return
+        with self._lock:
+            self._cache_hit_total += count
+        metrics.GLOBAL.add("flow_cache_hit_bytes_total", count)
+
     def note_egress(self, obj: str, count: int) -> None:
         """``count`` bytes shipped downstream (an uploaded part) for
         object ``obj``."""
@@ -500,6 +517,7 @@ class FlowLedger:
                 "ingress_bytes": self._ingress_total,
                 "unique_bytes": self._unique_total,
                 "egress_bytes": self._egress_total,
+                "cache_hit_bytes": self._cache_hit_total,
                 "origin_amplification": round(amplification, 6),
                 "hot_object_share": round(hot_share, 6),
                 "origins": origins,
@@ -530,6 +548,7 @@ def merge_flow_snapshots(per_instance: "dict[str, dict]") -> dict:
     exists to expose."""
     ingress = 0
     egress = 0
+    cache_hit = 0
     origins: "dict[str, dict]" = {}
     # object key -> [demand summed, unique maxed, egress summed]
     objects: "dict[str, list]" = {}
@@ -540,6 +559,7 @@ def merge_flow_snapshots(per_instance: "dict[str, dict]") -> dict:
             continue
         ingress += int(snap.get("ingress_bytes", 0))
         egress += int(snap.get("egress_bytes", 0))
+        cache_hit += int(snap.get("cache_hit_bytes", 0))
         for host, entry in (snap.get("origins") or {}).items():
             folded = origins.setdefault(
                 host, {"ingress_bytes": 0, "by_kind": {}}
@@ -561,6 +581,7 @@ def merge_flow_snapshots(per_instance: "dict[str, dict]") -> dict:
         instances[instance] = {
             "ingress_bytes": int(snap.get("ingress_bytes", 0)),
             "unique_bytes": int(snap.get("unique_bytes", 0)),
+            "cache_hit_bytes": int(snap.get("cache_hit_bytes", 0)),
             "origin_amplification": snap.get("origin_amplification", 0.0),
         }
     unique = sum(slot[1] for slot in objects.values())
@@ -581,6 +602,7 @@ def merge_flow_snapshots(per_instance: "dict[str, dict]") -> dict:
         "ingress_bytes": ingress,
         "unique_bytes": unique,
         "egress_bytes": egress,
+        "cache_hit_bytes": cache_hit,
         "origin_amplification": (
             round(tracked_demand / tracked_unique, 6)
             if tracked_unique > 0

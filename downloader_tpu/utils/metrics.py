@@ -159,6 +159,67 @@ HELP = {
         "share of all ingress bytes attributed to the single hottest "
         "object (heavy-hitter sketch top estimate over total)"
     ),
+    "flow_cache_hit_bytes_total": (
+        "bytes served from the shared content-addressed cache instead "
+        "of an origin (fleet data plane; these enter demand but not "
+        "origin ingress, so they pull amplification toward 1.0)"
+    ),
+    # fleet data plane (store/cas.py + fetch/singleflight.py)
+    "cache_hits_total": (
+        "content-addressed cache lookups served from a verified "
+        "on-disk entry"
+    ),
+    "cache_misses_total": (
+        "content-addressed cache lookups that found no fresh entry "
+        "(includes TTL-expired and corrupt-evicted entries)"
+    ),
+    "cache_hit_bytes_total": (
+        "object bytes served from the content-addressed cache"
+    ),
+    "cache_puts_total": (
+        "objects admitted into the content-addressed cache "
+        "(write-through after an origin fetch)"
+    ),
+    "cache_put_bytes_total": (
+        "object bytes written into the content-addressed cache"
+    ),
+    "cache_evictions_total": (
+        "cache entries evicted (LRU under the byte budget, TTL sweep, "
+        "corrupt, or torn-put cleanup)"
+    ),
+    "cache_corrupt_evictions_total": (
+        "cache entries evicted because their content digest no longer "
+        "matched the recorded sha256 (never served; refetched instead)"
+    ),
+    "cache_admit_refusals_total": (
+        "cache admissions refused (object too large for the budget, or "
+        "the admission ledger denied scratch-disk charge and every "
+        "remaining entry was lease-pinned)"
+    ),
+    "cache_entries": "live entries in the content-addressed cache",
+    "cache_bytes": (
+        "bytes currently held by the content-addressed cache"
+    ),
+    "singleflight_leads_total": (
+        "single-flight elections won: this process became the one "
+        "origin fetcher for a content key"
+    ),
+    "singleflight_joins_total": (
+        "single-flight elections lost: this process waited on another "
+        "worker's in-flight fetch instead of hitting the origin"
+    ),
+    "singleflight_promotions_total": (
+        "followers promoted to leader after a lease expired (previous "
+        "leader died or stalled mid-fetch)"
+    ),
+    "singleflight_wait_timeouts_total": (
+        "single-flight followers that gave up waiting and degraded to "
+        "a direct origin fetch (SINGLEFLIGHT_WAIT_S exceeded)"
+    ),
+    "singleflight_wait_seconds": (
+        "seconds a single-flight follower waited before its object "
+        "was served from the shared cache"
+    ),
     "source_demotions_total_mirror": (
         "mirror sources demoted to the trickle lane (slow or erroring; "
         "recovery re-promotes)"

@@ -28,9 +28,26 @@ sys.path.insert(0, REPO)
 DEFAULT_SEEDS = (509, 1307, 9001)
 DEFAULT_SPEC = (
     "s3.part_put=fail:0.15,queue.publish=fail:0.2,"
-    "net.connect=fail:0.05,http.read=fail:0.1"
+    "net.connect=fail:0.05,http.read=fail:0.1,"
+    # the fleet data plane's seams ride the same spec: the hermetic
+    # pipeline below runs without a cache (0 injections is expected),
+    # but the schedule fingerprint still receipts their determinism,
+    # and the SIGKILL-mid-coalesce cell exercises them for real
+    "cas.lookup=fail:0.2,cas.put=fail:0.2,"
+    "coalesce.join=fail:0.2,coalesce.lead=fail:0.1"
 )
-SITES = ("s3.part_put", "queue.publish", "net.connect", "http.read")
+SITES = (
+    "s3.part_put", "queue.publish", "net.connect", "http.read",
+    "cas.lookup", "cas.put", "coalesce.join", "coalesce.lead",
+)
+# the cell that cannot run in-process: the whole point is that the
+# elected coalesce LEADER process dies (SIGKILL, no finally blocks)
+# while followers wait on its lease
+COALESCE_KILL_TEST = (
+    "tests/test_singleflight.py::"
+    "test_e2e_chaos_sigkill_coalesce_leader_promotes_follower"
+)
+COALESCE_KILL_SPEC = "segments.pwrite=kill:1:16"
 
 
 def schedule_fingerprint(registry, sites, calls: int = 200) -> str:
@@ -110,6 +127,43 @@ def run_seed(seed: int, spec: str, jobs: int) -> dict:
     }
 
 
+def run_coalesce_kill_cell(seed: int = 509) -> dict:
+    """SIGKILL-mid-coalesce: a real 2-worker fleet elects a leader for
+    a flash crowd of identical jobs and a seeded kill failpoint SIGKILLs
+    it mid-multipart; the cell passes iff a follower promotes itself,
+    every job completes under its ORIGINAL trace id, the fleet ends
+    with ``list_multipart_uploads() == []``, and the ledger balances to
+    zero (the suite's autouse teardown). Runs the e2e acceptance in a
+    subprocess fleet because kill mode must take a worker PROCESS."""
+    import subprocess
+
+    started = time.monotonic()
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["FAILPOINT_SEED"] = str(seed)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest", "-q",
+            "-p", "no:cacheprovider", "-p", "no:randomly",
+            COALESCE_KILL_TEST,
+        ],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    tail = "\n".join(
+        (proc.stdout + proc.stderr).strip().splitlines()[-12:]
+    )
+    return {
+        "cell": "sigkill-mid-coalesce",
+        "seed": seed,
+        "spec": COALESCE_KILL_SPEC,
+        "test": COALESCE_KILL_TEST,
+        "elapsed_s": round(time.monotonic() - started, 2),
+        "rc": proc.returncode,
+        "tail": tail,
+        "ok": proc.returncode == 0,
+    }
+
+
 def main(argv) -> int:
     if len(argv) < 2:
         print(__doc__, file=sys.stderr)
@@ -124,6 +178,7 @@ def main(argv) -> int:
     with open(payload_path, "wb") as sink:
         sink.write(os.urandom(256 * 1024))
     rows = []
+    coalesce_cell = None
     try:
         for seed in seeds:
             print(f"failpoint-matrix: seed {seed} ...", flush=True)
@@ -147,6 +202,13 @@ def main(argv) -> int:
                 f"seed {seed} schedule not reproducible: "
                 f"{replay} != {row['schedule_fingerprint']}"
             )
+        print("failpoint-matrix: sigkill-mid-coalesce cell ...", flush=True)
+        coalesce_cell = run_coalesce_kill_cell()
+        print(
+            "failpoint-matrix: sigkill-mid-coalesce -> "
+            f"rc={coalesce_cell['rc']}, ok={coalesce_cell['ok']}",
+            flush=True,
+        )
     finally:
         try:
             os.unlink(payload_path)
@@ -155,9 +217,17 @@ def main(argv) -> int:
         with open(
             os.path.join(outdir, "failpoint_matrix.json"), "w"
         ) as sink:
-            json.dump({"spec": spec, "jobs": jobs, "seeds": rows}, sink,
-                      indent=1)
-    return 0 if rows and all(row["ok"] for row in rows) else 1
+            json.dump(
+                {
+                    "spec": spec,
+                    "jobs": jobs,
+                    "seeds": rows,
+                    "sigkill_mid_coalesce": coalesce_cell,
+                },
+                sink, indent=1,
+            )
+    ok = rows and all(row["ok"] for row in rows)
+    return 0 if ok and coalesce_cell and coalesce_cell["ok"] else 1
 
 
 if __name__ == "__main__":

@@ -58,6 +58,7 @@ _SCHEDULE_SHAKE_MODULES = {
     "test_pipeline",
     "test_batch",
     "test_admission",
+    "test_singleflight",
 }
 
 import pytest  # noqa: E402
@@ -152,6 +153,9 @@ _PROTOCOL_MODULES = {
     # the fleet's worker-lifecycle (spawn -> ready -> draining ->
     # reaped): every worker process a test spawns must be reaped
     "test_fleet",
+    # the fleet data plane's cache-lease lifecycle (single-flight
+    # election): every acquired lease must be released on every path
+    "test_singleflight",
 }
 
 

@@ -469,3 +469,61 @@ def test_bench_digest_picks_up_fleet_scrape_arm():
     assert digest["fleet_scrape_ms"] == 2.1
     assert digest["fleet_scrape_wedged_ms"] == 503.0
     assert digest["fleet_scrape_budget_ok"] is True
+
+
+def test_circleci_runs_single_flight_smoke_and_artifact():
+    """The fleet data plane's CI surface (ISSUE 18): the flash-crowd
+    e2e — K identical jobs against a throttled origin cost exactly ONE
+    origin GET with fleet /debug/flows amplification ~1.0 — runs as a
+    named step, and the flows/cache snapshot the test writes is
+    uploaded as an artifact."""
+    yaml = pytest.importorskip("yaml")
+    ci = yaml.safe_load(CONFIG.read_text())
+    steps = ci["jobs"]["tests"]["steps"]
+    commands = " ".join(
+        s["run"]["command"]
+        for s in steps
+        if isinstance(s, dict) and "run" in s
+    )
+    assert (
+        "test_singleflight.py::"
+        "test_e2e_single_flight_flash_crowd_one_origin_fetch"
+        in commands
+    )
+    assert "SINGLEFLIGHT_SMOKE_ARTIFACT_DIR=/tmp/singleflight" in commands
+    artifact_paths = [
+        s["store_artifacts"]["path"]
+        for s in steps
+        if isinstance(s, dict) and "store_artifacts" in s
+    ]
+    assert "/tmp/singleflight" in artifact_paths
+
+
+def test_bench_digest_picks_up_single_flight_arm():
+    """The single-flight arm's contract numbers — cache hit ratio and
+    fleet amplification at cache on vs off — must survive into the
+    digest line."""
+    import sys
+
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench_digest
+    finally:
+        sys.path.remove(str(REPO))
+
+    report = {
+        "value": 100.0,
+        "extra_metrics": [
+            {
+                "metric": "single_flight",
+                "workers": 2,
+                "cache_hit_ratio": 0.5,
+                "singleflight_amp": 1.0,
+                "singleflight_amp_off": 2.0,
+            }
+        ],
+    }
+    digest = bench_digest.digest_line(report)
+    assert digest["cache_hit_ratio"] == 0.5
+    assert digest["singleflight_amp"] == 1.0
+    assert digest["singleflight_amp_off"] == 2.0

@@ -106,6 +106,26 @@ def exposition():
     metrics.GLOBAL.gauge_set("flow_origin_amplification", 2.0)
     metrics.GLOBAL.gauge_set("flow_hot_object_share", 0.5)
     metrics.GLOBAL.add("source_bytes_total_mirror_origin_cdn_example_com", 4096)
+    # the fleet data plane's families (store/cas.py + fetch/
+    # singleflight.py): cache counters/gauges, the coalescing
+    # election counters, the follower-wait histogram, and the
+    # cache-served flow lane
+    metrics.GLOBAL.add("flow_cache_hit_bytes_total", 2048)
+    metrics.GLOBAL.add("cache_hits_total", 2)
+    metrics.GLOBAL.add("cache_misses_total", 1)
+    metrics.GLOBAL.add("cache_hit_bytes_total", 2048)
+    metrics.GLOBAL.add("cache_puts_total", 1)
+    metrics.GLOBAL.add("cache_put_bytes_total", 1024)
+    metrics.GLOBAL.add("cache_evictions_total", 1)
+    metrics.GLOBAL.add("cache_corrupt_evictions_total", 1)
+    metrics.GLOBAL.add("cache_admit_refusals_total", 1)
+    metrics.GLOBAL.gauge_set("cache_entries", 1)
+    metrics.GLOBAL.gauge_set("cache_bytes", 1024)
+    metrics.GLOBAL.add("singleflight_leads_total", 1)
+    metrics.GLOBAL.add("singleflight_joins_total", 2)
+    metrics.GLOBAL.add("singleflight_promotions_total", 1)
+    metrics.GLOBAL.add("singleflight_wait_timeouts_total", 1)
+    metrics.GLOBAL.observe("singleflight_wait_seconds", 0.25)
     server = HealthServer(_FakeDaemon(), _FakeClient(), 0)
     try:
         code, body, ctype = server._metrics()
@@ -307,6 +327,45 @@ def test_flow_families_carry_catalogued_help(exposition):
     assert per_origin in families, "per-origin counter not exported"
     assert families[per_origin]["type"] == "counter"
     assert families[per_origin]["help"].strip()
+
+
+def test_cache_families_carry_catalogued_help(exposition):
+    """Every fleet-data-plane family — the content-addressed cache's
+    counters and gauges, the single-flight election counters, the
+    follower-wait histogram, and the cache-served flow lane — must
+    carry a CATALOGUED HELP line (metrics.HELP), not the derived
+    fallback; these are the series the bench digest and the CI
+    single-flight smoke read."""
+    from downloader_tpu.utils.metrics import HELP
+
+    families, _ = _parse(exposition)
+    for name in (
+        "flow_cache_hit_bytes_total",
+        "cache_hits_total",
+        "cache_misses_total",
+        "cache_hit_bytes_total",
+        "cache_puts_total",
+        "cache_put_bytes_total",
+        "cache_evictions_total",
+        "cache_corrupt_evictions_total",
+        "cache_admit_refusals_total",
+        "cache_entries",
+        "cache_bytes",
+        "singleflight_leads_total",
+        "singleflight_joins_total",
+        "singleflight_promotions_total",
+        "singleflight_wait_timeouts_total",
+        "singleflight_wait_seconds",
+    ):
+        assert name in HELP, f"{name} missing from the HELP catalog"
+        exported = f"downloader_{name}"
+        assert exported in families, f"{exported} not exported"
+        assert families[exported]["help"] == HELP[name]
+    assert families["downloader_singleflight_wait_seconds"]["type"] == (
+        "histogram"
+    )
+    for gauge in ("downloader_cache_entries", "downloader_cache_bytes"):
+        assert families[gauge]["type"] == "gauge"
 
 
 def test_flow_alert_rules_in_stock_set():

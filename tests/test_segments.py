@@ -56,6 +56,7 @@ class RangeHandler(http.server.BaseHTTPRequestHandler):
     requests: dict = {}
     head_requests: list = []
     drop_honored = 0
+    throttle_s = 0.0  # per-64KB-chunk sleep; loopback is ~instant
 
     def log_message(self, *args):
         pass
@@ -92,7 +93,17 @@ class RangeHandler(http.server.BaseHTTPRequestHandler):
             self.send_response(200)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
-        self.wfile.write(body)
+        if RangeHandler.throttle_s > 0:
+            chunk = 64 * 1024
+            for offset in range(0, len(body), chunk):
+                try:
+                    self.wfile.write(body[offset:offset + chunk])
+                    self.wfile.flush()
+                except OSError:
+                    return
+                time.sleep(RangeHandler.throttle_s)
+        else:
+            self.wfile.write(body)
 
 
 @pytest.fixture(scope="module")
@@ -109,6 +120,7 @@ def _reset_handler_state():
     RangeHandler.requests = {}
     RangeHandler.head_requests = []
     RangeHandler.drop_honored = 0
+    RangeHandler.throttle_s = 0.0
 
 
 def make_backend(segments=4, **kwargs):
@@ -558,8 +570,12 @@ class TestResume:
             token.cancel()
 
         backend = make_backend()
-        # the progress throttle interval is 0.01 s, so the token
-        # cancels early in the stripe; the journal must survive
+        # throttle the origin so the stripe is guaranteed to still be
+        # mid-flight when the first progress tick (interval 0.01 s)
+        # fires the cancel — unthrottled, the 3 MB payload can finish
+        # over loopback before any worker re-checks the token, and the
+        # raises-Cancelled expectation below turns into a coin flip
+        RangeHandler.throttle_s = 0.02
         with pytest.raises(Cancelled):
             backend.download(
                 token, str(tmp_path), cancel_on_progress,

@@ -1,0 +1,972 @@
+"""Fleet data plane (store/cas.py + fetch/singleflight.py, ISSUE 18).
+
+Four layers:
+
+- content identity: ``content_key`` coalesces trivially-different
+  spellings of one object (case, default ports, fragments; magnet
+  links collapse to their infohash) while keeping distinct objects
+  distinct (query strings are significant);
+- the content-addressed store: verified round-trips, LRU ordering
+  under the byte bound, TTL expiry, corrupt entries evicted and never
+  served, lease-pinned entries never evicted (a full-of-pinned store
+  REFUSES admission), ledger accounting that balances to zero through
+  eviction and ``close()``;
+- the election: one leader per key, nonce-checked release (a zombie
+  cannot tear down its successor), stale-lease promotion, and the
+  in-process two-thread coalesce proof — one backend fetch serves two
+  concurrent jobs, plus every failpoint seam's degrade path (forced
+  miss, ENOSPC write-through, join/lead failures fall back to plain
+  direct fetches);
+- the e2e acceptances: a real 2-worker fleet drains a flash crowd of
+  identical jobs with ONE origin GET and fleet amplification ~1.0
+  (the CI single-flight smoke), and a seeded SIGKILL of the coalesce
+  leader mid-multipart promotes a follower that completes every job
+  under its ORIGINAL trace id with zero dangling multiparts.
+"""
+
+import http.client
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from downloader_tpu.daemon.fleet import (
+    FleetConfig,
+    FleetHealthServer,
+    FleetSupervisor,
+)
+from downloader_tpu.fetch import singleflight
+from downloader_tpu.fetch.singleflight import (
+    CoalescingDataPlane,
+    LeaseRegistry,
+)
+from downloader_tpu.queue.amqp_server import AmqpServerStub
+from downloader_tpu.store.cas import ContentStore, content_key
+from downloader_tpu.store.credentials import Credentials
+from downloader_tpu.store.stub import S3Stub
+from downloader_tpu.utils import admission, failpoints, metrics, tracing
+from downloader_tpu.wire import Convert, Download, Media
+
+CREDS = Credentials(access_key="ak", secret_key="sk")
+BUCKET = "cache-bkt"
+
+
+def _wait(predicate, timeout: float, what: str, interval: float = 0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _counter(name: str) -> float:
+    return metrics.GLOBAL.snapshot().get(name, 0)
+
+
+# -- content identity ---------------------------------------------------------
+
+
+def test_content_key_normalizes_equivalent_spellings():
+    base = content_key("http://example.com/a/b?q=1")
+    assert content_key("HTTP://Example.com:80/a/b?q=1") == base
+    assert content_key("http://example.com/a/b?q=1#frag") == base
+    assert content_key("https://example.com/a/b?q=1") != base
+    assert content_key("http://example.com:8080/a/b?q=1") != base
+    assert content_key("http://example.com/a/b?q=2") != base
+    assert content_key("http://example.com/a/c?q=1") != base
+
+
+def test_content_key_magnet_collapses_to_infohash():
+    infohash = "C0FFEE" + "0" * 34
+    one = content_key(
+        f"magnet:?xt=urn:btih:{infohash}&dn=name-a&tr=http://t1/a"
+    )
+    two = content_key(
+        f"magnet:?xt=urn:btih:{infohash.lower()}&dn=name-b&tr=http://t2/a"
+    )
+    assert one == two
+    assert content_key("magnet:?xt=urn:btih:" + "1" * 40) != one
+
+
+# -- the content-addressed store ----------------------------------------------
+
+
+@pytest.fixture
+def store(tmp_path):
+    cache = ContentStore(
+        str(tmp_path / "cache"), max_bytes=64 * 1024 * 1024, ttl_s=3600.0
+    )
+    yield cache
+    cache.close()
+
+
+def _put(cache, key, payload, name="artifact.bin", tmp_dir="/tmp"):
+    source = os.path.join(tmp_dir, f"src-{key[:8]}")
+    with open(source, "wb") as fh:
+        fh.write(payload)
+    try:
+        return cache.put(key, source, url="http://o/x", name=name)
+    finally:
+        os.unlink(source)
+
+
+def test_store_round_trip_verifies_and_serves(store, tmp_path):
+    payload = os.urandom(4096)
+    key = content_key("http://origin/hot.mp4")
+    assert store.lookup(key) is None  # cold miss
+    assert _put(store, key, payload, name="hot.bin", tmp_dir=str(tmp_path))
+    hit = store.lookup(key)
+    assert hit is not None
+    assert hit.name == "hot.bin"
+    assert hit.size == len(payload)
+    with open(hit.path, "rb") as fh:
+        assert fh.read() == payload
+    snap = store.snapshot()
+    assert snap["entries"] == 1
+    assert snap["bytes"] == len(payload)
+    assert snap["hits"] == 1 and snap["misses"] == 1
+
+
+def test_store_corrupt_entry_evicted_never_served(store, tmp_path):
+    payload = os.urandom(4096)
+    key = content_key("http://origin/corrupt.bin")
+    assert _put(store, key, payload, tmp_dir=str(tmp_path))
+    # flip the stored bytes behind the meta's back (same size, so only
+    # the digest verify can catch it)
+    data_path = store.lookup(key).path
+    with open(data_path, "r+b") as fh:
+        fh.write(b"\x00" * 16)
+    before = _counter("cache_corrupt_evictions_total")
+    assert store.lookup(key) is None, "a corrupt entry must never serve"
+    assert _counter("cache_corrupt_evictions_total") == before + 1
+    assert not os.path.exists(data_path)
+    # the refetch path admits cleanly again
+    assert _put(store, key, payload, tmp_dir=str(tmp_path))
+    assert store.lookup(key) is not None
+
+
+def test_store_ttl_expiry_evicts(store, tmp_path):
+    payload = os.urandom(1024)
+    key = content_key("http://origin/stale.bin")
+    assert _put(store, key, payload, tmp_dir=str(tmp_path))
+    meta_path = store._meta_path(key)
+    with open(meta_path, encoding="utf-8") as fh:
+        meta = json.load(fh)
+    meta["created"] = time.time() - 7200.0  # past the 3600s TTL
+    with open(meta_path, "w", encoding="utf-8") as fh:
+        json.dump(meta, fh)
+    assert store.lookup(key) is None
+    assert store.snapshot()["entries"] == 0
+
+
+def test_store_torn_put_swept_on_lookup(store):
+    key = "ab" + "0" * 62
+    data = store._data_path(key)
+    os.makedirs(os.path.dirname(data), exist_ok=True)
+    with open(data, "wb") as fh:
+        fh.write(b"torn")
+    assert store.lookup(key) is None
+    assert not os.path.exists(data), "meta-less data file must be swept"
+
+
+def test_store_lru_eviction_order(tmp_path):
+    payload = os.urandom(1024)
+    cache = ContentStore(str(tmp_path / "cache"), max_bytes=3 * 1024, ttl_s=0)
+    try:
+        keys = [f"{index:02d}" + "0" * 62 for index in range(3)]
+        now = time.time()
+        for index, key in enumerate(keys):
+            assert _put(cache, key, payload, tmp_dir=str(tmp_path))
+            # pin distinct LRU clocks: keys[0] coldest
+            os.utime(cache._data_path(key), (now - 100 + index, now - 100 + index))
+        # a hit REFRESHES keys[0]'s clock, making keys[1] the victim
+        assert cache.lookup(keys[0]) is not None
+        newcomer = "ff" + "0" * 62
+        assert _put(cache, newcomer, payload, tmp_dir=str(tmp_path))
+        survivors = {
+            key for key in keys + [newcomer]
+            if os.path.exists(cache._data_path(key))
+        }
+        assert survivors == {keys[0], keys[2], newcomer}
+    finally:
+        cache.close()
+
+
+def test_store_pinned_entries_never_evicted_refuses_admission(tmp_path):
+    payload = os.urandom(1024)
+    pins: set = set()
+    cache = ContentStore(
+        str(tmp_path / "cache"), max_bytes=2 * 1024, ttl_s=0,
+        pinned=lambda key: key in pins,
+    )
+    try:
+        leader, follower = "aa" + "0" * 62, "bb" + "0" * 62
+        assert _put(cache, leader, payload, tmp_dir=str(tmp_path))
+        assert _put(cache, follower, payload, tmp_dir=str(tmp_path))
+        pins.update({leader, follower})
+        before = _counter("cache_admit_refusals_total")
+        newcomer = "cc" + "0" * 62
+        assert not _put(cache, newcomer, payload, tmp_dir=str(tmp_path)), (
+            "a store full of leased entries must refuse, not evict"
+        )
+        assert _counter("cache_admit_refusals_total") == before + 1
+        assert os.path.exists(cache._data_path(leader))
+        assert os.path.exists(cache._data_path(follower))
+        # unpinning makes LRU room again
+        pins.discard(leader)
+        assert _put(cache, newcomer, payload, tmp_dir=str(tmp_path))
+        assert not os.path.exists(cache._data_path(leader))
+    finally:
+        cache.close()
+
+
+def test_store_refuses_under_ledger_scratch_pressure(tmp_path):
+    """The cache rides the PR 7 scratch-disk budget: when the ledger
+    cannot grant the charge and every entry is lease-pinned, admission
+    is refused — eviction never touches a leased leader to make ledger
+    room."""
+    payload = os.urandom(1024)
+    admission.LEDGER.configure({"disk": 2 * 1024})
+    pins: set = set()
+    cache = ContentStore(
+        str(tmp_path / "cache"), max_bytes=0, ttl_s=0,
+        pinned=lambda key: key in pins,
+    )
+    try:
+        first = "aa" + "0" * 62
+        assert _put(cache, first, payload, tmp_dir=str(tmp_path))
+        pins.add(first)
+        # the remaining ledger headroom is 1 KiB; a 1 KiB put fits...
+        second = "bb" + "0" * 62
+        assert _put(cache, second, payload, tmp_dir=str(tmp_path))
+        pins.add(second)
+        # ...but the third must be REFUSED: the ledger says no and both
+        # entries are pinned leaders
+        third = "cc" + "0" * 62
+        assert not _put(cache, third, payload, tmp_dir=str(tmp_path))
+        assert os.path.exists(cache._data_path(first))
+        assert os.path.exists(cache._data_path(second))
+        # releasing a lease lets eviction refund its charge and admit
+        pins.discard(first)
+        assert _put(cache, third, payload, tmp_dir=str(tmp_path))
+        assert not os.path.exists(cache._data_path(first))
+    finally:
+        cache.close()
+
+
+def test_store_close_refunds_without_deleting(store, tmp_path):
+    payload = os.urandom(1024)
+    key = content_key("http://origin/persist.bin")
+    assert _put(store, key, payload, tmp_dir=str(tmp_path))
+    assert admission.LEDGER.outstanding()
+    store.close()
+    assert not admission.LEDGER.outstanding()
+    assert os.path.exists(store._data_path(key)), (
+        "close() leaves artifacts for the next life"
+    )
+
+
+# -- the lease registry -------------------------------------------------------
+
+
+def test_lease_election_one_leader(tmp_path):
+    registry = LeaseRegistry(str(tmp_path / "inflight"), lease_ttl_s=30.0)
+    key = "aa" + "0" * 62
+    lease = registry.acquire_lease(key, url="http://o/x")
+    assert lease is not None and not lease.promoted
+    assert registry.acquire_lease(key) is None, "a live lease excludes"
+    assert registry.is_leased(key)
+    registry.release_lease(lease)
+    assert not registry.is_leased(key)
+    second = registry.acquire_lease(key)
+    assert second is not None and not second.promoted
+    registry.release_lease(second)
+    registry.release_lease(second)  # idempotent
+
+
+def test_lease_stale_promotion_and_zombie_release(tmp_path):
+    root = str(tmp_path / "inflight")
+    dead = LeaseRegistry(root, lease_ttl_s=5.0, instance="worker-dead")
+    heir = LeaseRegistry(root, lease_ttl_s=5.0, instance="worker-heir")
+    key = "aa" + "0" * 62
+    zombie = dead.acquire_lease(key)
+    assert zombie is not None
+    # the leader "dies": its heartbeat stops and the lease goes stale
+    stale = time.time() - 60.0
+    os.utime(zombie.path, (stale, stale))
+    before = _counter("singleflight_promotions_total")
+    promoted = heir.acquire_lease(key)
+    assert promoted is not None and promoted.promoted
+    assert _counter("singleflight_promotions_total") == before + 1
+    # the zombie waking up late must NOT tear down its successor
+    dead.release_lease(zombie)
+    assert heir.is_leased(key), "zombie release tore down the new lease"
+    # nor can its heartbeat keep the superseded claim alive
+    dead.beat(zombie)
+    record = heir.peek(key)
+    assert record is not None and record["owner"] == "worker-heir"
+    heir.release_lease(promoted)
+    assert not heir.is_leased(key)
+
+
+def test_lease_beat_keeps_claim_fresh(tmp_path):
+    registry = LeaseRegistry(str(tmp_path / "inflight"), lease_ttl_s=5.0)
+    key = "aa" + "0" * 62
+    lease = registry.acquire_lease(key)
+    assert lease is not None
+    old = time.time() - 4.0
+    os.utime(lease.path, (old, old))
+    registry.beat(lease)
+    record = registry.peek(key)
+    assert record is not None and record["age_s"] < 1.0
+    registry.release_lease(lease)
+
+
+# -- the coalescing plane (in-process) ----------------------------------------
+
+
+class _StubBackend:
+    supports_cache = True
+    supports_mirrors = False
+
+    def __init__(self, payload: bytes, gate: "threading.Event | None" = None):
+        self.payload = payload
+        self.gate = gate
+        self.started = threading.Event()
+        self.downloads = 0
+        self._lock = threading.Lock()
+
+    def download(self, token, job_dir, progress, url):
+        with self._lock:
+            self.downloads += 1
+        self.started.set()
+        if self.gate is not None:
+            assert self.gate.wait(timeout=30.0), "test gate never opened"
+        with open(os.path.join(job_dir, "artifact.bin"), "wb") as fh:
+            fh.write(self.payload)
+
+    def fetch_small(self, token, job_dir, progress, url, max_bytes):
+        self.download(token, job_dir, progress, url)
+        return True
+
+
+def _plane(tmp_path, backend_gate=None, wait_s=30.0, lease_ttl_s=30.0):
+    store = ContentStore(
+        str(tmp_path / "cache"), max_bytes=64 * 1024 * 1024, ttl_s=3600.0
+    )
+    registry = LeaseRegistry(
+        str(tmp_path / "inflight"), lease_ttl_s=lease_ttl_s
+    )
+    return CoalescingDataPlane(store, registry, wait_s=wait_s, poll_s=0.02)
+
+
+def test_plane_covers_only_opted_in_http_backends(tmp_path):
+    plane = _plane(tmp_path)
+    try:
+        backend = _StubBackend(b"x")
+        assert plane.covers(backend, "http://o/a")
+        assert plane.covers(backend, "https://o/a")
+        assert not plane.covers(backend, "magnet:?xt=urn:btih:" + "1" * 40)
+        assert not plane.covers(object(), "http://o/a")
+    finally:
+        plane.store.close()
+
+
+def test_plane_coalesces_two_concurrent_jobs_into_one_fetch(tmp_path):
+    payload = os.urandom(8192)
+    gate = threading.Event()
+    backend = _StubBackend(payload, gate=gate)
+    plane = _plane(tmp_path)
+    url = "http://origin/coalesce.bin"
+    dirs = [str(tmp_path / f"job-{index}") for index in range(2)]
+    for job_dir in dirs:
+        os.makedirs(job_dir)
+    results = [None, None]
+
+    def run(index):
+        results[index] = plane.download(
+            backend, None, dirs[index], lambda u, p: None, url
+        )
+
+    joins_before = _counter("singleflight_joins_total")
+    try:
+        leader = threading.Thread(target=run, args=(0,), daemon=True)
+        leader.start()
+        assert backend.started.wait(timeout=10.0)
+        follower = threading.Thread(target=run, args=(1,), daemon=True)
+        follower.start()
+        # the follower JOINS (doesn't fetch) while the leader holds
+        _wait(
+            lambda: _counter("singleflight_joins_total") > joins_before,
+            10.0,
+            "the follower to join the in-flight fetch",
+        )
+        gate.set()
+        leader.join(timeout=30.0)
+        follower.join(timeout=30.0)
+        assert not leader.is_alive() and not follower.is_alive()
+        assert results == [True, True]
+        assert backend.downloads == 1, "two jobs must cost ONE fetch"
+        for job_dir in dirs:
+            with open(os.path.join(job_dir, "artifact.bin"), "rb") as fh:
+                assert fh.read() == payload
+        # a third, later job is a plain cache hit
+        third = str(tmp_path / "job-2")
+        os.makedirs(third)
+        assert plane.download(backend, None, third, lambda u, p: None, url)
+        assert backend.downloads == 1
+    finally:
+        gate.set()
+        plane.store.close()
+
+
+def test_plane_small_lane_serves_from_cache(tmp_path):
+    payload = os.urandom(2048)
+    backend = _StubBackend(payload)
+    plane = _plane(tmp_path)
+    url = "http://origin/small.bin"
+    try:
+        for index in range(2):
+            job_dir = str(tmp_path / f"job-{index}")
+            os.makedirs(job_dir)
+            assert plane.fetch_small(
+                backend, None, job_dir, lambda u, p: None, url, 1 << 20
+            )
+            with open(os.path.join(job_dir, "artifact.bin"), "rb") as fh:
+                assert fh.read() == payload
+        assert backend.downloads == 1
+    finally:
+        plane.store.close()
+
+
+def test_failpoint_cas_lookup_forces_miss(tmp_path):
+    payload = os.urandom(1024)
+    backend = _StubBackend(payload)
+    plane = _plane(tmp_path)
+    url = "http://origin/forced-miss.bin"
+    job_dir = str(tmp_path / "job-0")
+    os.makedirs(job_dir)
+    try:
+        assert plane.download(backend, None, job_dir, lambda u, p: None, url)
+        failpoints.FAILPOINTS.configure("cas.lookup=fail")
+        assert plane.store.lookup(content_key(url)) is None
+    finally:
+        failpoints.FAILPOINTS.reset()
+        plane.store.close()
+
+
+def test_failpoint_cas_put_completes_job_uncached(tmp_path):
+    payload = os.urandom(1024)
+    backend = _StubBackend(payload)
+    plane = _plane(tmp_path)
+    url = "http://origin/enospc.bin"
+    job_dir = str(tmp_path / "job-0")
+    os.makedirs(job_dir)
+    try:
+        failpoints.FAILPOINTS.configure("cas.put=fail")
+        assert plane.download(
+            backend, None, job_dir, lambda u, p: None, url
+        ), "write-through failure must not fail the job"
+        with open(os.path.join(job_dir, "artifact.bin"), "rb") as fh:
+            assert fh.read() == payload
+        failpoints.FAILPOINTS.reset()
+        assert plane.store.lookup(content_key(url)) is None, (
+            "the entry must not have landed"
+        )
+    finally:
+        failpoints.FAILPOINTS.reset()
+        plane.store.close()
+
+
+def test_failpoint_coalesce_join_degrades_to_direct_fetch(tmp_path):
+    plane = _plane(tmp_path)
+    url = "http://origin/join-fail.bin"
+    key = content_key(url)
+    job_dir = str(tmp_path / "job-0")
+    os.makedirs(job_dir)
+    lease = plane.registry.acquire_lease(key)
+    assert lease is not None
+    try:
+        failpoints.FAILPOINTS.configure("coalesce.join=fail")
+        assert not plane.download(
+            _StubBackend(b"x"), None, job_dir, lambda u, p: None, url
+        ), "a failed join must decline so the caller fetches directly"
+    finally:
+        failpoints.FAILPOINTS.reset()
+        plane.registry.release_lease(lease)
+        plane.store.close()
+
+
+def test_failpoint_coalesce_lead_degrades_without_leaking_lease(tmp_path):
+    plane = _plane(tmp_path)
+    url = "http://origin/lead-fail.bin"
+    job_dir = str(tmp_path / "job-0")
+    os.makedirs(job_dir)
+    try:
+        failpoints.FAILPOINTS.configure("coalesce.lead=fail")
+        assert not plane.download(
+            _StubBackend(b"x"), None, job_dir, lambda u, p: None, url
+        )
+        failpoints.FAILPOINTS.reset()
+        assert not plane.registry.is_leased(content_key(url)), (
+            "the failed election leaked its lease"
+        )
+    finally:
+        failpoints.FAILPOINTS.reset()
+        plane.store.close()
+
+
+def test_failpoint_schedules_pure_for_coalesce_sites():
+    for site in ("cas.lookup", "cas.put", "coalesce.join", "coalesce.lead"):
+        failpoints.FAILPOINTS.configure(f"{site}=fail:0.5")
+        try:
+            first = failpoints.FAILPOINTS.schedule(site, 32)
+            assert first == failpoints.FAILPOINTS.schedule(site, 32)
+        finally:
+            failpoints.FAILPOINTS.reset()
+
+
+def test_debug_snapshot_reflects_active_plane(tmp_path):
+    singleflight.activate(None)
+    assert singleflight.debug_snapshot() == {"enabled": False}
+    plane = _plane(tmp_path)
+    try:
+        singleflight.activate(plane)
+        snap = singleflight.debug_snapshot()
+        assert snap["enabled"]
+        assert snap["cas"]["root"] == plane.store.root
+        assert snap["singleflight"]["leases"] == []
+    finally:
+        singleflight.activate(None)
+        plane.store.close()
+
+
+# -- e2e machinery ------------------------------------------------------------
+
+
+class _CountingOrigin:
+    """Throttled range-capable origin that counts GETs per path — the
+    single-flight acceptance is exactly this counter staying at 1
+    while a flash crowd of jobs completes."""
+
+    def __init__(self, objects, rate_bps):
+        import http.server
+        import socketserver
+
+        origin = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def do_HEAD(self):
+                payload = origin.objects.get(self.path)
+                if payload is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.send_header("Accept-Ranges", "bytes")
+                self.end_headers()
+
+            def do_GET(self):
+                payload = origin.objects.get(self.path)
+                with origin.lock:
+                    origin.gets[self.path] = origin.gets.get(self.path, 0) + 1
+                if payload is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                start, end = 0, len(payload)
+                header = self.headers.get("Range")
+                if header and header.startswith("bytes="):
+                    lo, _, hi = header[len("bytes="):].partition("-")
+                    start = int(lo) if lo else 0
+                    end = int(hi) + 1 if hi else len(payload)
+                    self.send_response(206)
+                    self.send_header(
+                        "Content-Range",
+                        f"bytes {start}-{end - 1}/{len(payload)}",
+                    )
+                else:
+                    self.send_response(200)
+                self.send_header("Content-Length", str(end - start))
+                self.send_header("Accept-Ranges", "bytes")
+                self.end_headers()
+                window = payload[start:end]
+                chunk = 64 * 1024
+                for offset in range(0, len(window), chunk):
+                    piece = window[offset:offset + chunk]
+                    try:
+                        self.wfile.write(piece)
+                        self.wfile.flush()
+                    except OSError:
+                        return
+                    if origin.rate_bps > 0:
+                        time.sleep(len(piece) / origin.rate_bps)
+
+        self.objects = dict(objects)
+        self.rate_bps = rate_bps
+        self.gets: dict = {}
+        self.lock = threading.Lock()
+        self._server = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", 0), Handler
+        )
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def data_gets(self) -> int:
+        with self.lock:
+            return sum(self.gets.values())
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def _worker_env(broker, s3, base_dir, **extra):
+    env = {
+        "BROKER": "amqp",
+        "RABBITMQ_ENDPOINT": broker.endpoint,
+        "RABBITMQ_USERNAME": "",
+        "RABBITMQ_PASSWORD": "",
+        "S3_ENDPOINT": f"http://{s3.endpoint}",
+        "S3_ACCESS_KEY": CREDS.access_key,
+        "S3_SECRET_KEY": CREDS.secret_key,
+        "BUCKET": BUCKET,
+        "DOWNLOAD_DIR": base_dir,
+        "JOB_CONCURRENCY": "1",
+        "PREFETCH": "1",
+        "BATCH_JOBS": "1",
+        "HTTP_SEGMENTS": "1",
+        "S3_MULTIPART_THRESHOLD": str(256 * 1024),
+        "S3_PART_SIZE": str(256 * 1024),
+        "PROFILE": "0",
+        "TSDB_INTERVAL": "off",
+        "ALERT_INTERVAL": "off",
+        "LSD": "off",
+        "DHT_BOOTSTRAP": "off",
+        "WATCHDOG_STALL_S": "600",
+        "MAX_JOB_RETRIES": "50",
+        "RETRY_DELAY": "0.3",
+        "RETRY_DELAY_CAP": "1.0",
+        "PUBLISH_CONFIRM_TIMEOUT": "10",
+        "FAILPOINT_SPEC": "",
+        "LOG_LEVEL": "info",
+        "CACHE_DIR": os.path.join(base_dir, "shared-cache"),
+        "SINGLEFLIGHT_LEASE_S": "2.0",
+        "SINGLEFLIGHT_WAIT_S": "120",
+    }
+    env.update(extra)
+    return env
+
+
+def _declare_topology(channel, topic):
+    channel.declare_exchange(topic)
+    for index in range(2):
+        name = f"{topic}-{index}"
+        channel.declare_queue(name)
+        channel.bind_queue(name, topic, name)
+
+
+def _publish_job(broker, media_id, url):
+    context = tracing.TraceContext.mint()
+    connection = broker.broker.connect()
+    try:
+        channel = connection.channel()
+        _declare_topology(channel, "v1.download")
+        channel.publish(
+            "v1.download",
+            "v1.download-0",
+            Download(media=Media(id=media_id, source_uri=url)).marshal(),
+            headers={tracing.TRACE_CONTEXT_HEADER: context.header_value()},
+            persistent=True,
+        )
+        channel.close()
+    finally:
+        connection.close()
+    return context
+
+
+class _ConvertSink:
+    """Collects (media_id, trace_id) pairs off both convert shards —
+    the trace-continuity witness for the chaos acceptance."""
+
+    def __init__(self, broker):
+        self.received: "list[tuple[str, str]]" = []
+        self._lock = threading.Lock()
+        self._connection = broker.broker.connect()
+        channel = self._connection.channel()
+        channel.set_prefetch(100)
+        _declare_topology(channel, "v1.convert")
+
+        def on_message(message, ch=channel):
+            convert = Convert.unmarshal(message.body)
+            context = tracing.TraceContext.parse(
+                message.headers.get(tracing.TRACE_CONTEXT_HEADER)
+            )
+            with self._lock:
+                self.received.append(
+                    (
+                        convert.media.id if convert.media else "",
+                        context.trace_id if context else "",
+                    )
+                )
+            ch.ack(message.delivery_tag)
+
+        for index in range(2):
+            channel.consume(f"v1.convert-{index}", on_message)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self.received)
+
+    def close(self):
+        self._connection.close()
+
+
+def _fleet_get(port: int, path: str, timeout: float = 10.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        response = conn.getresponse()
+        return response.status, response.read()
+    finally:
+        conn.close()
+
+
+def _fleet_config(workers=2, **overrides):
+    base = dict(
+        workers=workers,
+        heartbeat_s=0.2,
+        stall_s=30.0,
+        restart_backoff_s=0.1,
+        restart_backoff_cap_s=0.5,
+        start_grace_s=40.0,
+        drain_s=10.0,
+        scrape_timeout_s=2.0,
+    )
+    base.update(overrides)
+    return FleetConfig(**base)
+
+
+# -- the e2e acceptances ------------------------------------------------------
+
+
+def test_e2e_single_flight_flash_crowd_one_origin_fetch(tmp_path):
+    """The CI single-flight smoke: a flash crowd of SIX identical jobs
+    against a throttled origin, drained by a real 2-worker fleet with
+    the data plane on, costs exactly ONE origin GET; the fleet
+    ``/debug/flows`` reports origin amplification ~1.0 with every
+    non-leader's bytes on the ``cache_hit_bytes`` lane, and
+    ``/debug/cache`` shows the shared store from both instances."""
+    payload = os.urandom(1536 * 1024)
+    with S3Stub(CREDS) as s3, AmqpServerStub() as broker, _CountingOrigin(
+        {"/hot.mp4": payload}, rate_bps=768 * 1024
+    ) as origin:
+        supervisor = FleetSupervisor(
+            _fleet_config(workers=2),
+            worker_env=_worker_env(broker, s3, str(tmp_path)),
+        )
+        sink = None
+        health = None
+        try:
+            supervisor.start()
+            _wait(
+                lambda: all(
+                    slot["ready"] for slot in supervisor.snapshot()["slots"]
+                ),
+                60.0,
+                "both real workers ready",
+            )
+            sink = _ConvertSink(broker)
+            expected = {f"crowd-{index}" for index in range(6)}
+            for media_id in sorted(expected):
+                _publish_job(broker, media_id, f"{origin.url}/hot.mp4")
+            _wait(
+                lambda: {entry[0] for entry in sink.snapshot()} >= expected,
+                120.0,
+                "the whole flash crowd to complete",
+            )
+
+            assert origin.data_gets() == 1, (
+                f"flash crowd cost {origin.data_gets()} origin GETs, want 1"
+            )
+            # every copy of the object landed intact in the store
+            bucket = s3.buckets.get(BUCKET, {})
+            landed = [body for body in bucket.values() if body == payload]
+            assert len(landed) == len(expected), (
+                f"{len(landed)}/{len(expected)} intact objects in S3"
+            )
+
+            health = FleetHealthServer(supervisor, 0, "127.0.0.1").start()
+            status, body = _fleet_get(health.port, "/debug/flows")
+            assert status == 200
+            fleet = json.loads(body)
+            assert fleet["workers"] == 2
+            assert fleet["unique_bytes"] == len(payload)
+            assert fleet["ingress_bytes"] == len(payload), (
+                "the fleet fetched the hot object more than once"
+            )
+            assert fleet["cache_hit_bytes"] == (
+                (len(expected) - 1) * len(payload)
+            )
+            amplification = fleet["origin_amplification"]
+            assert amplification <= 1.2, (
+                f"fleet amplification {amplification}, want ~1.0 cache-on"
+            )
+
+            status, body = _fleet_get(health.port, "/debug/cache")
+            assert status == 200
+            cache_view = json.loads(body)
+            instances = cache_view["instances"]
+            assert set(instances) == {"worker-0", "worker-1"}
+            assert all(entry["enabled"] for entry in instances.values())
+            assert any(
+                entry["cas"]["entries"] >= 1 for entry in instances.values()
+            ), f"no worker shows the shared entry: {instances}"
+
+            if os.environ.get("SINGLEFLIGHT_SMOKE_ARTIFACT_DIR"):
+                out_dir = os.environ["SINGLEFLIGHT_SMOKE_ARTIFACT_DIR"]
+                os.makedirs(out_dir, exist_ok=True)
+                with open(
+                    os.path.join(out_dir, "single-flight-smoke.json"), "w"
+                ) as artifact:
+                    json.dump(
+                        {
+                            "origin_gets": origin.data_gets(),
+                            "flows": fleet,
+                            "cache": cache_view,
+                        },
+                        artifact,
+                        indent=1,
+                    )
+        finally:
+            if health is not None:
+                health.stop()
+            if sink is not None:
+                sink.close()
+            supervisor.drain()
+
+
+def test_e2e_chaos_sigkill_coalesce_leader_promotes_follower(tmp_path):
+    """The ISSUE 18 chaos proof: the elected coalesce leader is
+    SIGKILLed mid-multipart by a seeded failpoint
+    (``segments.pwrite=kill`` after 16 chunk writes ≈ 4 MB into a
+    6 MB object). Its lease goes stale, a follower PROMOTES itself and
+    re-leads from the journaled spans, every job in the crowd
+    completes under its ORIGINAL trace id, the supervisor restarts the
+    dead worker, and ``list_multipart_uploads()`` drains to empty —
+    zero dangling multiparts fleet-wide."""
+    payload = os.urandom(6 * 1024 * 1024)
+    with S3Stub(CREDS) as s3, AmqpServerStub() as broker, _CountingOrigin(
+        {"/hot.mp4": payload}, rate_bps=1536 * 1024
+    ) as origin:
+        supervisor = FleetSupervisor(
+            _fleet_config(workers=2, stall_s=2.0),
+            worker_env=_worker_env(
+                broker,
+                s3,
+                str(tmp_path),
+                # dies on the 17th 256 KiB chunk write (~4 MB in) —
+                # only an elected leader ever writes; followers wait
+                # on the lease. The promoted successor resumes the
+                # journal with < 16 chunks left, so it survives its
+                # own armed copy of the same spec. Two real segments
+                # (3 MB each over the 1 MB floor) so the death is
+                # mid-STRIPED-fetch with a live span journal.
+                FAILPOINT_SPEC="segments.pwrite=kill:1:16",
+                HTTP_SEGMENTS="2",
+                HTTP_SEGMENT_MIN_MB="1",
+                SINGLEFLIGHT_LEASE_S="1.0",
+                WATCHDOG_STALL_S="60",
+            ),
+        )
+        sink = None
+        health = None
+        try:
+            supervisor.start()
+            _wait(
+                lambda: all(
+                    slot["ready"] for slot in supervisor.snapshot()["slots"]
+                ),
+                60.0,
+                "both real workers ready",
+            )
+            sink = _ConvertSink(broker)
+            contexts = {}
+            for index in range(4):
+                media_id = f"chaos-{index}"
+                contexts[media_id] = _publish_job(
+                    broker, media_id, f"{origin.url}/hot.mp4"
+                )
+            _wait(
+                lambda: {entry[0] for entry in sink.snapshot()}
+                >= set(contexts),
+                180.0,
+                "the crowd to complete through the leader's death",
+            )
+
+            # trace continuity: every completion under its ORIGINAL id
+            foreign = [
+                entry
+                for entry in sink.snapshot()
+                if entry[0] in contexts
+                and entry[1] != contexts[entry[0]].trace_id
+            ]
+            assert not foreign, f"trace-id continuity broken: {foreign}"
+            # the leader really died and was really restarted
+            assert (
+                metrics.GLOBAL.snapshot().get("fleet_worker_restarts", 0) >= 1
+            ), "no worker was restarted: the failpoint never killed"
+            # a follower really promoted itself over the stale lease
+            health = FleetHealthServer(supervisor, 0, "127.0.0.1").start()
+            federated = _wait(
+                lambda: _fleet_get(health.port, "/metrics/federate")[1],
+                30.0,
+                "the fleet exposition",
+            ).decode()
+            promotions = sum(
+                float(line.rsplit(" ", 1)[1])
+                for line in federated.splitlines()
+                if line.startswith("downloader_singleflight_promotions_total")
+            )
+            assert promotions >= 1, (
+                "no follower promoted itself over the dead leader's lease"
+            )
+            # every copy landed intact despite the mid-multipart death
+            bucket = s3.buckets.get(BUCKET, {})
+            landed = [body for body in bucket.values() if body == payload]
+            assert len(landed) == len(contexts)
+            # zero dangling multiparts fleet-wide
+            _wait(
+                lambda: not s3.list_multipart_uploads(),
+                30.0,
+                "dangling multipart uploads to be reclaimed",
+            )
+        finally:
+            if health is not None:
+                health.stop()
+            if sink is not None:
+                sink.close()
+            supervisor.drain()
