@@ -43,6 +43,9 @@ BENCH_WATCHDOG=0 to skip the stall-watchdog heartbeat ablation,
 BENCH_TELEMETRY=0 to skip the whole-telemetry-plane on/off ablation
 (tracing + context propagation + watchdog + TSDB scraping + alert
 evaluation vs all of it off),
+BENCH_CANARY=0 to skip the canary-plane arm (live prober vs plane off
+on non-canary traffic, plus corruption-detection latency from an armed
+canary.corrupt failpoint to the canary_failing flip),
 BENCH_SMALL=0 to skip the small-object batched/unbatched arm
 (BENCH_SMALL_WAVE jobs per wave, BENCH_SMALL_WAVES rounds),
 BENCH_OVERLOAD=0 to skip the overload-shedding arm (BENCH_OVERLOAD_JOBS
@@ -1322,6 +1325,90 @@ def run_telemetry_ablation(
     }
 
 
+def run_canary_ablation(
+    site: str, samples: int, concurrency: int, repeats: int = 3
+) -> dict:
+    """The canary-plane arm (ISSUE 20 satellite): per-job latency on
+    NON-canary traffic with a live prober (exclusion table armed, shed
+    hook active, canary Convert lane consuming) against the plane off
+    — interleaved off/on pairs, median of per-pair deltas, same
+    always-on contract as the watchdog/telemetry arms. Plus the number
+    the plane exists for: detection latency from an armed
+    ``canary.corrupt`` failpoint to the prober reading the corruption
+    back and flipping ``canary_failing``."""
+    from downloader_tpu.utils import canary as canary_mod
+    from downloader_tpu.utils import failpoints as failpoints_mod
+
+    def build_prober(pipeline: _Pipeline) -> "canary_mod.CanaryProber":
+        prober = canary_mod.CanaryProber(
+            pipeline.client, pipeline.uploader,
+            consume_topic=pipeline.config.consume_topic,
+            publish_topic=pipeline.config.publish_topic,
+            interval_s=3600.0, timeout_s=60.0, instance="bench",
+        )
+        prober.start()
+        canary_mod.ACTIVE = prober
+        return prober
+
+    def teardown_prober(prober) -> None:
+        canary_mod.ACTIVE = None
+        prober.stop()
+
+    def run_arm(enabled: bool) -> float:
+        pipeline = _Pipeline(
+            concurrency, concurrency, site, payload="tiny.bin"
+        )
+        prober = build_prober(pipeline) if enabled else None
+        try:
+            laps: list[float] = []
+            for i in range(samples):
+                start = time.monotonic()
+                pipeline.publish_job(i)
+                pipeline.wait_converts(i + 1, timeout=60.0)
+                laps.append((time.monotonic() - start) * 1000.0)
+        finally:
+            if prober is not None:
+                teardown_prober(prober)
+            pipeline.close()
+        laps.sort()
+        return laps[len(laps) // 2]
+
+    pairs = []
+    for _ in range(repeats):
+        off_ms = run_arm(False)
+        on_ms = run_arm(True)
+        pairs.append({"off_ms": round(off_ms, 2), "on_ms": round(on_ms, 2),
+                      "delta_ms": round(on_ms - off_ms, 3)})
+    deltas = sorted(p["delta_ms"] for p in pairs)
+
+    # detection latency: arm silent corruption, trigger one probe pair
+    # through the prober's own loop, clock until the episode opens
+    pipeline = _Pipeline(concurrency, concurrency, site, payload="tiny.bin")
+    prober = build_prober(pipeline)
+    detect_s = None
+    try:
+        failpoints_mod.FAILPOINTS.configure("canary.corrupt=fail:1")
+        start = time.monotonic()
+        prober.trigger()
+        deadline = start + 120.0
+        while time.monotonic() < deadline:
+            if prober.failing:
+                detect_s = round(time.monotonic() - start, 3)
+                break
+            time.sleep(0.01)
+    finally:
+        failpoints_mod.FAILPOINTS.reset()
+        teardown_prober(prober)
+        pipeline.close()
+    return {
+        "metric": "canary_probe",
+        "unit": "ms",
+        "delta_ms": deltas[len(deltas) // 2],
+        "detect_s": detect_s,
+        "pairs": pairs,
+    }
+
+
 _PROFILE_STAGES = {
     "fetch": "fetch",
     "store": "upload",
@@ -2496,6 +2583,22 @@ def main() -> None:
                 f"{telemetry_ablation['delta_ms']:+.3f} ms/job"
             )
 
+        canary_ablation = None
+        if os.environ.get("BENCH_CANARY", "1") != "0":
+            _log(
+                f"bench: canary-plane ablation, interleaved off/on "
+                f"pairs of {latency_samples} tiny jobs + one corrupt "
+                "probe pair"
+            )
+            canary_ablation = run_canary_ablation(
+                site, latency_samples, concurrency
+            )
+            _log(
+                "bench: canary ablation median delta "
+                f"{canary_ablation['delta_ms']:+.3f} ms/job; corruption "
+                f"detected in {canary_ablation['detect_s']}s"
+            )
+
         profile_arm = None
         if os.environ.get("BENCH_PROFILE", "1") != "0":
             profile_jobs = max(
@@ -2677,6 +2780,8 @@ def main() -> None:
             extra_metrics.append(watchdog_ablation)
         if telemetry_ablation is not None:
             extra_metrics.append(telemetry_ablation)
+        if canary_ablation is not None:
+            extra_metrics.append(canary_ablation)
         if profile_arm is not None:
             extra_metrics.append(profile_arm)
         if fleet_chaos is not None:

@@ -127,6 +127,9 @@ def digest_line(report: dict) -> dict:
             out["cache_hit_ratio"] = extra.get("cache_hit_ratio")
             out["singleflight_amp"] = extra.get("singleflight_amp")
             out["singleflight_amp_off"] = extra.get("singleflight_amp_off")
+        elif metric == "canary_probe":
+            out["canary_ms"] = extra.get("delta_ms")
+            out["canary_detect_s"] = extra.get("detect_s")
     return out
 
 

@@ -37,7 +37,7 @@ from ..queue.delivery import Delivery, ack_batch
 from ..scan import scan_dir
 from ..store import Uploader, UploadError
 from ..utils import metrics, configure_from_env, get_logger, tracing
-from ..utils import admission, incident, profiling, watchdog
+from ..utils import admission, canary, incident, profiling, watchdog
 from ..utils.cancel import Cancelled, CancelToken
 from ..utils.failpoints import FAILPOINTS
 from ..wire import Convert, Download, WireError
@@ -141,6 +141,14 @@ class Daemon:
         self._applied_prefetch = self._normal_prefetch  # guarded-by: _prefetch_apply_lock
         # set by run(); sheds re-try the declare while it stays False
         self._dlq_ready = False
+        # /readyz: set once run() has the consume established, the DLQ
+        # declared, and the workers spawned — the health server serves
+        # 503 until then (and again during drain), distinct from the
+        # liveness /healthz
+        self.ready = threading.Event()
+        # serve() confirms the cache plane attached (when configured)
+        # before the job loop starts; /readyz reports it alongside
+        self.data_plane_attached = True
 
     @property
     def worker_count(self) -> int:
@@ -336,7 +344,7 @@ class Daemon:
         watch.stage("publish")
         with tracing.span("publish"):
             confirmed = self._client.publish(
-                self._config.publish_topic,
+                self._publish_topic_for(delivery),
                 convert.marshal(),
                 wait=self._config.publish_confirm_timeout,
                 cancel=job_token,
@@ -399,11 +407,40 @@ class Daemon:
         deque append) so a firing burn alert links straight to example
         traces instead of a bare percentile."""
         job_class = delivery.job_class or self._config.admission_default_class
+        if job_class == admission.CANARY_CLASS:
+            # synthetic probes must never enter the histograms the user
+            # SLO burn rules read — the canary plane has its own
+            # canary_* series (utils/canary.py)
+            return
         metrics.GLOBAL.observe(
             f"slo_job_duration_seconds_{job_class}",
             elapsed,
             exemplar=trace_id,
         )
+
+    def _publish_topic_for(self, delivery: Delivery) -> str:
+        """Canary Converts land on a parallel ``<topic>.canary[.
+        <instance>]`` lane the PROBING instance's prober consumes
+        (utils/canary.py, carried on its reply-to header — in a fleet
+        any worker may process the probe): downstream Convert consumers
+        never see synthetic media, while the hand-off itself rides the
+        same confirm-gated publisher as user traffic. The reply topic
+        is honored only under the canary prefix, so a crafted header
+        can never redirect a Convert onto the user topic."""
+        if delivery.job_class == admission.CANARY_CLASS:
+            fallback = f"{self._config.publish_topic}.canary"
+            reply = delivery.message.headers.get(
+                canary.REPLY_TOPIC_HEADER
+            )
+            if isinstance(reply, bytes):
+                try:
+                    reply = reply.decode("ascii")
+                except UnicodeDecodeError:
+                    reply = None
+            if isinstance(reply, str) and reply.startswith(fallback):
+                return reply
+            return fallback
+        return self._config.publish_topic
 
     def _settle_transient(self, delivery, job_log, trace, exc) -> None:
         """One retry-or-drop policy for every transient job failure —
@@ -761,7 +798,7 @@ class Daemon:
                 # publish span measures
                 publish_span = root.child("publish", coalesced=True)
                 pending = self._client.publish_async(
-                    self._config.publish_topic, convert.marshal()
+                    self._publish_topic_for(delivery), convert.marshal()
                 )
                 keep = True
                 return _FastJob(
@@ -979,6 +1016,22 @@ class Daemon:
         incident bundle (on its own thread — the wave may still carry
         interactive jobs that must not wait on a flight recorder)."""
         config = self._config
+        if delivery.job_class == admission.CANARY_CLASS:
+            # DLQ hygiene: a shed synthetic probe must never accumulate
+            # in the dead-letter queue (nothing will ever drain it) —
+            # ack it away and count it as the failed probe it is: its
+            # Convert will never arrive
+            try:
+                job_id = Download.unmarshal(delivery.body).media.id
+            except WireError:
+                job_id = "canary-unknown"
+            delivery.ack()
+            canary.note_shed(job_id, reason)
+            self.stats.bump(shed=1)
+            log.with_fields(job_id=job_id, reason=reason).warning(
+                "canary probe shed; self-cleaned instead of dead-lettering"
+            )
+            return
         if not self._dlq_ready:
             # startup raced a down broker and the declare never
             # happened: re-try it now, and if the DLQ still does not
@@ -1139,8 +1192,12 @@ class Daemon:
             profiling.ROLES.register_thread(worker, "job-worker")
             self._workers.append(worker)
         log.with_field("workers", len(self._workers)).info("job loop running")
+        # /readyz flips here: the consume is established, the DLQ
+        # declared (or its retry armed), and the workers are draining
+        self.ready.set()
 
         self._token.wait()  # block until cancelled
+        self.ready.clear()  # draining; not ready for traffic
         for worker in self._workers:
             # deadline: runs after cancellation — every worker blocking op is bounded (dequeue poll, socket timeouts, watchdog cancel) and the loop exits on the cancelled token
             worker.join()
@@ -1394,6 +1451,28 @@ def serve(
     uploader = Uploader.from_env(config.bucket)
 
     daemon = Daemon(token, client, dispatcher, uploader, config)
+    # when a cache plane is configured, it attached above (or serve()
+    # would have raised); /readyz reports the verdict either way
+    daemon.data_plane_attached = data_plane is not None or not config.cache_dir
+
+    # synthetic canary plane (utils/canary.py): the prober mints
+    # known-content probe jobs onto this worker's OWN consume topic —
+    # riding the real queue→admission→fetch→scan→upload→publish path —
+    # and verifies them from the outside. CANARY=0 builds none of it.
+    prober = None
+    if config.canary:
+        prober = canary.CanaryProber(
+            client,
+            uploader,
+            consume_topic=config.consume_topic,
+            publish_topic=config.publish_topic,
+            interval_s=config.canary_interval_s,
+            timeout_s=config.canary_timeout_s,
+            history=config.canary_history,
+            object_bytes=config.canary_object_bytes,
+            instance=config.instance,
+        )
+        canary.ACTIVE = prober
 
     health = None
     if config.health_port > 0:
@@ -1414,9 +1493,16 @@ def serve(
             config.fleet_heartbeat_s,
             health_port=health.port if health is not None else 0,
         ).start()
+    if prober is not None:
+        prober.start()
     try:
         daemon.run()
     finally:
+        # the prober goes FIRST: it publishes onto the consume topic
+        # and waits on Converts — both lanes are closing down behind it
+        if prober is not None:
+            canary.ACTIVE = None
+            prober.stop()
         if heartbeat is not None:
             heartbeat.stop()
         profiling.PROFILER.stop()

@@ -142,6 +142,14 @@ class Config:
     singleflight_dir: str = ""  # empty derives <cache_dir>/inflight
     singleflight_lease_s: float = 10.0
     singleflight_wait_s: float = 120.0
+    # synthetic canary plane (utils/canary.py): active probe jobs with
+    # known content through the real pipeline, verified outside-in.
+    # CANARY=0 builds no prober, no origin, no hooks.
+    canary: bool = True
+    canary_interval_s: float = 60.0
+    canary_timeout_s: float = 30.0
+    canary_history: int = 32
+    canary_object_bytes: int = 64 * 1024
 
     @property
     def dead_letter_queue(self) -> str:
@@ -281,4 +289,11 @@ class Config:
         config.singleflight_dir = singleflight.inflight_dir_from_env(env)
         config.singleflight_lease_s = singleflight.lease_ttl_from_env(env)
         config.singleflight_wait_s = singleflight.wait_from_env(env)
+        from ..utils import canary
+
+        config.canary = canary.enabled_from_env(env)
+        config.canary_interval_s = canary.interval_from_env(env)
+        config.canary_timeout_s = canary.timeout_from_env(env)
+        config.canary_history = canary.history_from_env(env)
+        config.canary_object_bytes = canary.object_bytes_from_env(env)
         return config

@@ -138,6 +138,7 @@ class FleetConfig:
         drain_s: float = DEFAULT_DRAIN_S,
         scrape_timeout_s: float = DEFAULT_SCRAPE_TIMEOUT_S,
         outlier_ratio: float = DEFAULT_OUTLIER_RATIO,
+        canary_interval_s: float = 0.0,
     ):
         self.workers = max(1, workers)
         self.heartbeat_s = heartbeat_s
@@ -153,6 +154,12 @@ class FleetConfig:
         # and the worker-outlier rule's p99-vs-fleet-median factor
         self.scrape_timeout_s = max(0.05, scrape_timeout_s)
         self.outlier_ratio = max(1.0, outlier_ratio)
+        # fleet canary scheduler: round-robin one POST
+        # /debug/canary/probe across the ready workers every interval,
+        # so each probe verdict is attributable to ONE instance and a
+        # single sick worker is localized. 0 = scheduler off (workers
+        # still self-probe on their own CANARY_INTERVAL_S).
+        self.canary_interval_s = max(0.0, canary_interval_s)
 
     @classmethod
     def from_env(cls, environ=None) -> "FleetConfig":
@@ -187,6 +194,9 @@ class FleetConfig:
             ),
             outlier_ratio=_float_env(
                 env, "FLEET_OUTLIER_RATIO", DEFAULT_OUTLIER_RATIO, 1.0
+            ),
+            canary_interval_s=_float_env(
+                env, "FLEET_CANARY_INTERVAL_S", 0.0, 0.0
             ),
         )
 
@@ -956,6 +966,21 @@ class FleetHealthServer:
                         code = 503 if degraded else 200
                         body = (json.dumps(snap, indent=1) + "\n").encode()
                         ctype = "application/json"
+                    elif path == "/readyz":
+                        snap = fleet.snapshot()
+                        slots = {
+                            slot["instance"]: bool(slot.get("ready"))
+                            for slot in snap.get("slots", [])
+                        }
+                        ready = bool(slots) and all(slots.values())
+                        payload = {"ready": ready, "slots": slots}
+                        code = 200 if ready else 503
+                        body = (
+                            json.dumps(payload, indent=1) + "\n"
+                        ).encode()
+                        ctype = "application/json"
+                    elif path == "/debug/canary":
+                        code, body, ctype = plane.debug_canary()
                     elif path == "/metrics":
                         code, body = 200, render_metrics()
                         ctype = "text/plain; version=0.0.4"
@@ -1131,9 +1156,63 @@ def run_fleet(
             os.environ.get("HEALTH_HOST", "127.0.0.1"),
             plane=plane,
         ).start()
+
+    # fleet canary scheduler: one probe per interval, round-robined
+    # across the ready workers — each verdict lands on exactly one
+    # instance, so a single sick worker is localized instead of every
+    # worker's self-probe firing at once
+    canary_stop = threading.Event()
+    canary_thread = None
+    if config.canary_interval_s > 0:
+        from .fleetplane import _http_request
+
+        def _canary_schedule() -> None:
+            watch = watchdog.MONITOR.loop("fleet-canary")
+            cursor = 0
+            try:
+                while not canary_stop.wait(config.canary_interval_s):
+                    watch.beat()
+                    targets = supervisor.ready_workers()
+                    if not targets:
+                        continue
+                    instance, port = targets[cursor % len(targets)]
+                    cursor += 1
+                    try:
+                        status, _ = _http_request(
+                            port,
+                            "/debug/canary/probe",
+                            method="POST",
+                            timeout=config.scrape_timeout_s,
+                        )
+                    except OSError as exc:
+                        log.with_fields(instance=instance).warning(
+                            f"canary probe dispatch failed: {exc}"
+                        )
+                        continue
+                    if status != 200:
+                        log.with_fields(
+                            instance=instance, status=status
+                        ).warning("canary probe dispatch rejected")
+            except Exception as exc:
+                # a crashed scheduler stops fleet-driven probes but the
+                # workers' own interval probers keep running — degraded,
+                # not blind; the cause must be in the log, not silent
+                log.error("fleet canary scheduler crashed", exc=exc)
+            finally:
+                watchdog.MONITOR.unregister(watch)
+
+        canary_thread = threading.Thread(  # thread-role: fleet-canary
+            target=_canary_schedule, name="fleet-canary", daemon=True
+        )
+        canary_thread.start()
+        profiling.ROLES.register_thread(canary_thread, "fleet-canary")
     try:
         return supervisor.run()
     finally:
+        canary_stop.set()
+        if canary_thread is not None:
+            # deadline: the loop blocks only on the stop event (interval waits) and a bounded scrape-timeout HTTP dispatch
+            canary_thread.join(timeout=config.scrape_timeout_s + 2.0)
         alerts.ENGINE.stop()
         tsdb.STORE.unregister_collector("fleet-aggregator")
         tsdb.STORE.stop()

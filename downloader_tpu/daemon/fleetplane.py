@@ -81,6 +81,14 @@ def instance_series(name: str, instance: str) -> str:
     return f"fleet:{name}:{instance}"
 
 
+# each worker's canary correctness gauge in its /metrics exposition —
+# the aggregator scans it per instance so the fleet canary rule can
+# NAME the failing worker
+_CANARY_GAUGE_RE = re.compile(
+    r"^downloader_canary_failing (\S+)$", re.MULTILINE
+)
+
+
 def _http_request(
     port: int,
     path: str,
@@ -639,6 +647,24 @@ class FleetQueryPlane:
             payload["errors"] = errors
         return _json_body(payload)
 
+    def debug_canary(self) -> "tuple[int, bytes, str]":
+        """The fleet-merged canary scorecard: every worker's last-N
+        probe verdicts under its instance plus the failing roster — the
+        view a firing fleet canary rule points the operator at."""
+        payloads, errors = self._split(self.fanout("/debug/canary"))
+        failing = sorted(
+            instance
+            for instance, payload in payloads.items()
+            if isinstance(payload, dict) and payload.get("failing")
+        )
+        payload: dict = {
+            "instances": dict(sorted(payloads.items())),
+            "failing": failing,
+        }
+        if errors:
+            payload["errors"] = errors
+        return _json_body(payload)
+
     # -- cross-worker incident capture -------------------------------------
 
     def capture_fleet_incident(
@@ -743,6 +769,9 @@ class FleetAggregator:
         # only ever grows.
         self._prev: "dict[tuple[str, str], tuple]" = {}  # guarded-by: _lock
         self._totals: "dict[str, list]" = {}  # guarded-by: _lock
+        # each live instance's last-scraped canary_failing gauge — the
+        # fleet canary rule's provider input
+        self._canary: "dict[str, float]" = {}  # guarded-by: _lock
 
     def collect(self) -> "list":
         """The TSDB collector: fan out over worker ``/metrics`` (and
@@ -790,6 +819,7 @@ class FleetAggregator:
             thread.join(timeout=max(0.0, deadline - time.monotonic()))
         batch: list = []
         live: "list[str]" = []
+        canary_values: "dict[str, float]" = {}
         with self._lock:
             for instance, entry in sorted(results.items()):
                 if not entry.get("ok"):
@@ -800,6 +830,14 @@ class FleetAggregator:
                     continue
                 histograms = parse_exposition_histograms(text)
                 live.append(instance)
+                canary_match = _CANARY_GAUGE_RE.search(text)
+                if canary_match:
+                    try:
+                        canary_values[instance] = float(
+                            canary_match.group(1)
+                        )
+                    except ValueError:
+                        pass
                 for name, snapshot in histograms.items():
                     bounds, counts, total, count = snapshot
                     if not bounds:
@@ -824,6 +862,17 @@ class FleetAggregator:
                 )
             self._instances = live
             self._exemplars = self._merge_exemplars(exemplar_holder[0])
+            self._canary = canary_values
+        if canary_values:
+            # the fleet gauge is the WORST instance: any failing worker
+            # makes the fleet canary signal red
+            batch.append(
+                (
+                    fleet_series("canary_failing"),
+                    "gauge",
+                    max(canary_values.values()),
+                )
+            )
         # fleet flow gauges: fold the workers' flow snapshots with the
         # one correct merge (summed bytes over MAXed unique bytes —
         # utils/flows.py) and record the RATIOS as supervisor gauges;
@@ -915,6 +964,12 @@ class FleetAggregator:
         with self._lock:
             return list(self._exemplars.get(base, ()))
 
+    def canary_by_instance(self) -> "dict[str, float]":
+        """The fleet canary rule's provider: each live instance's
+        last-scraped ``canary_failing`` gauge."""
+        with self._lock:
+            return dict(self._canary)
+
     def p99_by_instance(
         self, window_s: float, now: "float | None" = None
     ) -> "dict[str, float | None]":
@@ -942,6 +997,48 @@ class FleetAggregator:
                     worst = p99
             out[instance] = worst
         return out
+
+
+class FleetCanaryRule(alerts.AlertRule):
+    """The fleet twin of the worker ``canary-failure`` rule, and the
+    one that NAMES the sick instance: ``provider()`` returns each live
+    worker's ``canary_failing`` gauge; the rule fires while ANY
+    instance reports failing. Not a :class:`alerts.WorkerOutlierRule`
+    deliberately — median-of-peers semantics would stay silent when
+    every instance fails at once (a broken store corrupts all of them
+    equally), which is exactly the page this rule exists for."""
+
+    kind = "fleet-canary"
+
+    def __init__(self, name: str, series: str, provider, **kwargs):
+        super().__init__(name, series, **kwargs)
+        self._provider = provider
+
+    def _condition(self, view, now: float):
+        raw = self._provider() or {}
+        values = {
+            instance: value
+            for instance, value in raw.items()
+            if value is not None
+        }
+        failing = sorted(
+            instance
+            for instance, value in values.items()
+            if value >= 1.0
+        )
+        detail: dict = {
+            "values": {
+                instance: round(value, 4)
+                for instance, value in sorted(values.items())
+            },
+            "failing": failing,
+        }
+        if not failing:
+            # no reporting instance is red — including the no-data
+            # case: a scrape gap must not page as a canary failure
+            return False, detail
+        detail["instance"] = failing[0]
+        return True, detail
 
 
 def fleet_alert_rules(
@@ -1020,6 +1117,16 @@ def fleet_alert_rules(
                 "one object dominates fleet-wide ingress (merged "
                 "heavy-hitter sketches) — a flash crowd concentrating "
                 "on a single key"
+            ),
+        ),
+        FleetCanaryRule(
+            "fleet-canary-failure",
+            fleet_series("canary_failing"),
+            provider=aggregator.canary_by_instance,
+            description=(
+                "a worker's synthetic canary probe failed outside-in "
+                "verification — the detail names the failing "
+                "instance(s); /debug/canary has the per-stage verdicts"
             ),
         ),
     ]

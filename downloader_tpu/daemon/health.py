@@ -98,6 +98,10 @@ class HealthServer:
                     query = urllib.parse.parse_qs(parsed.query)
                     if path == "/healthz":
                         code, body, ctype = health._healthz()
+                    elif path == "/readyz":
+                        code, body, ctype = health._readyz()
+                    elif path == "/debug/canary":
+                        code, body, ctype = health._debug_canary()
                     elif path == "/metrics":
                         code, body, ctype = health._metrics()
                     elif path == "/metrics/federate":
@@ -146,6 +150,8 @@ class HealthServer:
                 try:
                     if self.path == "/debug/incident":
                         code, body, ctype = health._capture_incident()
+                    elif self.path == "/debug/canary/probe":
+                        code, body, ctype = health._trigger_probe()
                     else:
                         code, body, ctype = 404, b"not found\n", "text/plain"
                 except Exception as exc:
@@ -215,6 +221,59 @@ class HealthServer:
         }
         code = 200 if connected else 503
         return code, (json.dumps(payload) + "\n").encode(), "application/json"
+
+    def _readyz(self) -> tuple[int, bytes, str]:
+        """Readiness, distinct from liveness: /healthz answers "is the
+        process up", /readyz answers "may traffic be routed here" —
+        ready only once run() has the queue consume established and
+        (when configured) the cache plane attached."""
+        consume = bool(getattr(self._daemon, "ready", None))
+        consume = consume and self._daemon.ready.is_set()
+        data_plane = bool(
+            getattr(self._daemon, "data_plane_attached", True)
+        )
+        ready = consume and data_plane
+        payload = {
+            "ready": ready,
+            "consume": consume,
+            "data_plane": data_plane,
+        }
+        code = 200 if ready else 503
+        return code, (json.dumps(payload) + "\n").encode(), "application/json"
+
+    def _debug_canary(self) -> tuple[int, bytes, str]:
+        """The canary scorecard: last-N probe verdicts per stage from
+        the live prober (404 when the plane is off — CANARY=0)."""
+        from ..utils import canary
+
+        prober = canary.ACTIVE
+        if prober is None:
+            return (
+                404,
+                b'{"error": "canary plane disabled"}\n',
+                "application/json",
+            )
+        return (
+            200,
+            (json.dumps(prober.scorecard(), indent=1) + "\n").encode(),
+            "application/json",
+        )
+
+    def _trigger_probe(self) -> tuple[int, bytes, str]:
+        """POST /debug/canary/probe: one immediate probe pair — the
+        fleet scheduler's round-robin lane. Returns without waiting
+        for the verdict (it lands in the scorecard)."""
+        from ..utils import canary
+
+        prober = canary.ACTIVE
+        if prober is None:
+            return (
+                404,
+                b'{"error": "canary plane disabled"}\n',
+                "application/json",
+            )
+        prober.trigger()
+        return 200, b'{"triggered": true}\n', "application/json"
 
     def _debug_jobs(self) -> tuple[int, bytes, str]:
         payload = {
@@ -530,6 +589,10 @@ def render_metrics(
         # its publisher; alerts_firing when the engine evaluates
         "alerts_firing": 0.0,
         "queue_publisher_alive": 0.0,
+        # canary correctness gauge: the canary-failure rule (and the
+        # fleet aggregator's per-instance scan) need the series from
+        # the first scrape, not the first probe
+        "canary_failing": 0.0,
         **metrics.GLOBAL.gauges(),
     }
     for name, value in sorted(gauges.items()):
@@ -558,6 +621,8 @@ def render_metrics(
                 # absent()-free expressions before any traffic
                 "slo_job_duration_seconds_interactive",
                 "slo_job_duration_seconds_bulk",
+                # canary e2e latency: present before the first probe
+                "canary_e2e_seconds",
             )
         },
         "overhead_seconds": (

@@ -418,6 +418,20 @@ class S3Client:
         if status not in (200, 204):
             raise S3Error(status, body.decode(errors="replace")[:200])
 
+    def get_object(
+        self, bucket: str, key: str, token: CancelToken | None = None
+    ) -> bytes:
+        """Whole-object GET — the canary plane's outside-in read-back
+        lane (utils/canary.py verifies uploaded probe objects
+        byte-for-byte). Buffers in memory: callers control size, and
+        probe objects are small by construction."""
+        status, payload, _ = self._request(
+            "GET", self._object_path(bucket, key), token=token
+        )
+        if status != 200:
+            raise S3Error(status, f"GET object {bucket}/{key}")
+        return payload
+
     def put_object(
         self,
         bucket: str,

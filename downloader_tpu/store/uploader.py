@@ -25,6 +25,7 @@ Upgrades over the reference (its own TODO, uploader.go:61):
 from __future__ import annotations
 
 import base64
+import io
 import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -32,6 +33,7 @@ from dataclasses import dataclass, field
 
 from ..utils import get_logger, metrics, tracing, watchdog
 from ..utils.cancel import CancelToken
+from ..utils.failpoints import FAILPOINTS
 from .credentials import from_env
 from .s3 import S3Client, S3Error
 
@@ -168,6 +170,21 @@ class Uploader:
         the batch loop which folds them into the result."""
         token.raise_if_cancelled()
         size = os.stat(file_path).st_size
+        if FAILPOINTS.fire("canary.corrupt"):
+            # silent corruption PAST every digest check: the fetched
+            # file on disk verified clean, the upload "succeeds" with
+            # the same size, but the stored first byte is flipped —
+            # exactly the failure only the canary read-back can catch
+            with open(file_path, "rb") as stream:
+                body = bytearray(stream.read())
+            if body:
+                body[0] ^= 0xFF
+            with tracing.span("upload-file", key=key, size=size):
+                self._client.put_object(
+                    self._bucket, key, io.BytesIO(bytes(body)), size, token=token
+                )
+            log.info("finished upload")
+            return size
         with open(file_path, "rb") as stream, tracing.span(
             "upload-file", key=key, size=size
         ):
@@ -175,6 +192,13 @@ class Uploader:
             self._client.put_object(self._bucket, key, stream, size, token=token)
         log.info("finished upload")
         return size
+
+    def read_back(self, key: str) -> bytes:
+        """Outside-in fetch of a stored object's bytes — the canary
+        verifier's integrity lane (utils/canary.py). Deliberately NOT
+        routed through any cache or pipeline state: it must see
+        exactly what the store would serve a downstream consumer."""
+        return self._client.get_object(self._bucket, key)
 
     def upload_files(
         self,

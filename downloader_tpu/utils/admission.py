@@ -53,6 +53,10 @@ from .logging import get_logger
 log = get_logger("admission")
 
 JOB_CLASSES = ("interactive", "bulk")
+# the synthetic-probe class (utils/canary.py): admitted and scheduled
+# like user traffic so probes ride the real path, but excluded from the
+# user SLO histograms, flow amplification, and heavy-hitter sketches
+CANARY_CLASS = "canary"
 DEFAULT_CLASS = "bulk"
 DEFAULT_TENANT = "default"
 
@@ -109,8 +113,10 @@ def normalize_class(value, default: str = DEFAULT_CLASS) -> str:
             value = value.decode("ascii")
         except UnicodeDecodeError:
             return default
-    if isinstance(value, str) and value.strip().lower() in JOB_CLASSES:
-        return value.strip().lower()
+    if isinstance(value, str):
+        lowered = value.strip().lower()
+        if lowered in JOB_CLASSES or lowered == CANARY_CLASS:
+            return lowered
     return default
 
 
@@ -792,6 +798,7 @@ def batch_slot_key() -> str:
 
 __all__ = [
     "AdmissionController",
+    "CANARY_CLASS",
     "CONTROLLER",
     "Decision",
     "DeficitScheduler",

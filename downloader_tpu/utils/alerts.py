@@ -616,6 +616,18 @@ def default_rules(
                 "sketch); a flash crowd or a stuck refetch on one key"
             ),
         ),
+        ThresholdRule(
+            "canary-failure",
+            "canary_failing",
+            threshold=1.0,
+            description=(
+                "a synthetic canary probe failed outside-in "
+                "verification (publish, Convert round-trip, or store "
+                "read-back integrity) — the pipeline is broken or "
+                "silently corrupting even if every passive signal is "
+                "green (utils/canary.py)"
+            ),
+        ),
     ]
 
 

@@ -74,6 +74,12 @@ documents each with its natural failure):
                     lease-index IO error (degrades to direct fetch);
                     kill dies HOLDING the lease, forcing a follower
                     promotion
+``canary.corrupt``  the store-and-forward upload (store/uploader.py
+                    ``_upload_one``): fail = SILENT corruption — the
+                    stored object's first byte is flipped past every
+                    digest check, the upload still reports success;
+                    only the canary plane's outside-in read-back
+                    (utils/canary.py) can catch it
 ==================  ====================================================
 
 Wired in ``serve()`` from the environment; tests drive

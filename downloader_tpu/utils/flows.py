@@ -45,6 +45,7 @@ import os
 import re
 import threading
 import urllib.parse
+from collections import OrderedDict
 
 from . import metrics
 
@@ -58,6 +59,9 @@ DEFAULT_AMPLIFICATION_ALERT = 3.0
 DEFAULT_HOT_SHARE_ALERT = 0.8
 OVERFLOW_KEY = "__overflow__"
 OVERFLOW_LABEL = "overflow"
+# bound on the canary-exclusion set (object keys whose bytes are
+# synthetic and must stay out of every flow signal)
+MAX_EXCLUDED = 256
 
 # the stage spans daemon/app.py wraps each job phase in — the names a
 # gating chain's first hop below the root resolves to
@@ -326,6 +330,10 @@ class FlowLedger:
         # max single-key sketch estimate: monotone (estimates only
         # grow), so the hot-share gauge is one division per note
         self._top_bytes = 0  # guarded-by: _lock
+        # synthetic-probe object keys (utils/canary.py): their bytes
+        # must never enter the amplification ratio or the heavy-hitter
+        # sketch. Bounded FIFO — a runaway prober cannot grow it.
+        self._excluded: "OrderedDict[str, None]" = OrderedDict()  # guarded-by: _lock
 
     # -- configuration --------------------------------------------------
 
@@ -372,8 +380,27 @@ class FlowLedger:
             self._tracked_demand = 0
             self._tracked_unique = 0
             self._top_bytes = 0
+            self._excluded.clear()
         metrics.GLOBAL.gauge_set("flow_origin_amplification", 0.0)
         metrics.GLOBAL.gauge_set("flow_hot_object_share", 0.0)
+
+    # -- canary exclusion ------------------------------------------------
+
+    def exclude(self, key: str) -> None:
+        """Mark an object key as synthetic: every later note for it is
+        dropped before it can touch the ledger, the amplification
+        ratio, or the heavy-hitter sketch. The set is a bounded FIFO
+        (:data:`MAX_EXCLUDED`): the oldest probe keys age out, which is
+        fine — a probe's notes all land within one probe timeout."""
+        with self._lock:
+            self._excluded[key] = None
+            self._excluded.move_to_end(key)
+            while len(self._excluded) > MAX_EXCLUDED:
+                self._excluded.popitem(last=False)
+
+    def _is_excluded(self, key: str) -> bool:
+        with self._lock:
+            return key in self._excluded
 
     # -- the hot-path notes ---------------------------------------------
 
@@ -397,6 +424,8 @@ class FlowLedger:
         if not self.enabled or count <= 0:
             return
         with self._lock:
+            if self._excluded and obj in self._excluded:
+                return
             self._ingress_total += count
             entry = self._origins.get(origin)
             if entry is None:
@@ -437,6 +466,8 @@ class FlowLedger:
         if not self.enabled or total_bytes <= 0:
             return
         with self._lock:
+            if self._excluded and obj in self._excluded:
+                return
             slot, folded = self._object_slot(obj)
             delta = total_bytes - slot[1]
             if delta <= 0:
@@ -458,6 +489,8 @@ class FlowLedger:
         if not self.enabled or count <= 0:
             return
         with self._lock:
+            if self._excluded and obj in self._excluded:
+                return
             self._cache_hit_total += count
         metrics.GLOBAL.add("flow_cache_hit_bytes_total", count)
 
@@ -467,6 +500,8 @@ class FlowLedger:
         if not self.enabled or count <= 0:
             return
         with self._lock:
+            if self._excluded and obj in self._excluded:
+                return
             self._egress_total += count
             slot, _ = self._object_slot(obj)
             slot[2] += count

@@ -314,6 +314,21 @@ HELP = {
         "stale multipart uploads aborted by the crash janitor (orphans "
         "of workers that died mid-stream)"
     ),
+    "canary_probes_total": (
+        "synthetic canary probes completed (cold + warm, pass or fail)"
+    ),
+    "canary_probe_failures_total": (
+        "canary probes that failed any verification stage (publish, "
+        "Convert round-trip, store read-back integrity)"
+    ),
+    "canary_failing": (
+        "1 while the canary episode is failing, 0 when the last probe "
+        "verified clean (the canary-failure page rule's input)"
+    ),
+    "canary_e2e_seconds": (
+        "end-to-end latency of a verified canary probe (publish "
+        "through outside-in integrity check), trace-id exemplars"
+    ),
 }
 
 

@@ -527,3 +527,63 @@ def test_bench_digest_picks_up_single_flight_arm():
     assert digest["cache_hit_ratio"] == 0.5
     assert digest["singleflight_amp"] == 1.0
     assert digest["singleflight_amp_off"] == 2.0
+
+
+def test_circleci_runs_canary_smoke_and_artifact():
+    """The canary plane's CI surface (ISSUE 20): the injected-silent-
+    corruption e2e (canary-failure pages within one probe interval
+    while every passive rule stays green) and the exclusion-invariant
+    proof run as a named step, and the fleet-merged canary scorecard
+    the smoke writes is uploaded as an artifact."""
+    yaml = pytest.importorskip("yaml")
+    ci = yaml.safe_load(CONFIG.read_text())
+    steps = ci["jobs"]["tests"]["steps"]
+    commands = " ".join(
+        s["run"]["command"]
+        for s in steps
+        if isinstance(s, dict) and "run" in s
+    )
+    assert (
+        "test_canary.py::"
+        "test_canary_detects_silent_corruption_within_one_interval"
+        in commands
+    )
+    assert (
+        "test_canary.py::test_probe_wave_excluded_from_passive_signals"
+        in commands
+    )
+    assert "CANARY_SMOKE_ARTIFACT_DIR=/tmp/canary" in commands
+    artifact_paths = [
+        s["store_artifacts"]["path"]
+        for s in steps
+        if isinstance(s, dict) and "store_artifacts" in s
+    ]
+    assert "/tmp/canary" in artifact_paths
+
+
+def test_bench_digest_picks_up_canary_probe_arm():
+    """The canary_probe arm's contract numbers — probe-pair cost and
+    corruption detection latency — must survive into the digest line
+    beside the other overhead arms."""
+    import sys
+
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench_digest
+    finally:
+        sys.path.remove(str(REPO))
+
+    report = {
+        "value": 100.0,
+        "extra_metrics": [
+            {
+                "metric": "canary_probe",
+                "delta_ms": 0.02,
+                "detect_s": 0.4,
+                "pairs": 3,
+            }
+        ],
+    }
+    digest = bench_digest.digest_line(report)
+    assert digest["canary_ms"] == 0.02
+    assert digest["canary_detect_s"] == 0.4
